@@ -1,0 +1,47 @@
+"""ray_tpu: a TPU-native distributed AI runtime.
+
+Task/actor runtime + cluster scheduling (placement groups, TPU slice gang
+reservation) + collective communication over JAX/XLA meshes + libraries:
+data (streaming datasets), train (JaxTrainer/GSPMD), tune (HPO), rllib (RL),
+serve (model serving), llm (batched LLM inference).
+
+Built new for TPU (JAX/XLA/pjit/Pallas over ICI+DCN) with the capabilities
+of the reference Ray codebase; see SURVEY.md for the blueprint mapping.
+"""
+
+from ray_tpu import exceptions  # noqa: F401
+from ray_tpu.api import (  # noqa: F401
+    ActorClass,
+    ActorHandle,
+    RemoteFunction,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    method,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator  # noqa: F401
+
+__version__ = "0.1.0"
+
+_LAZY_SUBMODULES = ("data", "train", "tune", "rllib", "serve", "llm", "collective", "workflow")
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f"ray_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

@@ -1,0 +1,127 @@
+"""Runtime config flag registry.
+
+TPU-native equivalent of the reference's ``RayConfig`` flag system
+(reference: src/ray/common/ray_config_def.h — 232 RAY_CONFIG entries, each
+overridable via a ``RAY_<name>`` env var, parsed in common/ray_config.h:60).
+
+Every flag declared here is overridable via the ``RT_<NAME>`` environment
+variable at import time, and via ``ray_tpu.init(_system_config={...})`` at
+runtime.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+_ENV_PREFIX = "RT_"
+
+
+def _env_override(name: str, default: Any) -> Any:
+    raw = os.environ.get(_ENV_PREFIX + name.upper())
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+@dataclass
+class Config:
+    """Global runtime configuration (one instance per process)."""
+
+    # --- object store ---
+    # Objects smaller than this are stored inline in the owner's in-process
+    # memory store and piggybacked on RPC replies (reference:
+    # max_direct_call_object_size, common/ray_config_def.h:198).
+    max_direct_call_object_size: int = 100 * 1024
+    # Per-node shared-memory object store capacity.
+    object_store_memory: int = 2 * 1024 * 1024 * 1024
+    # Fraction of the store above which LRU-evictable objects are released.
+    object_store_eviction_threshold: float = 0.8
+    # Use the C++ shared-memory store when the extension is built.
+    use_native_object_store: bool = True
+
+    # --- scheduler ---
+    # Pack onto busiest feasible node until its utilization crosses this
+    # threshold, then spread (reference: scheduler_spread_threshold=0.5,
+    # common/ray_config_def.h:178).
+    scheduler_spread_threshold: float = 0.5
+    # Max task retries on worker crash when not overridden per task.
+    default_max_retries: int = 3
+    # Worker lease/dispatch batch size.
+    dispatch_batch_size: int = 64
+
+    # --- worker pool ---
+    num_workers_soft_limit: int = 0  # 0 => num_cpus
+    worker_start_method: str = "forkserver"
+    prestart_workers: bool = True
+    worker_register_timeout_s: float = 60.0
+    idle_worker_killing_time_s: float = 300.0
+
+    # --- health / failure detection ---
+    # Reference: gcs_health_check_manager.h — period + failure threshold.
+    health_check_period_s: float = 1.0
+    health_check_failure_threshold: int = 5
+    # Actor restart backoff.
+    actor_restart_backoff_s: float = 0.1
+
+    # --- fault injection (reference: rpc_chaos.h, RAY_testing_rpc_failure) ---
+    # Format: "method1=N,method2=M" — fail the first N calls of method1.
+    testing_rpc_failure: str = ""
+
+    # --- collective / mesh ---
+    collective_timeout_s: float = 120.0
+
+    # --- lineage ---
+    # Bounded lineage window: terminal task specs beyond this count are
+    # pruned (their outputs become non-reconstructable, like the
+    # reference's lineage eviction under max_lineage_bytes).
+    max_lineage_tasks: int = 20_000
+
+    # --- observability ---
+    task_events_buffer_size: int = 100_000
+    metrics_report_interval_s: float = 5.0
+    log_to_driver: bool = True
+
+    # --- misc ---
+    session_dir: str = "/tmp/ray_tpu"
+    enable_timeline: bool = True
+
+    extra: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        for f in fields(self):
+            if f.name == "extra":
+                continue
+            setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))
+
+    def update(self, overrides: dict | None):
+        if not overrides:
+            return
+        known = {f.name for f in fields(self)}
+        for k, v in overrides.items():
+            if k in known:
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+
+
+_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _config
+    if _config is None:
+        _config = Config()
+    return _config
+
+
+def reset_config():
+    global _config
+    _config = None

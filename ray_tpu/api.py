@@ -1,0 +1,442 @@
+"""Public API: init/shutdown, @remote, get/put/wait, actors.
+
+Reference parity: python/ray/_private/worker.py (ray.init :1432, get/put/wait
+wrappers), python/ray/remote_function.py (RemoteFunction._remote :314),
+python/ray/actor.py (ActorClass :1189, ActorClass._remote :1499).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import inspect
+import threading
+
+from ray_tpu.core import context
+from ray_tpu.core.ids import ActorID
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
+from ray_tpu.core.payloads import encode_value
+from ray_tpu.core.serialization import serialize
+from ray_tpu.core.task_spec import ArgSpec
+from ray_tpu.exceptions import GetTimeoutError
+
+_init_lock = threading.Lock()
+
+
+# ----------------------------------------------------------------------
+# init / shutdown
+# ----------------------------------------------------------------------
+def init(
+    address: str | None = None,
+    *,
+    num_cpus: int | None = None,
+    num_tpus: int | None = None,
+    resources: dict | None = None,
+    local_mode: bool = False,
+    namespace: str = "default",
+    ignore_reinit_error: bool = False,
+    labels: dict | None = None,
+    log_to_driver: bool = True,
+    _system_config: dict | None = None,
+    **kwargs,
+):
+    from ray_tpu.core.runtime import Runtime
+
+    with _init_lock:
+        if context.is_initialized():
+            if ignore_reinit_error:
+                return context.get_client()
+            raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True to allow")
+        res = dict(resources or {})
+        if num_cpus is not None:
+            res["CPU"] = float(num_cpus)
+        if num_tpus is not None:
+            res["TPU"] = float(num_tpus)
+        rt = Runtime(
+            resources=res or None,
+            local_mode=local_mode,
+            namespace=namespace,
+            system_config=_system_config,
+            labels=labels,
+        )
+        context.set_client(rt)
+        return rt
+
+
+def shutdown():
+    client = context.maybe_client()
+    if client is not None and hasattr(client, "shutdown"):
+        client.shutdown()
+    context.set_client(None)
+
+
+def is_initialized() -> bool:
+    return context.is_initialized()
+
+
+def _auto_init():
+    if not context.is_initialized():
+        init()
+    return context.get_client()
+
+
+# ----------------------------------------------------------------------
+# object API
+# ----------------------------------------------------------------------
+def put(value) -> ObjectRef:
+    client = _auto_init()
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() does not accept ObjectRefs")
+    return client.put_object(value)
+
+
+def get(refs, *, timeout: float | None = None):
+    import time as _time
+
+    client = _auto_init()
+    if isinstance(refs, ObjectRef):
+        return client.get_object(refs.id, timeout=timeout)
+    if isinstance(refs, (list, tuple)):
+        # timeout is an overall deadline across the whole batch
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        out = []
+        for r in refs:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"get() expects ObjectRefs, got {type(r)}")
+            remaining = None if deadline is None else max(0.0, deadline - _time.monotonic())
+            out.append(client.get_object(r.id, timeout=remaining))
+        return out
+    raise TypeError(f"get() expects an ObjectRef or list, got {type(refs)}")
+
+
+def wait(refs, *, num_returns: int = 1, timeout: float | None = None, fetch_local: bool = True):
+    client = _auto_init()
+    refs = list(refs)
+    by_id = {r.id: r for r in refs}
+    ready_ids, rest_ids = client.wait_ready([r.id for r in refs], num_returns=num_returns, timeout=timeout)
+    return [by_id[i] for i in ready_ids], [by_id[i] for i in rest_ids]
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    _auto_init().cancel_task(ref.id, force=force)
+
+
+def internal_free(refs):
+    _auto_init().free_objects([r.id for r in refs])
+
+
+# ----------------------------------------------------------------------
+# task/actor options
+# ----------------------------------------------------------------------
+_VALID_OPTIONS = {
+    "num_cpus",
+    "num_gpus",
+    "num_tpus",
+    "resources",
+    "memory",
+    "num_returns",
+    "max_retries",
+    "retry_exceptions",
+    "max_restarts",
+    "max_task_retries",
+    "max_concurrency",
+    "max_pending_calls",
+    "name",
+    "namespace",
+    "lifetime",
+    "scheduling_strategy",
+    "placement_group",
+    "placement_group_bundle_index",
+    "placement_group_capture_child_tasks",
+    "runtime_env",
+    "label_selector",
+    "concurrency_groups",
+    "accelerator_type",
+}
+
+
+def _check_options(opts: dict):
+    unknown = set(opts) - _VALID_OPTIONS
+    if unknown:
+        raise ValueError(f"unknown option(s): {sorted(unknown)}")
+
+
+def _encode_args(args, kwargs):
+    arg_specs = []
+    for a in args:
+        if isinstance(a, ObjectRef):
+            arg_specs.append(ArgSpec(ref=a.id))
+        else:
+            arg_specs.append(ArgSpec(payload=encode_value(a)))
+    kw_specs = {}
+    for k, v in (kwargs or {}).items():
+        if isinstance(v, ObjectRef):
+            kw_specs[k] = ArgSpec(ref=v.id)
+        else:
+            kw_specs[k] = ArgSpec(payload=encode_value(v))
+    return arg_specs, kw_specs
+
+
+def _num_returns(opts, default=1):
+    nr = opts.get("num_returns", default)
+    if nr in ("streaming", "dynamic"):
+        return 1, True
+    return int(nr), False
+
+
+# ----------------------------------------------------------------------
+# remote functions
+# ----------------------------------------------------------------------
+class RemoteFunction:
+    def __init__(self, fn, options: dict | None = None):
+        if inspect.iscoroutinefunction(fn):
+            raise TypeError("async functions can only be actor methods")
+        self._fn = fn
+        self._options = dict(options or {})
+        self._blob = None
+        self._func_id = None
+        functools.update_wrapper(self, fn)
+
+    def _ensure_registered(self, client):
+        if self._func_id is None:
+            from ray_tpu.core.serialization import Serialized
+
+            s = serialize(self._fn)
+            bufs = [bytes(b) for b in s.buffers]
+            self._func_id = hashlib.sha1(bytes(s.header) + b"".join(bufs)).hexdigest()
+            self._blob = Serialized(header=bytes(s.header), buffers=bufs)
+        if not client.has_function(self._func_id):
+            return self._blob
+        return None
+
+    def options(self, **opts) -> "RemoteFunction":
+        _check_options(opts)
+        merged = {**self._options, **opts}
+        rf = RemoteFunction(self._fn, merged)
+        rf._blob = self._blob
+        rf._func_id = self._func_id
+        return rf
+
+    def remote(self, *args, **kwargs):
+        client = _auto_init()
+        blob = self._ensure_registered(client)
+        arg_specs, kw_specs = _encode_args(args, kwargs)
+        num_returns, streaming = _num_returns(self._options)
+        ids = client.submit_task(
+            name=getattr(self._fn, "__name__", "task"),
+            func_id=self._func_id,
+            args=arg_specs,
+            kwargs=kw_specs,
+            num_returns=num_returns,
+            streaming=streaming,
+            func_blob=blob,
+            options=self._options,
+        )
+        if hasattr(client, "mark_function_sent"):
+            client.mark_function_sent(self._func_id)
+        if streaming:
+            return ObjectRefGenerator(ids[0])
+        refs = [ObjectRef(i) for i in ids]
+        return refs[0] if num_returns == 1 else refs
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(f"remote function {self.__name__}() cannot be called directly; use .remote()")
+
+
+# ----------------------------------------------------------------------
+# actors
+# ----------------------------------------------------------------------
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, options: dict | None = None):
+        self._handle = handle
+        self._name = name
+        self._options = dict(options or {})
+
+    def options(self, **opts) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, {**self._options, **opts})
+
+    def remote(self, *args, **kwargs):
+        client = _auto_init()
+        arg_specs, kw_specs = _encode_args(args, kwargs)
+        num_returns, streaming = _num_returns(self._options)
+        ids = client.submit_actor_task(
+            actor_id=self._handle._actor_id,
+            method_name=self._name,
+            args=arg_specs,
+            kwargs=kw_specs,
+            num_returns=num_returns,
+            streaming=streaming,
+            options=self._options,
+        )
+        if streaming:
+            return ObjectRefGenerator(ids[0])
+        refs = [ObjectRef(i) for i in ids]
+        return refs[0] if num_returns == 1 else refs
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag import ActorMethodNode
+
+        return ActorMethodNode(self._handle, self._name, args, kwargs)
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, method_options: dict | None = None):
+        self._actor_id = actor_id
+        self._method_options = method_options or {}
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name, self._method_options.get(name))
+
+    def __ray_ready__(self):
+        client = _auto_init()
+        if hasattr(client, "actor_ready_ref"):
+            return client.actor_ready_ref(self._actor_id)
+        from ray_tpu.core.runtime import _actor_ready_oid
+
+        return ObjectRef(_actor_ready_oid(self._actor_id))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:16]})"
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and self._actor_id == other._actor_id
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._method_options))
+
+
+class ActorClass:
+    def __init__(self, cls, options: dict | None = None):
+        self._cls = cls
+        self._options = dict(options or {})
+        self._blob = None
+        self._class_id = None
+        self.__name__ = cls.__name__
+
+    def options(self, **opts) -> "ActorClass":
+        _check_options(opts)
+        ac = ActorClass(self._cls, {**self._options, **opts})
+        ac._blob = self._blob
+        ac._class_id = self._class_id
+        return ac
+
+    def _ensure_registered(self, client):
+        if self._class_id is None:
+            from ray_tpu.core.serialization import Serialized
+
+            s = serialize(self._cls)
+            bufs = [bytes(b) for b in s.buffers]
+            self._class_id = hashlib.sha1(bytes(s.header) + b"".join(bufs)).hexdigest()
+            self._blob = Serialized(header=bytes(s.header), buffers=bufs)
+        if not client.has_function(self._class_id):
+            return self._blob
+        return None
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        client = _auto_init()
+        blob = self._ensure_registered(client)
+        arg_specs, kw_specs = _encode_args(args, kwargs)
+        opts = dict(self._options)
+        if any(inspect.iscoroutinefunction(m) for _, m in inspect.getmembers(self._cls, inspect.isfunction)):
+            opts.setdefault("max_concurrency", 8)
+        method_options = {}
+        for name, m in inspect.getmembers(self._cls, inspect.isfunction):
+            mo = getattr(m, "__ray_tpu_method_options__", None)
+            if mo:
+                method_options[name] = mo
+        info = client.create_actor(
+            name_desc=self._cls.__name__,
+            func_id=self._class_id,
+            args=arg_specs,
+            kwargs=kw_specs,
+            func_blob=blob,
+            options=opts,
+        )
+        if hasattr(client, "mark_function_sent"):
+            client.mark_function_sent(self._class_id)
+        return ActorHandle(info["actor_id"], method_options)
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(f"actor class {self.__name__} cannot be instantiated directly; use .remote()")
+
+
+def method(**opts):
+    """Per-method options decorator (reference: ray.method)."""
+
+    def deco(fn):
+        fn.__ray_tpu_method_options__ = opts
+        return fn
+
+    return deco
+
+
+# ----------------------------------------------------------------------
+# the @remote decorator
+# ----------------------------------------------------------------------
+def remote(*args, **kwargs):
+    if len(args) == 1 and not kwargs and (inspect.isfunction(args[0]) or inspect.isclass(args[0])):
+        target = args[0]
+        if inspect.isclass(target):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    _check_options(kwargs)
+    opts = kwargs
+
+    def deco(target):
+        if inspect.isclass(target):
+            return ActorClass(target, opts)
+        return RemoteFunction(target, opts)
+
+    return deco
+
+
+# ----------------------------------------------------------------------
+# actor management
+# ----------------------------------------------------------------------
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    client = _auto_init()
+    info = client.get_actor_handle_info(name, namespace)
+    if info is None:
+        raise ValueError(f"actor {name!r} not found in namespace {namespace!r}")
+    return ActorHandle(info["actor_id"])
+
+
+def kill(handle: ActorHandle, *, no_restart: bool = True):
+    _auto_init().kill_actor(handle._actor_id, no_restart=no_restart)
+
+
+# ----------------------------------------------------------------------
+# cluster info
+# ----------------------------------------------------------------------
+def nodes() -> list[dict]:
+    return _auto_init().cluster_info("nodes")
+
+
+def cluster_resources() -> dict:
+    return _auto_init().cluster_info("cluster_resources")
+
+
+def available_resources() -> dict:
+    return _auto_init().cluster_info("available_resources")
+
+
+def get_runtime_context():
+    from ray_tpu.core.context import get_runtime_context as _grc
+
+    _auto_init()
+    return _grc()

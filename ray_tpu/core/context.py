@@ -1,0 +1,66 @@
+"""Process-global client context.
+
+Each process (driver or worker) has exactly one CoreClient implementation
+bound here; ObjectRef/ActorHandle look it up lazily so they can be pickled
+across process boundaries and rebound on arrival (reference: the global
+``ray._private.worker.global_worker`` pattern).
+"""
+
+from __future__ import annotations
+
+_client = None
+
+
+def set_client(client):
+    global _client
+    _client = client
+
+
+def get_client():
+    if _client is None:
+        raise RuntimeError("ray_tpu is not initialized in this process; call ray_tpu.init() first")
+    return _client
+
+
+def maybe_client():
+    return _client
+
+
+def is_initialized() -> bool:
+    return _client is not None
+
+
+class RuntimeContext:
+    """Reference parity: ray.runtime_context.RuntimeContext."""
+
+    def __init__(self, client):
+        self._client = client
+
+    @property
+    def job_id(self):
+        return getattr(self._client, "job_id", None)
+
+    @property
+    def node_id(self):
+        return getattr(self._client, "node_id", None)
+
+    @property
+    def worker_id(self):
+        return getattr(self._client, "worker_id", None)
+
+    def get_actor_id(self):
+        return getattr(self._client, "current_actor_id", None)
+
+    def get_task_id(self):
+        return getattr(self._client, "current_task_id", None)
+
+    def get_assigned_resources(self):
+        return getattr(self._client, "assigned_resources", {})
+
+    def get_accelerator_ids(self):
+        res = self.get_assigned_resources()
+        return {"TPU": [str(i) for i in res.get("_tpu_chip_ids", [])]}
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(get_client())

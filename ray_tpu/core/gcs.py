@@ -1,0 +1,140 @@
+"""Control-plane state: KV store, named actors, pubsub, job registry.
+
+TPU-native equivalent of the reference's GCS (reference:
+src/ray/gcs/gcs_server.h:98 — internal KV `gcs_kv_manager.h`, actor registry
+`gcs_actor_manager.h:93`, pubsub `src/ray/pubsub/publisher.h:245`). Storage is
+the in-memory table store (reference: store_client/in_memory_store_client.h:32);
+a Redis-backed table store can be slotted behind the same dict interface for
+fault tolerance (reference: redis_store_client.h:126).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time
+from collections import defaultdict
+
+
+class KVStore:
+    """Namespaced binary KV (reference: gcs_kv_manager.h InternalKV)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: dict[str, dict[bytes, bytes]] = defaultdict(dict)
+
+    def put(self, key: bytes, value: bytes, overwrite: bool = True, namespace: str = "default") -> bool:
+        with self._lock:
+            ns = self._data[namespace]
+            if not overwrite and key in ns:
+                return False
+            ns[key] = value
+            return True
+
+    def get(self, key: bytes, namespace: str = "default") -> bytes | None:
+        with self._lock:
+            return self._data[namespace].get(key)
+
+    def delete(self, key: bytes, namespace: str = "default") -> bool:
+        with self._lock:
+            return self._data[namespace].pop(key, None) is not None
+
+    def exists(self, key: bytes, namespace: str = "default") -> bool:
+        with self._lock:
+            return key in self._data[namespace]
+
+    def keys(self, prefix: bytes = b"", namespace: str = "default") -> list[bytes]:
+        with self._lock:
+            return [k for k in self._data[namespace] if k.startswith(prefix)]
+
+
+class Publisher:
+    """In-process pubsub (reference: pubsub/publisher.h:245 long-poll based;
+    here subscribers get direct callback fan-out)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: dict[str, list] = defaultdict(list)
+
+    def subscribe(self, channel: str, callback) -> callable:
+        with self._lock:
+            self._subs[channel].append(callback)
+
+        def unsubscribe():
+            with self._lock:
+                try:
+                    self._subs[channel].remove(callback)
+                except ValueError:
+                    pass
+
+        return unsubscribe
+
+    def publish(self, channel: str, message: dict):
+        with self._lock:
+            subs = list(self._subs.get(channel, ()))
+        for cb in subs:
+            try:
+                cb(message)
+            except Exception:
+                pass
+
+
+class EventBuffer:
+    """Ring buffer of structured task/actor/node lifecycle events
+    (reference: core_worker/task_event_buffer.h -> gcs/gcs_task_manager.h)."""
+
+    def __init__(self, capacity: int = 100_000):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+
+    def record(self, kind: str, **fields):
+        ev = {"kind": kind, "ts": time.time(), **fields}
+        with self._lock:
+            self._events.append(ev)
+            if len(self._events) > self.capacity:
+                del self._events[: self.capacity // 10]
+
+    def query(self, kind: str | None = None, pattern: str | None = None, limit: int = 1000) -> list[dict]:
+        with self._lock:
+            evs = list(self._events)
+        if kind:
+            evs = [e for e in evs if e["kind"] == kind]
+        if pattern:
+            evs = [e for e in evs if fnmatch.fnmatch(e.get("name", ""), pattern)]
+        return evs[-limit:]
+
+
+class Gcs:
+    def __init__(self):
+        self.kv = KVStore()
+        self.pubsub = Publisher()
+        self.events = EventBuffer()
+        self._lock = threading.Lock()
+        # named actor registry: (namespace, name) -> ActorID
+        self.named_actors: dict[tuple, object] = {}
+        self.job_counter = 0
+
+    def register_named_actor(self, name: str, namespace: str, actor_id) -> bool:
+        with self._lock:
+            key = (namespace, name)
+            if key in self.named_actors:
+                return False
+            self.named_actors[key] = actor_id
+            return True
+
+    def lookup_named_actor(self, name: str, namespace: str):
+        with self._lock:
+            return self.named_actors.get((namespace, name))
+
+    def unregister_named_actor(self, name: str, namespace: str):
+        with self._lock:
+            self.named_actors.pop((namespace, name), None)
+
+    def list_named_actors(self, namespace: str | None = None) -> list:
+        with self._lock:
+            return [
+                {"name": n, "namespace": ns}
+                for (ns, n) in self.named_actors
+                if namespace is None or ns == namespace
+            ]

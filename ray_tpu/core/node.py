@@ -1,0 +1,241 @@
+"""Node manager: per-node resource accounting + worker process pool.
+
+TPU-native equivalent of the reference's raylet (reference:
+src/ray/raylet/node_manager.h:133 lease-based scheduling entry;
+src/ray/raylet/worker_pool.h:280 process pool with prestart and idle reuse).
+Nodes here are in-driver-process objects each owning real OS worker
+processes; the cluster test harness instantiates several to simulate
+multi-node scheduling (reference: python/ray/cluster_utils.py:135).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ray_tpu._config import get_config
+from ray_tpu.core.ids import NodeID, WorkerID
+
+_mp_ctx = None
+
+
+def _ctx():
+    global _mp_ctx
+    if _mp_ctx is None:
+        method = get_config().worker_start_method
+        if method == "forkserver":
+            ctx = mp.get_context("forkserver")
+            # Fork pre-warmed workers: the forkserver imports the worker
+            # module (and, via sitecustomize, jax) exactly once; every
+            # subsequent worker is a cheap fork of that clean process.
+            ctx.set_forkserver_preload(["ray_tpu.core.worker_main"])
+            _mp_ctx = ctx
+        else:
+            _mp_ctx = mp.get_context(method)
+    return _mp_ctx
+
+
+import contextlib
+import sys
+
+
+@contextlib.contextmanager
+def _suppress_child_main_import():
+    """Stop multiprocessing from re-importing the driver's __main__ in
+    workers. Functions/classes travel by value via cloudpickle (like the
+    reference: python/ray/_private/serialization.py), so workers never need
+    the user's script — re-running it would execute module-level side
+    effects (or crash outright for stdin/REPL drivers)."""
+    main = sys.modules.get("__main__")
+    if main is None:
+        yield
+        return
+    saved = {}
+    for attr in ("__spec__", "__file__"):
+        if hasattr(main, attr):
+            saved[attr] = getattr(main, attr)
+            try:
+                setattr(main, attr, None)
+            except Exception:
+                pass
+    try:
+        yield
+    finally:
+        for attr, val in saved.items():
+            try:
+                setattr(main, attr, val)
+            except Exception:
+                pass
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    proc: object
+    conn: object  # driver-side end of the duplex pipe
+    node_id: NodeID
+    state: str = "starting"  # starting | idle | busy | actor | dead
+    actor_id: object = None
+    running_tasks: dict = field(default_factory=dict)  # task_id -> spec
+    env_binding: dict = field(default_factory=dict)  # sticky env (TPU chips)
+    last_idle: float = field(default_factory=time.monotonic)
+    send_lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def send(self, msg: dict):
+        with self.send_lock:
+            self.conn.send(msg)
+
+    def alive(self) -> bool:
+        return self.state != "dead" and self.proc.is_alive()
+
+
+class Node:
+    """One (possibly simulated) node: resources, labels, worker pool."""
+
+    def __init__(self, node_id: NodeID | None, resources: dict, labels: dict | None = None, env: dict | None = None):
+        self.node_id = node_id or NodeID.from_random()
+        self.total_resources = dict(resources)
+        self.available = dict(resources)
+        self.labels = dict(labels or {})
+        self.env = dict(env or {})
+        self.workers: dict[WorkerID, WorkerHandle] = {}
+        self.dispatch_queue: list = []  # tasks with resources reserved, waiting for a worker
+        self.alive = True
+        self._lock = threading.RLock()
+        # placement-group bundle accounting: pg_id -> {bundle_idx: {res: avail}}
+        self.pg_bundles: dict = {}
+        self.pg_bundle_totals: dict = {}
+        # TPU chip index pool for TPU_VISIBLE_CHIPS assignment
+        self._tpu_chips_free = list(range(int(resources.get("TPU", 0))))
+
+    # ---- resources ----
+    def feasible(self, resources: dict) -> bool:
+        return all(self.total_resources.get(k, 0) >= v for k, v in resources.items() if v > 0)
+
+    def can_allocate(self, resources: dict) -> bool:
+        return all(self.available.get(k, 0) >= v - 1e-9 for k, v in resources.items() if v > 0)
+
+    def allocate(self, resources: dict) -> bool:
+        with self._lock:
+            if not self.can_allocate(resources):
+                return False
+            for k, v in resources.items():
+                if v > 0:
+                    self.available[k] = self.available.get(k, 0) - v
+            return True
+
+    def release(self, resources: dict):
+        with self._lock:
+            for k, v in resources.items():
+                if v > 0:
+                    self.available[k] = min(self.available.get(k, 0) + v, self.total_resources.get(k, 0))
+
+    def utilization(self) -> float:
+        """Max over resource dims of used fraction (reference scorer:
+        raylet/scheduling/policy/scorer.h)."""
+        u = 0.0
+        for k, tot in self.total_resources.items():
+            if tot > 0:
+                u = max(u, 1.0 - self.available.get(k, 0) / tot)
+        return u
+
+    # ---- placement-group bundles ----
+    def reserve_bundle(self, pg_id, bundle_idx: int, resources: dict) -> bool:
+        with self._lock:
+            if not self.allocate(resources):
+                return False
+            self.pg_bundles.setdefault(pg_id, {})[bundle_idx] = dict(resources)
+            self.pg_bundle_totals.setdefault(pg_id, {})[bundle_idx] = dict(resources)
+            return True
+
+    def return_bundle(self, pg_id, bundle_idx: int):
+        with self._lock:
+            total = self.pg_bundle_totals.get(pg_id, {}).pop(bundle_idx, None)
+            self.pg_bundles.get(pg_id, {}).pop(bundle_idx, None)
+            if total:
+                self.release(total)
+
+    def allocate_from_bundle(self, pg_id, bundle_idx: int, resources: dict) -> bool:
+        with self._lock:
+            avail = self.pg_bundles.get(pg_id, {}).get(bundle_idx)
+            if avail is None:
+                return False
+            if not all(avail.get(k, 0) >= v - 1e-9 for k, v in resources.items() if v > 0):
+                return False
+            for k, v in resources.items():
+                if v > 0:
+                    avail[k] = avail.get(k, 0) - v
+            return True
+
+    def release_to_bundle(self, pg_id, bundle_idx: int, resources: dict):
+        with self._lock:
+            avail = self.pg_bundles.get(pg_id, {}).get(bundle_idx)
+            total = self.pg_bundle_totals.get(pg_id, {}).get(bundle_idx)
+            if avail is None or total is None:
+                return
+            for k, v in resources.items():
+                if v > 0:
+                    avail[k] = min(avail.get(k, 0) + v, total.get(k, 0))
+
+    # ---- TPU chips ----
+    def take_tpu_chips(self, n: int) -> list[int]:
+        with self._lock:
+            chips, self._tpu_chips_free = self._tpu_chips_free[:n], self._tpu_chips_free[n:]
+            return chips
+
+    def return_tpu_chips(self, chips: list[int]):
+        with self._lock:
+            self._tpu_chips_free.extend(chips)
+
+    # ---- workers ----
+    def start_worker(self) -> WorkerHandle:
+        from ray_tpu.core.worker_main import worker_entry
+
+        ctx = _ctx()
+        parent_conn, child_conn = ctx.Pipe(duplex=True)
+        wid = WorkerID.from_random()
+        proc = ctx.Process(
+            target=worker_entry,
+            args=(child_conn, wid.hex(), self.node_id.hex(), self.env),
+            daemon=True,
+            name=f"rt-worker-{wid.hex()[:8]}",
+        )
+        with _suppress_child_main_import():
+            proc.start()
+        child_conn.close()
+        handle = WorkerHandle(worker_id=wid, proc=proc, conn=parent_conn, node_id=self.node_id)
+        with self._lock:
+            self.workers[wid] = handle
+        return handle
+
+    def idle_workers(self) -> list[WorkerHandle]:
+        with self._lock:
+            return [w for w in self.workers.values() if w.state == "idle"]
+
+    def remove_worker(self, wid: WorkerID):
+        with self._lock:
+            self.workers.pop(wid, None)
+
+    def shutdown(self):
+        self.alive = False
+        with self._lock:
+            workers = list(self.workers.values())
+            self.workers.clear()
+        for w in workers:
+            try:
+                w.send({"type": "shutdown"})
+            except Exception:
+                pass
+        for w in workers:
+            try:
+                w.proc.join(timeout=1.0)
+                if w.proc.is_alive():
+                    w.proc.terminate()
+            except Exception:
+                pass
+            try:
+                w.conn.close()
+            except Exception:
+                pass

@@ -1,0 +1,117 @@
+"""ObjectRef: a future naming an object in the cluster.
+
+Reference parity: python/ray/_raylet.pyx ObjectRef +
+ObjectRefGenerator (streaming returns, _raylet.pyx:1067).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.core.ids import ObjectID
+
+
+def _client():
+    from ray_tpu.core.context import get_client
+
+    return get_client()
+
+
+class ObjectRef:
+    __slots__ = ("id", "_owner_hint")
+
+    def __init__(self, obj_id: ObjectID, owner_hint: str | None = None):
+        self.id = obj_id
+        self._owner_hint = owner_hint
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def task_id(self):
+        return self.id.task_id()
+
+    def get(self, timeout: float | None = None):
+        return _client().get_object(self.id, timeout=timeout)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return _client().wait_ready([self.id], num_returns=1, timeout=timeout)[0] != []
+
+    def future(self):
+        """concurrent.futures.Future view of this ref."""
+        import concurrent.futures
+
+        fut = concurrent.futures.Future()
+
+        def _done(value, err):
+            if err is not None:
+                fut.set_exception(err)
+            else:
+                fut.set_result(value)
+
+        _client().add_done_callback(self.id, _done)
+        return fut
+
+    def __await__(self):
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and self.id == other.id
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()[:16]})"
+
+    def __reduce__(self):
+        # Refs crossing a process boundary are borrowed; the runtime adds the
+        # borrow when deserializing task args (reference:
+        # reference_counter.h borrow protocol).
+        return (ObjectRef, (self.id, self._owner_hint))
+
+
+class ObjectRefGenerator:
+    """Iterator over the streamed return refs of a generator task.
+
+    Reference parity: _raylet.pyx ObjectRefGenerator (:1067) — each next()
+    yields an ObjectRef whose value is produced incrementally by the task.
+    """
+
+    def __init__(self, generator_id: ObjectID):
+        self.generator_id = generator_id
+        self._index = 0
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        if self._done:
+            raise StopIteration
+        ref = _client().next_generator_item(self.generator_id, self._index, timeout=None)
+        if ref is None:
+            self._done = True
+            raise StopIteration
+        self._index += 1
+        return ref if isinstance(ref, ObjectRef) else ObjectRef(ref)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> ObjectRef:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(None, self.__next__)
+        except StopIteration:
+            raise StopAsyncIteration from None
+
+    def completed(self) -> bool:
+        return self._done
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self.generator_id,))

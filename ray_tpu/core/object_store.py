@@ -1,0 +1,348 @@
+"""Object stores: in-process memory store + shared-memory store.
+
+TPU-native equivalents of the reference's two store providers:
+- ``MemoryStore``  <- CoreWorkerMemoryStore (reference:
+  src/ray/core_worker/store_provider/memory_store.h:47) — small objects held
+  in the owner process, waiters notified on seal.
+- ``SharedMemoryStore`` <- plasma (reference:
+  src/ray/object_manager/plasma/store.h:55, plasma_allocator.h) — large
+  objects in named POSIX shared memory, mapped zero-copy by workers on the
+  same host. Instead of a single mmap arena + dlmalloc we use one named
+  segment per object (the kernel's page cache is the allocator); a C++ arena
+  store can replace this behind the same interface.
+
+Eviction is LRU over sealed, unpinned objects (reference:
+plasma/eviction_policy.h); evicted objects are reconstructed via lineage by
+the task manager (reference: object_recovery_manager.h:41).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory, resource_tracker
+
+from ray_tpu._config import get_config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.serialization import Serialized
+
+
+@dataclass
+class ShmDescriptor:
+    """Locator for an object living in shared memory."""
+
+    shm_name: str
+    header_len: int
+    buffer_lens: list[int]
+    total_size: int
+
+
+@dataclass
+class StoredObject:
+    """An entry in the owner's store: either inline data or an shm locator,
+    or an error to raise at get()."""
+
+    value: Serialized | None = None
+    shm: ShmDescriptor | None = None
+    error: BaseException | None = None
+    sealed_at: float = field(default_factory=time.monotonic)
+
+    def size(self) -> int:
+        if self.shm is not None:
+            return self.shm.total_size
+        if self.value is not None:
+            return self.value.total_size()
+        return 0
+
+
+def _attach_no_track(name: str) -> shared_memory.SharedMemory:
+    """Attach to an existing segment without registering with the
+    resource_tracker (the owner is responsible for unlinking)."""
+    seg = shared_memory.SharedMemory(name=name)
+    try:
+        resource_tracker.unregister(seg._name, "shared_memory")  # noqa: SLF001
+    except Exception:
+        pass
+    return seg
+
+
+def _session_tag() -> str:
+    """Segment names embed the session (driver) pid so orphans from killed
+    sessions can be reclaimed (reference: plasma store restart cleanup)."""
+    import os
+
+    return os.environ.get("RT_SESSION_PID", str(os.getpid()))
+
+
+def cleanup_orphan_segments():
+    """Unlink rt<pid>_* segments whose owning session is dead."""
+    import os
+    import re
+
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return
+    for n in names:
+        m = re.match(r"^rt(\d+)_", n)
+        if not m:
+            continue
+        pid = int(m.group(1))
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            try:
+                os.unlink("/dev/shm/" + n)
+            except OSError:
+                pass
+        except PermissionError:
+            pass
+
+
+def write_to_shm(obj_id: ObjectID, s: Serialized) -> ShmDescriptor:
+    total = s.total_size()
+    name = f"rt{_session_tag()}_" + obj_id.hex()[:24]
+    try:
+        seg = shared_memory.SharedMemory(name=name, create=True, size=max(total, 1))
+    except FileExistsError:
+        # stale segment from a retried/reconstructed task: replace it
+        unlink_shm(name)
+        seg = shared_memory.SharedMemory(name=name, create=True, size=max(total, 1))
+    try:
+        resource_tracker.unregister(seg._name, "shared_memory")  # noqa: SLF001
+    except Exception:
+        pass
+    off = 0
+    seg.buf[off : off + len(s.header)] = s.header
+    off += len(s.header)
+    lens = []
+    for b in s.buffers:
+        mv = memoryview(b).cast("B")
+        n = len(mv)
+        seg.buf[off : off + n] = mv
+        off += n
+        lens.append(n)
+    desc = ShmDescriptor(shm_name=name, header_len=len(s.header), buffer_lens=lens, total_size=total)
+    seg.close()
+    return desc
+
+
+def read_from_shm(desc: ShmDescriptor, zero_copy: bool = False):
+    """Return (Serialized, segment). With zero_copy the buffers are
+    memoryviews into the mapping and the caller must keep `segment` alive."""
+    seg = _attach_no_track(desc.shm_name)
+    off = 0
+    hdr_mv = seg.buf[off : off + desc.header_len]
+    header = bytes(hdr_mv)
+    hdr_mv.release()
+    off += desc.header_len
+    buffers = []
+    for n in desc.buffer_lens:
+        mv = seg.buf[off : off + n]
+        if zero_copy:
+            buffers.append(mv)
+        else:
+            buffers.append(bytes(mv))
+            mv.release()
+        off += n
+    s = Serialized(header=header, buffers=buffers)
+    if not zero_copy:
+        seg.close()
+        seg = None
+    return s, seg
+
+
+def unlink_shm(name: str):
+    # Bypass SharedMemory/resource_tracker: a direct shm_unlink keeps the
+    # tracker's bookkeeping balanced (we unregistered at attach time).
+    import os
+
+    try:
+        os.unlink("/dev/shm/" + name)
+    except OSError:
+        pass
+
+
+class ObjectStore:
+    """Owner-side store combining the memory store and the shm store, with
+    waiter notification and LRU eviction accounting."""
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._objects: dict[ObjectID, StoredObject] = {}
+        self._shm_bytes = 0
+        self._pinned: dict[ObjectID, int] = {}
+        self._evicted: set[ObjectID] = set()
+        self.cfg = get_config()
+        # called (outside the lock) with the ObjectID on every seal
+        self.listeners: list = []
+
+    # -- write path --------------------------------------------------------
+    def put_serialized(self, obj_id: ObjectID, s: Serialized, inline_threshold: int | None = None) -> StoredObject:
+        thr = self.cfg.max_direct_call_object_size if inline_threshold is None else inline_threshold
+        if s.total_size() > thr:
+            desc = write_to_shm(obj_id, s)
+            entry = StoredObject(shm=desc)
+        else:
+            entry = StoredObject(value=s)
+        self.seal(obj_id, entry)
+        return entry
+
+    def put_error(self, obj_id: ObjectID, err: BaseException):
+        self.seal(obj_id, StoredObject(error=err))
+
+    def seal(self, obj_id: ObjectID, entry: StoredObject):
+        with self._lock:
+            old = self._objects.get(obj_id)
+            if old is not None and old.shm is not None:
+                self._shm_bytes -= old.shm.total_size
+                unlink_shm(old.shm.shm_name)
+            self._objects[obj_id] = entry
+            self._evicted.discard(obj_id)
+            if entry.shm is not None:
+                self._shm_bytes += entry.shm.total_size
+            self._lock.notify_all()
+        for listener in self.listeners:
+            try:
+                listener(obj_id)
+            except Exception:
+                pass
+        self._maybe_evict()
+
+    # -- read path ---------------------------------------------------------
+    def contains(self, obj_id: ObjectID) -> bool:
+        with self._lock:
+            return obj_id in self._objects
+
+    def is_evicted(self, obj_id: ObjectID) -> bool:
+        with self._lock:
+            return obj_id in self._evicted
+
+    def get_entry(self, obj_id: ObjectID, timeout: float | None = None) -> StoredObject | None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while obj_id not in self._objects:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._lock.wait(timeout=remaining if remaining is not None else 1.0)
+            entry = self._objects[obj_id]
+            entry.sealed_at = time.monotonic()  # LRU touch
+            return entry
+
+    def try_get_entry(self, obj_id: ObjectID) -> StoredObject | None:
+        with self._lock:
+            e = self._objects.get(obj_id)
+            if e is not None:
+                e.sealed_at = time.monotonic()
+            return e
+
+    def wait_ready(self, obj_ids, num_returns: int = 1, timeout: float | None = None):
+        """Block until num_returns of obj_ids are sealed; returns
+        (ready_ids, remaining_ids) preserving input order (reference:
+        ray.wait semantics, core_worker.h Wait)."""
+        obj_ids = list(obj_ids)
+        num_returns = min(num_returns, len(obj_ids))
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                ready = [o for o in obj_ids if o in self._objects]
+                if len(ready) >= num_returns:
+                    ready = ready[:num_returns]
+                    ready_set = set(ready)
+                    rest = [o for o in obj_ids if o not in ready_set]
+                    return ready, rest
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return ready, [o for o in obj_ids if o not in ready]
+                self._lock.wait(timeout=0.5 if remaining is None else min(remaining, 0.5))
+
+    # -- lifecycle ---------------------------------------------------------
+    def pin(self, obj_id: ObjectID):
+        with self._lock:
+            self._pinned[obj_id] = self._pinned.get(obj_id, 0) + 1
+
+    def unpin(self, obj_id: ObjectID):
+        with self._lock:
+            n = self._pinned.get(obj_id, 0) - 1
+            if n <= 0:
+                self._pinned.pop(obj_id, None)
+            else:
+                self._pinned[obj_id] = n
+
+    def delete(self, obj_id: ObjectID):
+        with self._lock:
+            entry = self._objects.pop(obj_id, None)
+            self._evicted.discard(obj_id)
+            if entry is not None and entry.shm is not None:
+                self._shm_bytes -= entry.shm.total_size
+                unlink_shm(entry.shm.shm_name)
+
+    def mark_lost(self, obj_id: ObjectID):
+        """The object's shm backing vanished (raced eviction / external
+        unlink): flip to evicted so lineage reconstruction kicks in."""
+        with self._lock:
+            entry = self._objects.pop(obj_id, None)
+            if entry is not None and entry.shm is not None:
+                self._shm_bytes -= entry.shm.total_size
+            self._evicted.add(obj_id)
+
+    def shm_backing_exists(self, entry: StoredObject) -> bool:
+        import os
+
+        if entry.shm is None:
+            return True
+        return os.path.exists("/dev/shm/" + entry.shm.shm_name)
+
+    def evict(self, obj_id: ObjectID) -> bool:
+        """Drop the object's data but remember it existed (lineage can
+        reconstruct it)."""
+        with self._lock:
+            if obj_id in self._pinned:
+                return False
+            entry = self._objects.pop(obj_id, None)
+            if entry is None:
+                return False
+            if entry.shm is not None:
+                self._shm_bytes -= entry.shm.total_size
+                unlink_shm(entry.shm.shm_name)
+            self._evicted.add(obj_id)
+            return True
+
+    def _maybe_evict(self):
+        cfg = self.cfg
+        limit = int(cfg.object_store_memory * cfg.object_store_eviction_threshold)
+        with self._lock:
+            if self._shm_bytes <= limit:
+                return
+            candidates = sorted(
+                (
+                    (e.sealed_at, oid)
+                    for oid, e in self._objects.items()
+                    if e.shm is not None and oid not in self._pinned
+                ),
+            )
+        for _, oid in candidates:
+            self.evict(oid)
+            with self._lock:
+                if self._shm_bytes <= limit:
+                    break
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_objects": len(self._objects),
+                "shm_bytes": self._shm_bytes,
+                "num_evicted": len(self._evicted),
+                "num_pinned": len(self._pinned),
+            }
+
+    def shutdown(self):
+        with self._lock:
+            for entry in self._objects.values():
+                if entry.shm is not None:
+                    unlink_shm(entry.shm.shm_name)
+            self._objects.clear()
+            self._shm_bytes = 0
+            self._evicted.clear()

@@ -1,0 +1,40 @@
+"""Encoding/decoding of values crossing the driver<->worker boundary."""
+
+from __future__ import annotations
+
+from ray_tpu._config import get_config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import read_from_shm, write_to_shm
+from ray_tpu.core.serialization import Serialized, deserialize, serialize
+from ray_tpu.core.task_spec import Payload
+
+
+def encode_value(value, obj_id: ObjectID | None = None, threshold: int | None = None) -> Payload:
+    """Serialize a value; large payloads go to shared memory (zero-copy for
+    any process on this host), small ones stay inline."""
+    s = serialize(value)
+    return encode_serialized(s, obj_id=obj_id, threshold=threshold)
+
+
+def encode_serialized(s: Serialized, obj_id: ObjectID | None = None, threshold: int | None = None) -> Payload:
+    if threshold is None:
+        threshold = get_config().max_direct_call_object_size
+    if s.total_size() > threshold:
+        if obj_id is None:
+            obj_id = ObjectID.from_put()
+        desc = write_to_shm(obj_id, s)
+        return Payload(shm=desc)
+    # Pipe messages are pickled; make buffers picklable bytes.
+    return Payload(inline=Serialized(header=s.header, buffers=[bytes(b) for b in s.buffers]))
+
+
+def decode_payload(p: Payload, zero_copy: bool = True):
+    """Return (value, segment_keepalive_or_None)."""
+    if p.shm is not None:
+        s, seg = read_from_shm(p.shm, zero_copy=zero_copy)
+        if zero_copy:
+            bufs = [b.toreadonly() if isinstance(b, memoryview) else b for b in s.buffers]
+        else:
+            bufs = s.buffers
+        return deserialize(s.header, bufs), seg
+    return deserialize(p.inline.header, p.inline.buffers), None

@@ -1,0 +1,1382 @@
+"""The head runtime: object ownership, scheduling, actor management, worker IO.
+
+This process plays the roles the reference splits across GCS + raylet +
+driver core_worker (reference: src/ray/gcs/gcs_server.h:98,
+src/ray/raylet/node_manager.h:133, src/ray/core_worker/core_worker.h:167):
+it owns all objects, runs the cluster scheduler over the (possibly many)
+node managers, maintains the actor registry with restart state machines, and
+serves client RPCs from worker processes over their pipes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from multiprocessing import connection as mp_connection
+
+from ray_tpu._config import get_config, reset_config
+from ray_tpu.core import context
+from ray_tpu.core.gcs import Gcs
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
+from ray_tpu.core.node import Node, WorkerHandle
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_store import ObjectStore, StoredObject, read_from_shm
+from ray_tpu.core.payloads import decode_payload, encode_serialized, encode_value
+from ray_tpu.core.scheduler import Scheduler
+from ray_tpu.core.serialization import Serialized, deserialize_s
+from ray_tpu.core.task_manager import TaskManager
+from ray_tpu.core.task_spec import ActorInfo, ArgSpec, Payload, SchedulingOptions, TaskSpec
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    PlacementGroupUnschedulableError,
+    TaskError,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class GenState:
+    """Streaming-generator bookkeeping (reference: streaming returns in
+    task_manager.h + _raylet.pyx:1067)."""
+
+    __slots__ = ("items", "finished", "error", "error_ref_made")
+
+    def __init__(self):
+        self.items: list[ObjectID] = []
+        self.finished = False
+        self.error: BaseException | None = None
+        self.error_ref_made = False
+
+
+class ActorState:
+    def __init__(self, info: ActorInfo):
+        self.info = info
+        self.lock = threading.RLock()
+        self.seq = 0
+        self.pending: list[tuple] = []  # (spec, msg) queued while not ALIVE
+        self.allocation = None  # (node, resources, chips)
+        self.expected_exit = False
+        self.waiters = threading.Condition(self.lock)
+
+
+class PlacementGroupState:
+    def __init__(self, pg_id: PlacementGroupID, bundles: list[dict], strategy: str, name: str = ""):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.state = "PENDING"  # PENDING | CREATED | REMOVED
+        self.placements: list = []  # bundle_idx -> NodeID
+        self.cond = threading.Condition()
+
+
+class Runtime:
+    """Driver-side CoreClient + cluster control plane."""
+
+    def __init__(
+        self,
+        resources: dict | None = None,
+        num_nodes: int = 1,
+        local_mode: bool = False,
+        namespace: str = "default",
+        system_config: dict | None = None,
+        labels: dict | None = None,
+    ):
+        reset_config()
+        self.cfg = get_config()
+        self.cfg.update(system_config)
+        os.environ["RT_SESSION_PID"] = str(os.getpid())
+        from ray_tpu.core.object_store import cleanup_orphan_segments
+
+        cleanup_orphan_segments()
+        self.local_mode = local_mode
+        self.namespace = namespace
+        self.job_id = JobID.from_random()
+        self.node_id = None
+        self.worker_id = None
+        self.current_task_id = None
+        self.current_actor_id = None
+        self.assigned_resources = {}
+
+        self.store = ObjectStore()
+        self.gcs = Gcs()
+        self.task_manager = TaskManager(self)
+        self.scheduler = Scheduler(self)
+        self._nodes_lock = threading.RLock()
+        self.nodes: dict[NodeID, Node] = {}
+        self.actors: dict[ActorID, ActorState] = {}
+        self.placement_groups: dict[PlacementGroupID, PlacementGroupState] = {}
+        self.generators: dict[ObjectID, GenState] = {}
+        self._gen_cond = threading.Condition()
+        self._functions: dict[str, Serialized] = {}
+        self._local_fn_cache: dict[str, object] = {}
+        self._done_callbacks: dict[ObjectID, list] = {}
+        self._dc_lock = threading.Lock()
+        self._stopped = False
+        self._worker_count_limit_extra = 4
+        # Large pool: client RPCs like get_object block until the object is
+        # produced, so the pool must exceed the worst-case number of
+        # simultaneously blocked workers to avoid starving put/submit RPCs.
+        self._req_pool = ThreadPoolExecutor(max_workers=256, thread_name_prefix="rt-req")
+
+        base_res = dict(resources or {})
+        base_res.setdefault("CPU", float(os.cpu_count() or 4))
+        base_res.setdefault("memory", float(2**33))
+        base_res.setdefault("TPU", float(_detect_tpu_chips()))
+        if base_res.get("TPU", 0) <= 0:
+            base_res.pop("TPU", None)
+        head = Node(None, base_res, labels={"ray_tpu.io/node-type": "head", **(labels or {})})
+        self.head_node = head
+        self.node_id = head.node_id
+        self.nodes[head.node_id] = head
+        self.gcs.events.record("node_added", node_id=head.node_id.hex(), resources=base_res)
+        for _ in range(max(0, num_nodes - 1)):
+            self.add_node(dict(base_res))
+
+        self.store.listeners.append(self._on_sealed)
+        if not local_mode:
+            self._io_thread = threading.Thread(target=self._io_loop, daemon=True, name="rt-io")
+            self._io_thread.start()
+            self._sched_thread = threading.Thread(target=self.scheduler.run_loop, daemon=True, name="rt-sched")
+            self._sched_thread.start()
+            if self.cfg.prestart_workers:
+                # Warm the pool in the background (reference: worker_pool.h
+                # prestart) — overlaps the one-time forkserver boot with user
+                # setup code.
+                n = min(int(head.total_resources.get("CPU", 1)), 4)
+                threading.Thread(
+                    target=lambda: [head.start_worker() for _ in range(n)] if not self._stopped else None,
+                    daemon=True,
+                ).start()
+
+    # ------------------------------------------------------------------
+    # cluster membership
+    # ------------------------------------------------------------------
+    def add_node(self, resources: dict, labels: dict | None = None, env: dict | None = None) -> Node:
+        node = Node(None, resources, labels=labels, env=env)
+        with self._nodes_lock:
+            self.nodes[node.node_id] = node
+        self.gcs.events.record("node_added", node_id=node.node_id.hex(), resources=resources)
+        self.gcs.pubsub.publish("node", {"event": "added", "node_id": node.node_id.hex()})
+        self.scheduler.wake()
+        return node
+
+    def remove_node(self, node_id: NodeID, graceful: bool = False):
+        """Simulate node death (reference: GcsHealthCheckManager failure path —
+        gcs_health_check_manager.h:45: leases killed, objects failed)."""
+        with self._nodes_lock:
+            node = self.nodes.get(node_id)
+        if node is None:
+            return
+        node.alive = False
+        workers = list(node.workers.values())
+        for w in workers:
+            self._on_worker_death(node, w, "node removed")
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        node.shutdown()
+        with self._nodes_lock:
+            self.nodes.pop(node_id, None)
+        self.gcs.events.record("node_removed", node_id=node_id.hex())
+        self.gcs.pubsub.publish("node", {"event": "removed", "node_id": node_id.hex()})
+        self.scheduler.wake()
+
+    def node_list(self) -> list[Node]:
+        with self._nodes_lock:
+            return [n for n in self.nodes.values() if n.alive]
+
+    # ------------------------------------------------------------------
+    # object plane (CoreClient impl)
+    # ------------------------------------------------------------------
+    def put_object(self, value) -> ObjectRef:
+        obj_id = ObjectID.from_put()
+        self.store.put_serialized(obj_id, _to_serialized(value))
+        return ObjectRef(obj_id)
+
+    def put_payload(self, obj_id: ObjectID, payload: Payload):
+        if payload.shm is not None:
+            self.store.seal(obj_id, StoredObject(shm=payload.shm))
+        else:
+            self.store.seal(obj_id, StoredObject(value=payload.inline))
+
+    def get_object(self, obj_id: ObjectID, timeout: float | None = None, _depth: int = 0):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            entry = self._get_entry_reconstructing(obj_id, deadline)
+            if entry is None:
+                raise GetTimeoutError(f"get() timed out waiting for {obj_id.hex()[:16]}")
+            if entry.error is not None:
+                raise entry.error
+            if entry.shm is not None:
+                try:
+                    s, _ = read_from_shm(entry.shm, zero_copy=False)
+                except FileNotFoundError:
+                    self.store.mark_lost(obj_id)  # raced an eviction
+                    continue
+                return deserialize_s(s)
+            return deserialize_s(entry.value)
+
+    def _get_entry_reconstructing(self, obj_id, deadline):
+        while True:
+            timeout = None if deadline is None else max(0.0, deadline - time.monotonic())
+            if self.store.is_evicted(obj_id):
+                self.task_manager.reconstruct(obj_id)
+            entry = self.store.get_entry(obj_id, timeout=0.2 if timeout is None else min(timeout, 0.2))
+            if entry is not None:
+                if not self.store.shm_backing_exists(entry):
+                    self.store.mark_lost(obj_id)
+                    continue
+                return entry
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+
+    def entry_to_payload(self, entry: StoredObject) -> Payload:
+        if entry.error is not None:
+            return encode_value(entry.error)
+        if entry.shm is not None:
+            return Payload(shm=entry.shm)
+        s = entry.value
+        return Payload(inline=Serialized(header=s.header, buffers=[bytes(b) for b in s.buffers]))
+
+    def wait_ready(self, obj_ids, num_returns=1, timeout=None, fetch_local=True):
+        return self.store.wait_ready(obj_ids, num_returns, timeout)
+
+    def add_done_callback(self, obj_id: ObjectID, cb):
+        with self._dc_lock:
+            if not self.store.contains(obj_id):
+                self._done_callbacks.setdefault(obj_id, []).append(cb)
+                return
+        self._req_pool.submit(self._fire_callback, obj_id, cb)
+
+    def _fire_callback(self, obj_id, cb):
+        try:
+            v = self.get_object(obj_id)
+            cb(v, None)
+        except BaseException as e:  # noqa: BLE001
+            cb(None, e)
+
+    def free_objects(self, obj_ids):
+        for oid in obj_ids:
+            self.store.delete(oid)
+
+    def _on_sealed(self, obj_id: ObjectID):
+        self.scheduler.on_object_sealed(obj_id)
+        with self._dc_lock:
+            cbs = self._done_callbacks.pop(obj_id, None)
+        if cbs:
+            for cb in cbs:
+                self._req_pool.submit(self._fire_callback, obj_id, cb)
+
+    # ------------------------------------------------------------------
+    # function registry
+    # ------------------------------------------------------------------
+    def register_function(self, func_id: str, blob: Serialized | None):
+        if blob is not None and func_id not in self._functions:
+            self._functions[func_id] = Serialized(header=blob.header, buffers=[bytes(b) for b in blob.buffers])
+
+    def has_function(self, func_id: str) -> bool:
+        return func_id in self._functions
+
+    def get_function_blob(self, func_id: str) -> Serialized:
+        return self._functions[func_id]
+
+    def get_function(self, func_id: str):
+        if func_id not in self._local_fn_cache:
+            self._local_fn_cache[func_id] = deserialize_s(self._functions[func_id])
+        return self._local_fn_cache[func_id]
+
+    # ------------------------------------------------------------------
+    # task submission (CoreClient impl)
+    # ------------------------------------------------------------------
+    def submit_task(
+        self,
+        name: str,
+        func_id: str,
+        args: list[ArgSpec],
+        kwargs: dict[str, ArgSpec] | None = None,
+        num_returns: int = 1,
+        streaming: bool = False,
+        func_blob: Serialized | None = None,
+        options: dict | None = None,
+    ):
+        self.register_function(func_id, func_blob)
+        opts = options or {}
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            name=name,
+            func_id=func_id,
+            args=args,
+            num_returns=num_returns,
+            streaming=streaming,
+            scheduling=_sched_options(opts),
+            max_retries=opts.get("max_retries", self.cfg.default_max_retries),
+            retry_exceptions=opts.get("retry_exceptions", False),
+            runtime_env=opts.get("runtime_env"),
+        )
+        spec._kwargs = kwargs or {}
+        self.task_manager.register(spec)
+        if self.local_mode:
+            self._local_execute(spec)
+        else:
+            self.scheduler.submit(spec)
+        if streaming:
+            return [spec.generator_id()]
+        return spec.return_ids()
+
+    def resubmit(self, spec: TaskSpec):
+        """Re-run a task (retry or lineage reconstruction)."""
+        spec.attempt += 1
+        if spec.actor_id is not None and not spec.is_actor_creation:
+            self._submit_actor_spec(spec)
+        elif self.local_mode:
+            self._local_execute(spec)
+        else:
+            self.scheduler.submit(spec)
+
+    # ------------------------------------------------------------------
+    # actors (CoreClient impl)
+    # ------------------------------------------------------------------
+    def create_actor(
+        self,
+        name_desc: str,
+        func_id: str,
+        args: list[ArgSpec],
+        kwargs: dict | None = None,
+        func_blob: Serialized | None = None,
+        options: dict | None = None,
+    ) -> dict:
+        self.register_function(func_id, func_blob)
+        opts = options or {}
+        actor_id = ActorID.from_random()
+        actor_name = opts.get("name")
+        namespace = opts.get("namespace", self.namespace)
+        if actor_name:
+            if not self.gcs.register_named_actor(actor_name, namespace, actor_id):
+                raise ValueError(f"actor name {actor_name!r} already taken in namespace {namespace!r}")
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            name=f"{name_desc}.__init__",
+            func_id=func_id,
+            args=args,
+            num_returns=0,
+            scheduling=_sched_options(opts, is_actor=True),
+            actor_id=actor_id,
+            is_actor_creation=True,
+            max_restarts=opts.get("max_restarts", 0),
+            max_task_retries=opts.get("max_task_retries", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+        )
+        spec._kwargs = kwargs or {}
+        info = ActorInfo(
+            actor_id=actor_id,
+            name=actor_name,
+            namespace=namespace,
+            class_id=func_id,
+            state="PENDING",
+            max_restarts=spec.max_restarts,
+            max_task_retries=spec.max_task_retries,
+            max_concurrency=spec.max_concurrency,
+            creation_spec=spec,
+            resources=dict(spec.scheduling.resources),
+            placement_group=spec.scheduling.placement_group,
+            bundle_index=spec.scheduling.bundle_index,
+            detached=opts.get("lifetime") == "detached",
+        )
+        self.actors[actor_id] = ActorState(info)
+        self.task_manager.register(spec)
+        self.gcs.events.record("actor_created", actor_id=actor_id.hex(), name=name_desc)
+        if self.local_mode:
+            self._local_create_actor(spec)
+        else:
+            self.scheduler.submit(spec)
+        return {"actor_id": actor_id, "method_meta": {}}
+
+    def submit_actor_task(
+        self,
+        actor_id: ActorID,
+        method_name: str,
+        args: list[ArgSpec],
+        kwargs: dict | None = None,
+        num_returns: int = 1,
+        streaming: bool = False,
+        options: dict | None = None,
+    ):
+        astate = self.actors.get(actor_id)
+        if astate is None:
+            raise ActorDiedError(actor_id, "unknown actor")
+        with astate.lock:
+            if astate.info.state == "DEAD":
+                err_ids = self._make_actor_error_returns(actor_id, method_name, num_returns, streaming, astate.info.death_cause)
+                return err_ids
+            astate.seq += 1
+            spec = TaskSpec(
+                task_id=TaskID.for_actor(actor_id, astate.seq),
+                name=f"{method_name}",
+                func_id="",
+                args=args,
+                num_returns=num_returns,
+                streaming=streaming,
+                actor_id=actor_id,
+                method_name=method_name,
+                seq_no=astate.seq,
+                max_retries=astate.info.max_task_retries,
+            )
+            spec._kwargs = kwargs or {}
+            self.task_manager.register(spec)
+            if self.local_mode:
+                self._local_actor_call(spec)
+            else:
+                self._submit_actor_spec(spec)
+        if streaming:
+            return [spec.generator_id()]
+        return spec.return_ids()
+
+    def _make_actor_error_returns(self, actor_id, method_name, num_returns, streaming, cause):
+        tid = TaskID.from_random()
+        err = ActorDiedError(actor_id, cause or "actor is dead")
+        ids = []
+        if streaming:
+            ids = [ObjectID.for_task_return(tid, 0)]
+        else:
+            ids = [ObjectID.for_task_return(tid, i) for i in range(num_returns)]
+        for oid in ids:
+            self.store.put_error(oid, err)
+        return ids
+
+    def _submit_actor_spec(self, spec: TaskSpec):
+        astate = self.actors[spec.actor_id]
+        with astate.lock:
+            if astate.info.state == "ALIVE":
+                self._dispatch_actor_task(astate, spec)
+            elif astate.info.state in ("PENDING", "RESTARTING"):
+                astate.pending.append(spec)
+            else:
+                err = ActorDiedError(spec.actor_id, astate.info.death_cause)
+                for oid in self._spec_return_ids(spec):
+                    self.store.put_error(oid, err)
+
+    def _dispatch_actor_task(self, astate: ActorState, spec: TaskSpec):
+        node = self.nodes.get(astate.info.node_id)
+        worker = node.workers.get(astate.info.worker_id) if node else None
+        if worker is None or not worker.alive():
+            astate.pending.append(spec)
+            return
+        msg = self._build_exec_msg(spec, node, resources=astate.info.resources, env=None)
+        if msg is None:
+            return  # dependency error already sealed
+        worker.running_tasks[spec.task_id] = (spec, None)
+        self.task_manager.mark_running(spec.task_id, node.node_id, worker.worker_id)
+        worker.send(msg)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        astate = self.actors.get(actor_id)
+        if astate is None:
+            return
+        with astate.lock:
+            astate.expected_exit = no_restart
+            if no_restart:
+                astate.info.max_restarts = 0
+            node = self.nodes.get(astate.info.node_id)
+            worker = node.workers.get(astate.info.worker_id) if node else None
+            if worker is None and astate.info.creation_spec is not None:
+                # still PENDING: pull the creation task out of the scheduler
+                # so the actor can't resurrect after the kill
+                self.scheduler.remove_task(astate.info.creation_spec.task_id)
+        if worker is not None:
+            try:
+                worker.proc.terminate()
+            except Exception:
+                pass
+        else:
+            self._finalize_actor_death(astate, "killed via ray_tpu.kill")
+
+    def get_actor_handle_info(self, name: str, namespace: str = "default") -> dict | None:
+        actor_id = self.gcs.lookup_named_actor(name, namespace)
+        if actor_id is None:
+            return None
+        astate = self.actors.get(actor_id)
+        if astate is None or astate.info.state == "DEAD":
+            return None
+        return {"actor_id": actor_id, "class_id": astate.info.class_id}
+
+    # ------------------------------------------------------------------
+    # placement groups
+    # ------------------------------------------------------------------
+    def create_placement_group(self, bundles: list[dict], strategy: str = "PACK", name: str = "") -> PlacementGroupID:
+        """Atomic all-or-nothing bundle reservation (reference: 2-phase
+        commit in gcs/gcs_placement_group_scheduler.h; atomicity is trivial
+        here because the control plane is single-process)."""
+        pg_id = PlacementGroupID.from_random()
+        pgs = PlacementGroupState(pg_id, bundles, strategy, name)
+        self.placement_groups[pg_id] = pgs
+        self._try_place_pg(pgs)
+        return pg_id
+
+    def _try_place_pg(self, pgs: PlacementGroupState) -> bool:
+        with self._nodes_lock:
+            nodes = self.node_list()
+            plan = _plan_pg(pgs.bundles, pgs.strategy, nodes)
+            if plan is None:
+                return False
+            reserved = []
+            ok = True
+            for idx, node in enumerate(plan):
+                if node.reserve_bundle(pgs.pg_id, idx, pgs.bundles[idx]):
+                    reserved.append((node, idx))
+                else:
+                    ok = False
+                    break
+            if not ok:
+                for node, idx in reserved:
+                    node.return_bundle(pgs.pg_id, idx)
+                return False
+        with pgs.cond:
+            pgs.placements = [n.node_id for n in plan]
+            pgs.state = "CREATED"
+            pgs.cond.notify_all()
+        from ray_tpu.util.placement_group import _pg_ready_oid
+
+        self.store.put_serialized(_pg_ready_oid(pgs.pg_id), _to_serialized(True))
+        self.gcs.events.record("pg_created", pg_id=pgs.pg_id.hex(), strategy=pgs.strategy)
+        return True
+
+    def wait_placement_group(self, pg_id: PlacementGroupID, timeout: float | None = None) -> bool:
+        pgs = self.placement_groups.get(pg_id)
+        if pgs is None:
+            raise PlacementGroupUnschedulableError(f"unknown placement group {pg_id}")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with pgs.cond:
+                if pgs.state == "CREATED":
+                    return True
+                if pgs.state == "REMOVED":
+                    raise PlacementGroupUnschedulableError("placement group removed")
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                pgs.cond.wait(timeout=0.1 if remaining is None else min(0.1, remaining))
+            if pgs.state == "PENDING":
+                self._try_place_pg(pgs)
+
+    def remove_placement_group(self, pg_id: PlacementGroupID):
+        pgs = self.placement_groups.get(pg_id)
+        if pgs is None:
+            return
+        with self._nodes_lock:
+            for node in self.node_list():
+                for idx in list(node.pg_bundles.get(pg_id, {})):
+                    node.return_bundle(pg_id, idx)
+        with pgs.cond:
+            pgs.state = "REMOVED"
+            pgs.cond.notify_all()
+        self.gcs.events.record("pg_removed", pg_id=pg_id.hex())
+
+    def placement_group_table(self) -> list[dict]:
+        return [
+            {
+                "pg_id": p.pg_id.hex(),
+                "name": p.name,
+                "state": p.state,
+                "strategy": p.strategy,
+                "bundles": p.bundles,
+                "nodes": [n.hex() for n in p.placements],
+            }
+            for p in self.placement_groups.values()
+        ]
+
+    # ------------------------------------------------------------------
+    # generators
+    # ------------------------------------------------------------------
+    def next_generator_item(self, gen_id: ObjectID, index: int, timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._gen_cond:
+            while True:
+                gen = self.generators.get(gen_id)
+                # error sealed directly on the generator id (worker crash,
+                # actor death, dependency failure) terminates the stream
+                entry = self.store.try_get_entry(gen_id)
+                if entry is not None and entry.error is not None:
+                    if gen is None:
+                        gen = self.generators.setdefault(gen_id, GenState())
+                    gen.finished = True
+                    gen.error = entry.error
+                if gen is not None:
+                    if index < len(gen.items):
+                        return gen.items[index]
+                    if gen.finished:
+                        if gen.error is not None and not gen.error_ref_made:
+                            gen.error_ref_made = True
+                            err_id = ObjectID.for_task_return(gen_id.task_id(), len(gen.items) + 1)
+                            self.store.put_error(err_id, gen.error)
+                            gen.items.append(err_id)
+                            return err_id
+                        if index >= len(gen.items):
+                            self.generators.pop(gen_id, None)  # exhausted: reclaim
+                        return None
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError("generator next timed out")
+                self._gen_cond.wait(timeout=0.2 if remaining is None else min(remaining, 0.2))
+
+    # ------------------------------------------------------------------
+    # scheduling integration
+    # ------------------------------------------------------------------
+    def reserve_and_queue(self, node: Node, spec: TaskSpec) -> bool:
+        sched = spec.scheduling
+        res = dict(sched.resources)
+        if sched.placement_group is not None:
+            idx = sched.bundle_index
+            if idx < 0:
+                bundles = node.pg_bundles.get(sched.placement_group, {})
+                idx = next(
+                    (
+                        i
+                        for i, avail in bundles.items()
+                        if all(avail.get(k, 0) >= v - 1e-9 for k, v in res.items() if v > 0)
+                    ),
+                    -1,
+                )
+                if idx < 0:
+                    return False
+            if not node.allocate_from_bundle(sched.placement_group, idx, res):
+                return False
+            alloc = ("pg", sched.placement_group, idx, res)
+        else:
+            if not node.allocate(res):
+                return False
+            alloc = ("node", None, -1, res)
+        chips = []
+        n_tpu = int(res.get("TPU", 0))
+        if n_tpu > 0:
+            chips = node.take_tpu_chips(n_tpu)
+        node.dispatch_queue.append((spec, alloc, chips))
+        return True
+
+    def dispatch_all(self):
+        for node in self.node_list():
+            self._dispatch_node(node)
+
+    def _dispatch_node(self, node: Node):
+        while node.dispatch_queue:
+            idle = [w for w in node.idle_workers() if not w.env_binding]
+            if not idle:
+                starting = sum(1 for w in node.workers.values() if w.state == "starting")
+                nonactor = sum(1 for w in node.workers.values() if w.state in ("starting", "idle", "busy"))
+                limit = int(node.total_resources.get("CPU", 1)) + self._worker_count_limit_extra
+                if nonactor < limit and starting < len(node.dispatch_queue):
+                    node.start_worker()
+                return
+            spec, alloc, chips = node.dispatch_queue.pop(0)
+            worker = idle[0]
+            self._dispatch_to_worker(node, worker, spec, alloc, chips)
+
+    def _dispatch_to_worker(self, node: Node, worker: WorkerHandle, spec: TaskSpec, alloc, chips):
+        env = {}
+        if chips:
+            env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in chips)
+            worker.env_binding = {"TPU_VISIBLE_CHIPS": env["TPU_VISIBLE_CHIPS"]}
+        if spec.runtime_env and spec.runtime_env.get("env_vars"):
+            env.update(spec.runtime_env["env_vars"])
+        resources = dict(alloc[3])
+        if chips:
+            resources["_tpu_chip_ids"] = chips
+        msg = self._build_exec_msg(spec, node, resources=resources, env=env)
+        if msg is None:
+            self._release_alloc(node, alloc, chips)
+            return
+        if spec.is_actor_creation:
+            worker.state = "actor"
+            worker.actor_id = spec.actor_id
+            astate = self.actors[spec.actor_id]
+            with astate.lock:
+                astate.info.node_id = node.node_id
+                astate.info.worker_id = worker.worker_id
+                astate.allocation = (node, alloc, chips)
+        else:
+            worker.state = "busy"
+        worker.running_tasks[spec.task_id] = (spec, (node, alloc, chips))
+        self.task_manager.mark_running(spec.task_id, node.node_id, worker.worker_id)
+        try:
+            worker.send(msg)
+        except (OSError, ValueError):
+            self._on_worker_death(node, worker, "send failed")
+
+    def _build_exec_msg(self, spec: TaskSpec, node: Node, resources: dict, env: dict | None):
+        """Resolve ref args into payloads; returns None if a dependency
+        failed (the dependency's error is propagated to the task returns)."""
+        args, err = self._resolve_args(spec.args)
+        if err is None:
+            kw, err = self._resolve_kwargs(getattr(spec, "_kwargs", {}))
+        if err is not None:
+            retried = self.task_manager.handle_app_error(spec.task_id, err if isinstance(err, TaskError) else TaskError.from_exception(err, spec.desc()))
+            if not retried:
+                for oid in self._spec_return_ids(spec):
+                    self.store.put_error(oid, err)
+            return None
+        import dataclasses
+
+        wire_spec = dataclasses.replace(spec, args=[])  # args travel separately, resolved
+        return {
+            "type": "exec",
+            "spec": wire_spec,
+            "args": args,
+            "kwargs": kw,
+            "resources": resources,
+            "env": env,
+        }
+
+    def _resolve_args(self, args: list[ArgSpec]):
+        out = []
+        for a in args:
+            if a.ref is None:
+                out.append(a)
+                continue
+            entry = self.store.try_get_entry(a.ref)
+            if entry is None:
+                # evicted or not yet local: let the worker fetch via RPC
+                out.append(a)
+                continue
+            if entry.error is not None:
+                return None, entry.error
+            out.append(ArgSpec(payload=self.entry_to_payload(entry)))
+        return out, None
+
+    def _resolve_kwargs(self, kwargs: dict[str, ArgSpec]):
+        out = {}
+        for k, a in (kwargs or {}).items():
+            lst, err = self._resolve_args([a])
+            if err is not None:
+                return None, err
+            out[k] = lst[0]
+        return out, None
+
+    def _spec_return_ids(self, spec: TaskSpec):
+        if spec.streaming:
+            with self._gen_cond:
+                self.generators.setdefault(spec.generator_id(), GenState())
+            return [spec.generator_id()]
+        return spec.return_ids()
+
+    def _release_alloc(self, node: Node, alloc, chips):
+        if chips:
+            node.return_tpu_chips(chips)
+        kind, pg_id, idx, res = alloc
+        if kind == "pg":
+            node.release_to_bundle(pg_id, idx, res)
+        else:
+            node.release(res)
+
+    # ------------------------------------------------------------------
+    # worker IO loop
+    # ------------------------------------------------------------------
+    def _io_loop(self):
+        while not self._stopped:
+            conn_map = {}
+            for node in self.node_list():
+                for w in list(node.workers.values()):
+                    if w.state != "dead":
+                        conn_map[w.conn] = (node, w)
+            if not conn_map:
+                time.sleep(0.02)
+                continue
+            try:
+                ready = mp_connection.wait(list(conn_map), timeout=0.05)
+            except OSError:
+                continue
+            for c in ready:
+                node, w = conn_map[c]
+                try:
+                    msg = c.recv()
+                except (EOFError, OSError):
+                    self._on_worker_death(node, w, "worker process exited")
+                    continue
+                except Exception:
+                    logger.exception("bad message from worker")
+                    continue
+                try:
+                    self._handle_worker_msg(node, w, msg)
+                except Exception:
+                    logger.exception("error handling worker message %s", msg.get("type"))
+
+    def _handle_worker_msg(self, node: Node, w: WorkerHandle, msg: dict):
+        t = msg["type"]
+        if t == "ready":
+            if w.state == "starting":
+                w.state = "idle"
+                w.last_idle = time.monotonic()
+            self.scheduler.wake()
+        elif t == "done":
+            self._on_task_done(node, w, msg)
+        elif t == "stream_item":
+            self._on_stream_item(msg)
+        elif t == "req":
+            self._req_pool.submit(self._handle_client_req, w, msg)
+        elif t == "pong":
+            pass
+
+    def _on_task_done(self, node: Node, w: WorkerHandle, msg: dict):
+        task_id = msg["task_id"]
+        entry = w.running_tasks.pop(task_id, None)
+        if entry is None:
+            return
+        spec, allocation = entry
+        if allocation is not None and not spec.is_actor_creation:
+            anode, alloc, chips = allocation
+            self._release_alloc(anode, alloc, chips)
+            if w.state == "busy":
+                if w.env_binding:
+                    # TPU-bound workers are single-use: the chip binding is
+                    # baked into the process (jax backend init); retire it so
+                    # the chips go to a fresh worker (reference: worker_pool
+                    # kills workers with exclusive accelerator envs).
+                    w.state = "dead"
+                    node.remove_worker(w.worker_id)
+                    try:
+                        w.send({"type": "shutdown"})
+                        w.conn.close()
+                    except Exception:
+                        pass
+                else:
+                    w.state = "idle"
+                    w.last_idle = time.monotonic()
+        err = msg.get("error")
+        if spec.is_actor_creation:
+            self._on_actor_creation_done(spec, err, w)
+            self.scheduler.wake()
+            return
+        if err is not None:
+            retried = self.task_manager.handle_app_error(task_id, err)
+            if not retried:
+                if spec.streaming:
+                    with self._gen_cond:
+                        gen = self.generators.setdefault(spec.generator_id(), GenState())
+                        gen.finished = True
+                        gen.error = err
+                        self._gen_cond.notify_all()
+                else:
+                    for oid in spec.return_ids():
+                        self.store.put_error(oid, err)
+        else:
+            for oid, payload in msg["returns"]:
+                self.put_payload(oid, payload)
+            if spec.streaming:
+                with self._gen_cond:
+                    gen = self.generators.setdefault(spec.generator_id(), GenState())
+                    gen.finished = True
+                    self._gen_cond.notify_all()
+            self.task_manager.complete(task_id)
+        self.gcs.events.record("task_finished", task_id=task_id.hex(), name=spec.name, ok=err is None)
+        self.scheduler.wake()
+
+    def _on_actor_creation_done(self, spec: TaskSpec, err, w: WorkerHandle):
+        astate = self.actors.get(spec.actor_id)
+        if astate is None:
+            return
+        with astate.lock:
+            if astate.info.state == "DEAD":
+                # killed while the creation was in flight: tear down the
+                # worker that just constructed it
+                if w is not None:
+                    try:
+                        w.proc.terminate()
+                    except Exception:
+                        pass
+                return
+            if err is not None:
+                astate.info.state = "DEAD"
+                astate.info.death_cause = f"creation failed: {err}"
+                self.store.put_error(_actor_ready_oid(spec.actor_id), err)
+                pending, astate.pending = astate.pending, []
+                for p in pending:
+                    for oid in self._spec_return_ids(p):
+                        self.store.put_error(oid, ActorDiedError(spec.actor_id, astate.info.death_cause))
+                self._release_actor_resources(astate)
+                return
+            astate.info.state = "ALIVE"
+            self.store.put_serialized(_actor_ready_oid(spec.actor_id), _to_serialized(True))
+            pending, astate.pending = astate.pending, []
+            for p in pending:
+                self._dispatch_actor_task(astate, p)
+        self.gcs.events.record("actor_alive", actor_id=spec.actor_id.hex())
+
+    def _on_stream_item(self, msg: dict):
+        task_id = msg["task_id"]
+        obj_id = msg["obj_id"]
+        self.put_payload(obj_id, msg["payload"])
+        gen_id = ObjectID.for_task_return(task_id, 0)
+        with self._gen_cond:
+            gen = self.generators.setdefault(gen_id, GenState())
+            gen.items.append(obj_id)
+            self._gen_cond.notify_all()
+
+    # ---- worker death / actor restart ----
+    def _on_worker_death(self, node: Node, w: WorkerHandle, reason: str):
+        if w.state == "dead" or self._stopped:
+            return
+        was_actor = w.state == "actor"
+        w.state = "dead"
+        node.remove_worker(w.worker_id)
+        try:
+            w.conn.close()
+        except Exception:
+            pass
+        running = dict(w.running_tasks)
+        w.running_tasks.clear()
+        for task_id, (spec, allocation) in running.items():
+            if allocation is not None and not spec.is_actor_creation:
+                anode, alloc, chips = allocation
+                self._release_alloc(anode, alloc, chips)
+            if spec.is_actor_creation or spec.actor_id is not None:
+                continue  # handled by actor death path
+            self.task_manager.handle_worker_crash(task_id, reason)
+        if was_actor and w.actor_id is not None:
+            self._on_actor_worker_death(w.actor_id, running, reason)
+        self.scheduler.wake()
+
+    def _on_actor_worker_death(self, actor_id: ActorID, running: dict, reason: str):
+        astate = self.actors.get(actor_id)
+        if astate is None:
+            return
+        with astate.lock:
+            info = astate.info
+            inflight = [spec for _, (spec, _) in running.items() if not spec.is_actor_creation]
+            if astate.expected_exit or info.num_restarts >= info.max_restarts:
+                cause = "expected exit" if astate.expected_exit else f"{reason}; max_restarts exhausted"
+                self._finalize_actor_death(astate, cause, inflight)
+                return
+            # restart (reference: gcs_actor_manager.h restart state machine)
+            info.num_restarts += 1
+            info.state = "RESTARTING"
+            logger.info("restarting actor %s (%d/%d): %s", actor_id.hex()[:8], info.num_restarts, info.max_restarts, reason)
+            for spec in inflight:
+                if info.max_task_retries != 0:
+                    astate.pending.append(spec)
+                else:
+                    for oid in self._spec_return_ids(spec):
+                        self.store.put_error(oid, ActorDiedError(actor_id, f"actor died while task inflight: {reason}"))
+            self.store.delete(_actor_ready_oid(actor_id))
+            if astate.allocation is not None:
+                anode, alloc, chips = astate.allocation
+                self._release_alloc(anode, alloc, chips)
+                astate.allocation = None
+            creation = info.creation_spec
+        self.task_manager.register(creation)
+        self.scheduler.submit(creation)
+
+    def _finalize_actor_death(self, astate: ActorState, cause: str, inflight: list | None = None):
+        info = astate.info
+        info.state = "DEAD"
+        info.death_cause = cause
+        # ready-ref waiters must observe the death (even if creation never ran)
+        self.store.put_error(_actor_ready_oid(info.actor_id), ActorDiedError(info.actor_id, cause))
+        for spec in inflight or []:
+            for oid in self._spec_return_ids(spec):
+                self.store.put_error(oid, ActorDiedError(info.actor_id, cause))
+        pending, astate.pending = astate.pending, []
+        for spec in pending:
+            for oid in self._spec_return_ids(spec):
+                self.store.put_error(oid, ActorDiedError(info.actor_id, cause))
+        self._release_actor_resources(astate)
+        if info.name:
+            self.gcs.unregister_named_actor(info.name, info.namespace)
+        self.gcs.events.record("actor_dead", actor_id=info.actor_id.hex(), cause=cause)
+
+    def _release_actor_resources(self, astate: ActorState):
+        if astate.allocation is not None:
+            node, alloc, chips = astate.allocation
+            self._release_alloc(node, alloc, chips)
+            astate.allocation = None
+
+    # ------------------------------------------------------------------
+    # client RPC handling (requests from worker processes)
+    # ------------------------------------------------------------------
+    def _handle_client_req(self, w: WorkerHandle, msg: dict):
+        method = msg["method"]
+        params = msg["params"]
+        try:
+            handler = getattr(self, f"_rpc_{method}", None)
+            if handler is None:
+                raise AttributeError(f"unknown client RPC {method}")
+            payload = handler(**params)
+            w.send({"type": "resp", "req_id": msg["req_id"], "ok": True, "payload": payload})
+        except BaseException as e:  # noqa: BLE001
+            try:
+                w.send({"type": "resp", "req_id": msg["req_id"], "ok": False, "error": _picklable_error(e)})
+            except Exception:
+                logger.exception("failed to send error response")
+
+    def _rpc_get_object(self, obj_id, timeout_s=None):
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        entry = self._get_entry_reconstructing(obj_id, deadline)
+        if entry is None:
+            raise GetTimeoutError(f"get() timed out waiting for {obj_id.hex()[:16]}")
+        return self.entry_to_payload(entry)
+
+    def _rpc_put_object(self, obj_id, payload):
+        self.put_payload(obj_id, payload)
+        return True
+
+    def _rpc_mark_object_lost(self, obj_id):
+        self.store.mark_lost(obj_id)
+        return True
+
+    def _rpc_wait_ready(self, obj_ids, num_returns, timeout_s=None):
+        return self.store.wait_ready(obj_ids, num_returns, timeout_s)
+
+    def _rpc_submit_task(self, **kw):
+        return self.submit_task(**kw)
+
+    def _rpc_create_actor(self, **kw):
+        return self.create_actor(**kw)
+
+    def _rpc_submit_actor_task(self, **kw):
+        return self.submit_actor_task(**kw)
+
+    def _rpc_kill_actor(self, actor_id, no_restart=True):
+        self.kill_actor(actor_id, no_restart)
+        return True
+
+    def _rpc_cancel_task(self, obj_id, force=False):
+        return self.cancel_task(obj_id, force)
+
+    def _rpc_get_actor_handle_info(self, name, namespace="default"):
+        return self.get_actor_handle_info(name, namespace)
+
+    def _rpc_next_generator_item(self, gen_id, index):
+        return self.next_generator_item(gen_id, index, timeout=None)
+
+    def _rpc_free_objects(self, obj_ids):
+        self.free_objects(obj_ids)
+        return True
+
+    def _rpc_get_function(self, func_id):
+        return self.get_function_blob(func_id)
+
+    def _rpc_cluster_info(self, kind):
+        return self.cluster_info(kind)
+
+    def _rpc_kv(self, op, **kw):
+        return getattr(self.gcs.kv, op)(**kw)
+
+    def _rpc_pg(self, op, **kw):
+        if op == "create":
+            return self.create_placement_group(**kw)
+        if op == "wait":
+            return self.wait_placement_group(**kw)
+        if op == "remove":
+            return self.remove_placement_group(**kw)
+        if op == "table":
+            return self.placement_group_table()
+        raise ValueError(op)
+
+    def pg(self, op, **kw):
+        return self._rpc_pg(op, **kw)
+
+    def kv(self, op, **kw):
+        return getattr(self.gcs.kv, op)(**kw)
+
+    # ------------------------------------------------------------------
+    # misc API
+    # ------------------------------------------------------------------
+    def cancel_task(self, obj_id: ObjectID, force: bool = False) -> bool:
+        from ray_tpu.exceptions import RayTpuError
+
+        task_id = obj_id.task_id()
+        if self.scheduler.remove_task(task_id):
+            self.task_manager.mark_cancelled(task_id)
+            st = self.task_manager.get(task_id)
+            if st:
+                for oid in self._spec_return_ids(st.spec):
+                    self.store.put_error(oid, RayTpuError(f"task {task_id.hex()[:8]} was cancelled"))
+            return True
+        if force:
+            for node in self.node_list():
+                for w in list(node.workers.values()):
+                    if task_id in w.running_tasks and w.state == "busy":
+                        self.task_manager.mark_cancelled(task_id)
+                        try:
+                            w.proc.terminate()
+                        except Exception:
+                            pass
+                        return True
+        return False
+
+    def cluster_info(self, kind: str):
+        if kind == "nodes":
+            return [
+                {
+                    "node_id": n.node_id.hex(),
+                    "alive": n.alive,
+                    "resources": dict(n.total_resources),
+                    "available": dict(n.available),
+                    "labels": dict(n.labels),
+                    "num_workers": len(n.workers),
+                }
+                for n in self.node_list()
+            ]
+        if kind == "cluster_resources":
+            out = {}
+            for n in self.node_list():
+                for k, v in n.total_resources.items():
+                    out[k] = out.get(k, 0) + v
+            return out
+        if kind == "available_resources":
+            out = {}
+            for n in self.node_list():
+                for k, v in n.available.items():
+                    out[k] = out.get(k, 0) + v
+            return out
+        if kind == "actors":
+            return [
+                {
+                    "actor_id": a.info.actor_id.hex(),
+                    "name": a.info.name,
+                    "state": a.info.state,
+                    "class": a.info.class_id[:16],
+                    "num_restarts": a.info.num_restarts,
+                    "node_id": a.info.node_id.hex() if a.info.node_id else None,
+                }
+                for a in self.actors.values()
+            ]
+        if kind == "tasks":
+            return self.task_manager.states()
+        if kind == "objects":
+            return self.store.stats()
+        if kind == "placement_groups":
+            return self.placement_group_table()
+        raise ValueError(kind)
+
+    def actor_ready_ref(self, actor_id: ActorID) -> ObjectRef:
+        return ObjectRef(_actor_ready_oid(actor_id))
+
+    # ------------------------------------------------------------------
+    # local mode execution
+    # ------------------------------------------------------------------
+    def _local_decode_args(self, spec):
+        args = []
+        for a in spec.args:
+            if a.ref is not None:
+                args.append(self.get_object(a.ref))
+            else:
+                v, _ = decode_payload(a.payload, zero_copy=False)
+                args.append(v)
+        kwargs = {}
+        for k, a in getattr(spec, "_kwargs", {}).items():
+            if a.ref is not None:
+                kwargs[k] = self.get_object(a.ref)
+            else:
+                v, _ = decode_payload(a.payload, zero_copy=False)
+                kwargs[k] = v
+        return args, kwargs
+
+    def _local_execute(self, spec: TaskSpec):
+        import inspect as _inspect
+
+        fn = self.get_function(spec.func_id)
+        try:
+            args, kwargs = self._local_decode_args(spec)
+            result = fn(*args, **kwargs)
+            if spec.streaming:
+                with self._gen_cond:
+                    gen = self.generators.setdefault(spec.generator_id(), GenState())
+                for i, item in enumerate(result):
+                    oid = ObjectID.for_task_return(spec.task_id, i + 1)
+                    self.store.put_serialized(oid, _to_serialized(item))
+                    with self._gen_cond:
+                        gen.items.append(oid)
+                        self._gen_cond.notify_all()
+                with self._gen_cond:
+                    gen.finished = True
+                    self._gen_cond.notify_all()
+                return
+            if _inspect.isgenerator(result):
+                result = list(result)
+            values = [result] if spec.num_returns == 1 else list(result)
+            for oid, v in zip(spec.return_ids(), values):
+                self.store.put_serialized(oid, _to_serialized(v))
+            self.task_manager.complete(spec.task_id)
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError.from_exception(e, spec.desc())
+            if not self.task_manager.handle_app_error(spec.task_id, err):
+                for oid in self._spec_return_ids(spec):
+                    self.store.put_error(oid, err)
+
+    def _local_create_actor(self, spec: TaskSpec):
+        cls = self.get_function(spec.func_id)
+        astate = self.actors[spec.actor_id]
+        try:
+            args, kwargs = self._local_decode_args(spec)
+            astate.local_instance = cls(*args, **kwargs)
+            astate.info.state = "ALIVE"
+            self.store.put_serialized(_actor_ready_oid(spec.actor_id), _to_serialized(True))
+        except BaseException as e:  # noqa: BLE001
+            astate.info.state = "DEAD"
+            astate.info.death_cause = str(e)
+            self.store.put_error(_actor_ready_oid(spec.actor_id), TaskError.from_exception(e, spec.desc()))
+
+    def _local_actor_call(self, spec: TaskSpec):
+        astate = self.actors[spec.actor_id]
+        inst = getattr(astate, "local_instance", None)
+        try:
+            args, kwargs = self._local_decode_args(spec)
+            if spec.method_name == "__ray_ready__":
+                result = True
+            elif spec.method_name == "__ray_terminate__":
+                result = True
+            else:
+                result = getattr(inst, spec.method_name)(*args, **kwargs)
+            import inspect as _inspect
+
+            if _inspect.iscoroutine(result):
+                import asyncio
+
+                result = asyncio.get_event_loop().run_until_complete(result)
+            values = [result] if spec.num_returns == 1 else list(result)
+            for oid, v in zip(spec.return_ids(), values):
+                self.store.put_serialized(oid, _to_serialized(v))
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError.from_exception(e, spec.desc())
+            for oid in spec.return_ids():
+                self.store.put_error(oid, err)
+
+    # ------------------------------------------------------------------
+    def shutdown(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        self.scheduler.stop()
+        for node in list(self.nodes.values()):
+            node.shutdown()
+        self.store.shutdown()
+        self._req_pool.shutdown(wait=False, cancel_futures=True)
+        context.set_client(None)
+
+
+def _actor_ready_oid(actor_id: ActorID) -> ObjectID:
+    return ObjectID(actor_id.binary() + b"\xfe\xfe\xfe\xfe")
+
+
+def _to_serialized(value) -> Serialized:
+    from ray_tpu.core.serialization import serialize
+
+    s = serialize(value)
+    return Serialized(header=s.header, buffers=[bytes(b) for b in s.buffers])
+
+
+def _sched_options(opts: dict, is_actor: bool = False) -> SchedulingOptions:
+    resources = dict(opts.get("resources") or {})
+    num_cpus = opts.get("num_cpus")
+    if num_cpus is None:
+        num_cpus = 0 if is_actor else 1
+    if num_cpus:
+        resources["CPU"] = float(num_cpus)
+    num_tpus = opts.get("num_tpus") or opts.get("num_gpus")
+    if num_tpus:
+        resources["TPU"] = float(num_tpus)
+    if opts.get("memory"):
+        resources["memory"] = float(opts["memory"])
+    pg = opts.get("placement_group")
+    pg_id = None
+    bundle_index = -1
+    if pg is not None:
+        pg_id = pg.id if hasattr(pg, "id") else pg
+        bundle_index = opts.get("placement_group_bundle_index", -1)
+    strategy = opts.get("scheduling_strategy", "DEFAULT")
+    node_id = None
+    soft_node_id = None
+    if hasattr(strategy, "node_id"):  # NodeAffinitySchedulingStrategy
+        if strategy.soft:
+            soft_node_id = strategy.node_id
+        else:
+            node_id = strategy.node_id
+        strategy = "DEFAULT"
+    elif hasattr(strategy, "placement_group"):  # PlacementGroupSchedulingStrategy
+        pg_obj = strategy.placement_group
+        pg_id = pg_obj.id if hasattr(pg_obj, "id") else pg_obj
+        bundle_index = getattr(strategy, "placement_group_bundle_index", -1)
+        strategy = "DEFAULT"
+    return SchedulingOptions(
+        resources=resources,
+        node_id=node_id,
+        soft_node_id=soft_node_id,
+        placement_group=pg_id,
+        bundle_index=bundle_index if bundle_index is not None else -1,
+        scheduling_strategy=strategy if isinstance(strategy, str) else "DEFAULT",
+        label_selector=opts.get("label_selector") or {},
+    )
+
+
+def _plan_pg(bundles: list[dict], strategy: str, nodes: list[Node]):
+    """Choose a node per bundle; None if infeasible. All-or-nothing commit
+    happens in the caller under the cluster lock."""
+    if not nodes:
+        return None
+    plan = []
+    # track would-be availability to keep the plan feasible
+    avail = {n.node_id: dict(n.available) for n in nodes}
+
+    def fits(node, res):
+        a = avail[node.node_id]
+        return all(a.get(k, 0) >= v - 1e-9 for k, v in res.items() if v > 0)
+
+    def take(node, res):
+        a = avail[node.node_id]
+        for k, v in res.items():
+            if v > 0:
+                a[k] = a.get(k, 0) - v
+
+    order = list(nodes)
+    for i, b in enumerate(bundles):
+        cands = [n for n in order if fits(n, b)]
+        if strategy in ("STRICT_SPREAD",):
+            cands = [n for n in cands if n not in plan]
+        if not cands:
+            return None
+        if strategy in ("PACK", "STRICT_PACK"):
+            # prefer the node already used by previous bundles
+            used = [n for n in plan if n in cands]
+            node = used[0] if used else cands[0]
+            if strategy == "STRICT_PACK" and plan and node is not plan[0]:
+                if plan[0] in cands:
+                    node = plan[0]
+                else:
+                    return None
+        elif strategy in ("SPREAD", "STRICT_SPREAD"):
+            unused = [n for n in cands if n not in plan]
+            node = (unused or cands)[0]
+        else:
+            node = cands[0]
+        plan.append(node)
+        take(node, b)
+    return plan
+
+
+def _picklable_error(e: BaseException) -> BaseException:
+    import pickle
+
+    try:
+        pickle.dumps(e)
+        return e
+    except Exception:
+        return TaskError(cause=None, tb_str=str(e), task_desc="rpc")
+
+
+def _detect_tpu_chips() -> int:
+    """TPU chip autodetection (reference semantics:
+    python/ray/_private/accelerators/tpu.py:294-313 — /dev/accel* then
+    /dev/vfio)."""
+    import glob
+
+    env = os.environ.get("RT_NUM_TPUS")
+    if env is not None:
+        return int(env)
+    n = len(glob.glob("/dev/accel*"))
+    if n == 0:
+        n = len(glob.glob("/dev/vfio/[0-9]*"))
+    return n

@@ -1,0 +1,218 @@
+"""Cluster scheduler: dependency resolution, node selection policies,
+worker dispatch.
+
+TPU-native equivalent of the reference's scheduling stack (reference:
+raylet/scheduling/cluster_lease_manager.h:41 queue+spillback,
+cluster_resource_scheduler.h:45, policies in raylet/scheduling/policy/ —
+hybrid pack-then-spread at scheduler_spread_threshold=0.5
+(hybrid_scheduling_policy.cc, common/ray_config_def.h:178), spread,
+node-affinity, label and bundle policies). The lease protocol collapses to
+direct worker assignment because the control plane is in-process; the
+policies and queueing semantics are preserved.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+
+from ray_tpu._config import get_config
+from ray_tpu.core.node import Node
+from ray_tpu.core.task_spec import TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+def matches_labels(node: Node, selector: dict[str, str]) -> bool:
+    for k, v in (selector or {}).items():
+        if v.startswith("!"):
+            if str(node.labels.get(k)) == v[1:]:
+                return False
+        elif str(node.labels.get(k)) != v:
+            return False
+    return True
+
+
+class SchedulingPolicy:
+    """Node-selection policies (reference: raylet/scheduling/policy/)."""
+
+    def __init__(self):
+        self._rr = itertools.count()
+
+    def pick(self, spec: TaskSpec, nodes: list[Node]) -> Node | None:
+        sched = spec.scheduling
+        cfg = get_config()
+        cands = [n for n in nodes if n.alive and matches_labels(n, sched.label_selector)]
+        if sched.node_id is not None:
+            cands = [n for n in cands if n.node_id.hex() == sched.node_id]
+            return self._first_allocatable(spec, cands)
+        if sched.placement_group is not None:
+            pg_cands = []
+            for n in cands:
+                bundles = n.pg_bundles.get(sched.placement_group, {})
+                if sched.bundle_index >= 0:
+                    if sched.bundle_index in bundles:
+                        pg_cands.append(n)
+                elif bundles:
+                    pg_cands.append(n)
+            return self._first_bundle_allocatable(spec, pg_cands)
+        res = sched.resources
+        feasible = [n for n in cands if n.feasible(res)]
+        if not feasible:
+            return None
+        allocatable = [n for n in feasible if n.can_allocate(res)]
+        if not allocatable:
+            return "retry"  # feasible but busy: keep queued
+        if sched.scheduling_strategy == "SPREAD":
+            allocatable.sort(key=lambda n: n.utilization())
+            k = next(self._rr) % len(allocatable)
+            low = [n for n in allocatable if abs(n.utilization() - allocatable[0].utilization()) < 1e-9]
+            return low[k % len(low)]
+        if sched.soft_node_id is not None:
+            for n in allocatable:
+                if n.node_id.hex() == sched.soft_node_id:
+                    return n
+        # hybrid: pack in node order until spread threshold, then least-utilized
+        for n in allocatable:
+            if n.utilization() < cfg.scheduler_spread_threshold:
+                return n
+        return min(allocatable, key=lambda n: n.utilization())
+
+    def _first_allocatable(self, spec, cands):
+        if not cands:
+            return None
+        for n in cands:
+            if spec.scheduling.placement_group is not None or n.can_allocate(spec.scheduling.resources):
+                return n
+        return "retry"
+
+    def _first_bundle_allocatable(self, spec, cands):
+        if not cands:
+            return None
+        sched = spec.scheduling
+        for n in cands:
+            bundles = n.pg_bundles.get(sched.placement_group, {})
+            idxs = [sched.bundle_index] if sched.bundle_index >= 0 else list(bundles)
+            for i in idxs:
+                avail = bundles.get(i, {})
+                if all(avail.get(k, 0) >= v - 1e-9 for k, v in sched.resources.items() if v > 0):
+                    return n
+        return "retry"
+
+
+class Scheduler:
+    """Dependency-gated ready queue + per-node dispatch.
+
+    States mirror the reference's lease queues (cluster_lease_manager.h):
+    waiting-for-deps -> ready -> (resources reserved) node dispatch queue ->
+    running on a worker.
+    """
+
+    def __init__(self, runtime):
+        self.rt = runtime
+        self.policy = SchedulingPolicy()
+        self._lock = threading.Condition()
+        self._waiting: dict = {}  # task_id -> (spec, set(pending obj ids))
+        self._dep_index: dict = {}  # obj_id -> set(task_id)
+        self._ready: list[TaskSpec] = []
+        self._infeasible_warned: set = set()
+        self._wake = threading.Event()
+        self._stopped = False
+
+    def stop(self):
+        self._stopped = True
+        self._wake.set()
+
+    def submit(self, spec: TaskSpec):
+        deps = set()
+        for a in spec.args:
+            if a.ref is not None and not self.rt.store.contains(a.ref):
+                deps.add(a.ref)
+        with self._lock:
+            if deps:
+                self._waiting[spec.task_id] = (spec, deps)
+                for d in deps:
+                    self._dep_index.setdefault(d, set()).add(spec.task_id)
+                # seal may have raced registration
+                resolved = [d for d in deps if self.rt.store.contains(d)]
+                for d in resolved:
+                    self._resolve_dep_locked(d)
+            else:
+                self._ready.append(spec)
+        self._wake.set()
+
+    def on_object_sealed(self, obj_id):
+        with self._lock:
+            self._resolve_dep_locked(obj_id)
+        self._wake.set()
+
+    def _resolve_dep_locked(self, obj_id):
+        for tid in self._dep_index.pop(obj_id, set()):
+            entry = self._waiting.get(tid)
+            if entry is None:
+                continue
+            spec, deps = entry
+            deps.discard(obj_id)
+            if not deps:
+                del self._waiting[tid]
+                self._ready.append(spec)
+
+    def remove_task(self, task_id) -> bool:
+        """Cancel support: pull a task out of the queues if still pending."""
+        with self._lock:
+            if task_id in self._waiting:
+                del self._waiting[task_id]
+                return True
+            for i, s in enumerate(self._ready):
+                if s.task_id == task_id:
+                    del self._ready[i]
+                    return True
+        return False
+
+    # ---- scheduling loop (runs on the runtime's scheduler thread) ----
+    def run_loop(self):
+        while not self._stopped:
+            self._wake.wait(timeout=0.1)
+            self._wake.clear()
+            if self._stopped:
+                return
+            try:
+                self._schedule_once()
+                self.rt.dispatch_all()
+            except Exception:
+                logger.exception("scheduler loop error")
+
+    def wake(self):
+        self._wake.set()
+
+    def _schedule_once(self):
+        with self._lock:
+            ready, self._ready = self._ready, []
+        requeue = []
+        for spec in ready:
+            node = self.policy.pick(spec, self.rt.node_list())
+            if node is None:
+                if spec.task_id not in self._infeasible_warned:
+                    if len(self._infeasible_warned) > 10_000:
+                        self._infeasible_warned.clear()
+                    self._infeasible_warned.add(spec.task_id)
+                    logger.warning(
+                        "task %s is infeasible on the current cluster (resources=%s); queued",
+                        spec.desc(),
+                        spec.scheduling.resources,
+                    )
+                requeue.append(spec)
+                continue
+            if node == "retry":
+                requeue.append(spec)
+                continue
+            if not self.rt.reserve_and_queue(node, spec):
+                requeue.append(spec)
+        if requeue:
+            with self._lock:
+                self._ready.extend(requeue)
+
+    def has_pending(self) -> bool:
+        with self._lock:
+            return bool(self._ready or self._waiting)

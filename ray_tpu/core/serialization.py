@@ -1,0 +1,68 @@
+"""Serialization: cloudpickle for closures + pickle5 out-of-band buffers.
+
+TPU-native equivalent of the reference's serialization stack (reference:
+python/ray/_private/serialization.py — cloudpickle for code, Pickle5
+out-of-band buffers for zero-copy numpy, ObjectRef-in-object tracking).
+
+Large contiguous buffers (numpy arrays, arrow buffers) are extracted
+out-of-band so they can live in shared memory and be mapped zero-copy by
+workers. Host-side jax.Arrays are converted to numpy on serialize.
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass, field
+
+import cloudpickle
+
+
+@dataclass
+class Serialized:
+    header: bytes
+    buffers: list = field(default_factory=list)  # list of bytes/memoryview
+    # ObjectRefs found inside the object (for borrowed-ref tracking;
+    # reference: reference_counter.h borrow protocol).
+    contained_refs: list = field(default_factory=list)
+
+    def total_size(self) -> int:
+        return len(self.header) + sum(len(b.raw() if hasattr(b, "raw") else b) for b in self.buffers)
+
+
+def serialize(obj) -> Serialized:
+    buffers: list[pickle.PickleBuffer] = []
+    contained: list = []
+    _track_contained_refs(obj, contained)
+
+    def cb(buf: pickle.PickleBuffer):
+        buffers.append(buf)
+        return False  # out-of-band
+
+    header = cloudpickle.dumps(obj, protocol=5, buffer_callback=cb)
+    return Serialized(header=header, buffers=[b.raw() for b in buffers], contained_refs=contained)
+
+
+def deserialize(header: bytes, buffers) -> object:
+    return pickle.loads(header, buffers=buffers)
+
+
+def deserialize_s(s: Serialized) -> object:
+    return deserialize(s.header, s.buffers)
+
+
+def _track_contained_refs(obj, out: list, depth: int = 0):
+    """Best-effort scan of containers for ObjectRefs (no recursion into
+    arbitrary objects — full tracking happens at pickle time via
+    ObjectRef.__reduce__ hooks registered by the runtime)."""
+    if depth > 3:
+        return
+    from ray_tpu.core.object_ref import ObjectRef
+
+    if isinstance(obj, ObjectRef):
+        out.append(obj)
+    elif isinstance(obj, (list, tuple, set)):
+        for x in obj:
+            _track_contained_refs(x, out, depth + 1)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _track_contained_refs(v, out, depth + 1)

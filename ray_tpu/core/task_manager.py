@@ -1,0 +1,195 @@
+"""Task lifecycle: retries, failure handling, lineage reconstruction.
+
+TPU-native equivalent of the reference's owner-side TaskManager (reference:
+src/ray/core_worker/task_manager.h:175 — retry budget + lineage
+re-execution) and ObjectRecoveryManager (object_recovery_manager.h:41).
+Ownership is centralized in the head process (a deliberate simplification of
+the reference's per-owner distributed refcounting; the interface keeps the
+same seams so ownership can be distributed later).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ray_tpu.core.ids import ObjectID, TaskID
+from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.exceptions import (
+    ObjectLostError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+logger = logging.getLogger(__name__)
+
+TERMINAL = ("FINISHED", "FAILED", "CANCELLED")
+
+
+class TaskState:
+    __slots__ = ("spec", "status", "attempts_done", "node_id", "worker_id", "cancelled", "submitted_at", "events")
+
+    def __init__(self, spec: TaskSpec):
+        self.spec = spec
+        self.status = "PENDING"
+        self.attempts_done = 0
+        self.node_id = None
+        self.worker_id = None
+        self.cancelled = False
+        self.submitted_at = time.time()
+        self.events: list[tuple[str, float]] = [("PENDING", self.submitted_at)]
+
+    def transition(self, status: str):
+        self.status = status
+        self.events.append((status, time.time()))
+
+
+class TaskManager:
+    def __init__(self, runtime):
+        self.rt = runtime
+        self._lock = threading.Lock()
+        self._tasks: dict[TaskID, TaskState] = {}
+        # lineage: object ids we may need to reconstruct keep their producing
+        # spec alive via _tasks (keyed by ObjectID.task_id()). Bounded: old
+        # terminal specs are pruned (reference: lineage eviction under
+        # max_lineage_bytes in reference_counter.h).
+        from collections import deque
+
+        self._order: deque = deque()
+
+    def register(self, spec: TaskSpec) -> TaskState:
+        st = TaskState(spec)
+        with self._lock:
+            self._tasks[spec.task_id] = st
+            self._order.append(spec.task_id)
+            self._prune_locked()
+        self.rt.gcs.events.record("task_submitted", task_id=spec.task_id.hex(), name=spec.name)
+        return st
+
+    def _prune_locked(self):
+        from ray_tpu._config import get_config
+        from ray_tpu.core.object_store import unlink_shm
+
+        cap = get_config().max_lineage_tasks
+        while len(self._order) > cap:
+            tid = self._order.popleft()
+            st = self._tasks.get(tid)
+            if st is None:
+                continue
+            if st.status not in TERMINAL:
+                self._order.append(tid)  # still live; retry later
+                if self._order[0] == tid:
+                    break  # everything is live
+                continue
+            del self._tasks[tid]
+            # reclaim anonymous shm segments backing by-value args
+            for a in st.spec.args:
+                if a.payload is not None and a.payload.shm is not None:
+                    unlink_shm(a.payload.shm.shm_name)
+            for a in getattr(st.spec, "_kwargs", {}).values():
+                if a.payload is not None and a.payload.shm is not None:
+                    unlink_shm(a.payload.shm.shm_name)
+
+    def get(self, task_id: TaskID) -> TaskState | None:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def mark_running(self, task_id, node_id, worker_id):
+        st = self.get(task_id)
+        if st:
+            st.node_id, st.worker_id = node_id, worker_id
+            st.transition("RUNNING")
+
+    def complete(self, task_id: TaskID):
+        st = self.get(task_id)
+        if st:
+            st.transition("FINISHED")
+
+    def handle_app_error(self, task_id: TaskID, err: TaskError) -> bool:
+        """Application-level exception. Returns True if the task will be
+        retried (retry_exceptions), else the error is final."""
+        st = self.get(task_id)
+        if st is None:
+            return False
+        spec = st.spec
+        retry_on = spec.retry_exceptions
+        should = False
+        if retry_on is True:
+            should = True
+        elif isinstance(retry_on, (list, tuple)) and err.cause is not None:
+            should = isinstance(err.cause, tuple(retry_on))
+        if should and st.attempts_done < spec.max_retries:
+            st.attempts_done += 1
+            st.transition("RETRYING")
+            logger.info("retrying %s after app error (attempt %d/%d)", spec.desc(), st.attempts_done, spec.max_retries)
+            self.rt.resubmit(spec)
+            return True
+        st.transition("FAILED")
+        return False
+
+    def handle_worker_crash(self, task_id: TaskID, reason: str) -> bool:
+        """System failure (worker died). Returns True if retried."""
+        st = self.get(task_id)
+        if st is None:
+            return False
+        spec = st.spec
+        if not st.cancelled and st.attempts_done < spec.max_retries:
+            st.attempts_done += 1
+            st.transition("RETRYING")
+            logger.info("retrying %s after worker crash (%s) attempt %d/%d", spec.desc(), reason, st.attempts_done, spec.max_retries)
+            self.rt.resubmit(spec)
+            return True
+        st.transition("FAILED")
+        err = WorkerCrashedError(f"task {spec.desc()}: worker died ({reason}); retries exhausted")
+        for oid in self._return_ids(spec):
+            self.rt.store.put_error(oid, err)
+        return False
+
+    def mark_cancelled(self, task_id: TaskID):
+        st = self.get(task_id)
+        if st:
+            st.cancelled = True
+            st.transition("CANCELLED")
+
+    def _return_ids(self, spec: TaskSpec):
+        if spec.streaming:
+            return [spec.generator_id()]
+        return spec.return_ids()
+
+    # ---- lineage reconstruction ----
+    def reconstruct(self, obj_id: ObjectID):
+        """Re-execute the producing task of an evicted object (reference:
+        object_recovery_manager.h:41 -> task resubmission via lineage)."""
+        if obj_id.is_put():
+            raise ObjectLostError(f"object {obj_id.hex()[:16]} was created by put() and has no lineage to reconstruct")
+        st = self.get(obj_id.task_id())
+        if st is None:
+            raise ObjectLostError(f"object {obj_id.hex()[:16]} lost and producing task unknown")
+        with self._lock:
+            if st.status == "RECONSTRUCTING":
+                return  # already in flight
+            st.transition("RECONSTRUCTING")
+        logger.info("reconstructing %s via lineage", st.spec.desc())
+        self.rt.resubmit(st.spec)
+
+    def states(self, limit: int = 10_000) -> list[dict]:
+        with self._lock:
+            out = []
+            for st in list(self._tasks.values())[-limit:]:
+                out.append(
+                    {
+                        "task_id": st.spec.task_id.hex(),
+                        "name": st.spec.name,
+                        "status": st.status,
+                        "attempts": st.attempts_done,
+                        "node_id": st.node_id.hex() if st.node_id else None,
+                        "submitted_at": st.submitted_at,
+                        "is_actor_task": st.spec.actor_id is not None,
+                    }
+                )
+            return out
+
+    def num_nonterminal(self) -> int:
+        with self._lock:
+            return sum(1 for st in self._tasks.values() if st.status not in TERMINAL)

@@ -1,0 +1,105 @@
+"""Task/actor specifications and argument payload encoding.
+
+Reference parity: src/ray/common/task/task_spec.h (TaskSpecification) and the
+arg-passing scheme of NormalTaskSubmitter (inline small values, plasma refs
+for large ones — core_worker.h:854, task_submission/normal_task_submitter.h:81).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu.core.ids import ActorID, ObjectID, PlacementGroupID, TaskID
+from ray_tpu.core.object_store import ShmDescriptor
+from ray_tpu.core.serialization import Serialized
+
+
+@dataclass
+class Payload:
+    """A serialized value in transit: inline bytes or an shm locator."""
+
+    inline: Serialized | None = None
+    shm: ShmDescriptor | None = None
+
+
+@dataclass
+class ArgSpec:
+    """One task argument: a payload (by value) or an object ref (by
+    reference, resolved by the scheduler before dispatch — or fetched by the
+    executing worker if nested)."""
+
+    payload: Payload | None = None
+    ref: ObjectID | None = None
+
+
+@dataclass
+class SchedulingOptions:
+    resources: dict[str, float] = field(default_factory=dict)
+    node_id: str | None = None  # hard node affinity
+    soft_node_id: str | None = None  # locality preference
+    placement_group: PlacementGroupID | None = None
+    bundle_index: int = -1
+    scheduling_strategy: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY
+    label_selector: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    name: str
+    func_id: str  # content hash of the pickled function
+    args: list[ArgSpec]
+    num_returns: int = 1
+    streaming: bool = False  # num_returns="streaming"
+    scheduling: SchedulingOptions = field(default_factory=SchedulingOptions)
+    max_retries: int = 0
+    retry_exceptions: bool | list | None = False
+    runtime_env: dict | None = None
+    # actor fields
+    actor_id: ActorID | None = None
+    is_actor_creation: bool = False
+    method_name: str | None = None
+    seq_no: int = -1
+    # actor creation fields
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    is_async_actor: bool = False
+    # bookkeeping
+    attempt: int = 0
+    submitter: str = "driver"
+
+    def return_ids(self) -> list[ObjectID]:
+        return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+
+    def generator_id(self) -> ObjectID:
+        return ObjectID.for_task_return(self.task_id, 0)
+
+    def desc(self) -> str:
+        return f"{self.name}[{self.task_id.hex()[:8]}]"
+
+
+@dataclass
+class ActorInfo:
+    """Control-plane record of a live actor (reference:
+    gcs/gcs_actor_manager.h:93 actor registry + restart state machine)."""
+
+    actor_id: ActorID
+    name: str | None
+    namespace: str = "default"
+    class_id: str = ""
+    state: str = "PENDING"  # PENDING/ALIVE/RESTARTING/DEAD
+    node_id: Any = None
+    worker_id: Any = None
+    num_restarts: int = 0
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    is_async: bool = False
+    creation_spec: TaskSpec | None = None
+    death_cause: str = ""
+    resources: dict = field(default_factory=dict)
+    placement_group: PlacementGroupID | None = None
+    bundle_index: int = -1
+    detached: bool = False

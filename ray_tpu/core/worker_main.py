@@ -1,0 +1,401 @@
+"""Worker process: task execution loop + client RPC back to the node.
+
+TPU-native equivalent of the reference's worker stack: the execution side of
+core_worker (task_execution/task_receiver.h:44, actor scheduling queues incl.
+async-actor fibers in task_execution/fiber.h) plus the Cython
+``execute_task`` path (python/ray/_raylet.pyx:1557,2131).
+
+One duplex pipe connects the worker to its node manager. Inbound messages are
+either task executions or responses to this worker's own client calls
+(get/put/submit/...). Execution runs on a thread pool sized by the actor's
+``max_concurrency`` (default 1 => strictly ordered, matching the reference's
+sequential actor submit queue); ``async`` actors run coroutines on a
+dedicated event loop thread.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import os
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+
+from ray_tpu.core import context
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
+from ray_tpu.core.payloads import decode_payload, encode_value
+from ray_tpu.core.serialization import deserialize_s
+from ray_tpu.exceptions import ActorDiedError, TaskError
+
+
+class WorkerClient:
+    """CoreClient implementation for worker processes: every control-plane
+    operation is an RPC over the pipe to the node manager."""
+
+    def __init__(self, conn, worker_id: str, node_id: str):
+        self.conn = conn
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.job_id = None
+        self._send_lock = threading.Lock()
+        self._req_lock = threading.Lock()
+        self._req_seq = 0
+        self._pending: dict[int, list] = {}  # req_id -> [event, ok, payload]
+        self.current_task_id = None
+        self.current_actor_id = None
+        self.assigned_resources = {}
+        self._shutdown = False
+        # execution machinery (created lazily / per actor)
+        self._exec_pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="rt-exec")
+        self._actor_instance = None
+        self._actor_loop = None  # asyncio loop thread for async actors
+        self._func_cache: dict[str, object] = {}
+        self._sent_funcs: set[str] = set()
+        # shm mappings whose close was deferred because user code still
+        # holds zero-copy views into them
+        self._deferred_segs: list = []
+
+    # ---------------- transport ----------------
+    def _send(self, msg: dict):
+        with self._send_lock:
+            self.conn.send(msg)
+
+    def call(self, method: str, timeout: float | None = None, **params):
+        with self._req_lock:
+            self._req_seq += 1
+            req_id = self._req_seq
+            slot = [threading.Event(), False, None]
+            self._pending[req_id] = slot
+        self._send({"type": "req", "req_id": req_id, "method": method, "params": params})
+        if not slot[0].wait(timeout=timeout):
+            with self._req_lock:
+                self._pending.pop(req_id, None)
+            raise TimeoutError(f"worker RPC {method} timed out")
+        if not slot[1]:
+            raise slot[2]
+        return slot[2]
+
+    def _handle_resp(self, msg):
+        with self._req_lock:
+            slot = self._pending.pop(msg["req_id"], None)
+        if slot is None:
+            return
+        slot[1] = msg["ok"]
+        slot[2] = msg["payload"] if msg["ok"] else msg["error"]
+        slot[0].set()
+
+    # ---------------- CoreClient API ----------------
+    def get_object(self, obj_id: ObjectID, timeout: float | None = None):
+        for attempt in range(3):
+            payload = self.call("get_object", obj_id=obj_id, timeout_s=timeout, timeout=None)
+            try:
+                value, seg = decode_payload(payload, zero_copy=False)
+            except FileNotFoundError:
+                # shm backing raced an eviction; tell the owner and retry
+                # (lineage reconstruction will re-produce it)
+                self.call("mark_object_lost", obj_id=obj_id)
+                continue
+            if isinstance(value, BaseException):
+                raise value
+            return value
+        raise FileNotFoundError(f"object {obj_id.hex()[:16]} backing store repeatedly lost")
+
+    def put_object(self, value) -> ObjectRef:
+        obj_id = ObjectID.from_put()
+        payload = encode_value(value, obj_id=obj_id)
+        self.call("put_object", obj_id=obj_id, payload=payload)
+        return ObjectRef(obj_id)
+
+    def wait_ready(self, obj_ids, num_returns=1, timeout=None, fetch_local=True):
+        return self.call("wait_ready", obj_ids=list(obj_ids), num_returns=num_returns, timeout_s=timeout, timeout=None)
+
+    def add_done_callback(self, obj_id, cb):
+        # Poll-free callback support for workers: run a waiter thread.
+        def _wait():
+            try:
+                v = self.get_object(obj_id)
+                cb(v, None)
+            except BaseException as e:  # noqa: BLE001
+                cb(None, e)
+
+        threading.Thread(target=_wait, daemon=True).start()
+
+    def submit_task(self, **payload):
+        return self.call("submit_task", **payload)
+
+    def create_actor(self, **payload):
+        return self.call("create_actor", **payload)
+
+    def submit_actor_task(self, **payload):
+        return self.call("submit_actor_task", **payload)
+
+    def kill_actor(self, actor_id, no_restart=True):
+        return self.call("kill_actor", actor_id=actor_id, no_restart=no_restart)
+
+    def cancel_task(self, obj_id, force=False):
+        return self.call("cancel_task", obj_id=obj_id, force=force)
+
+    def get_actor_handle_info(self, name, namespace="default"):
+        return self.call("get_actor_handle_info", name=name, namespace=namespace)
+
+    def next_generator_item(self, gen_id, index, timeout=None):
+        oid = self.call("next_generator_item", gen_id=gen_id, index=index, timeout=None)
+        return ObjectRef(oid) if oid is not None else None
+
+    def free_objects(self, obj_ids):
+        try:
+            self.call("free_objects", obj_ids=list(obj_ids))
+        except Exception:
+            pass
+
+    def cluster_info(self, kind: str):
+        return self.call("cluster_info", kind=kind)
+
+    def kv(self, op: str, **kw):
+        return self.call("kv", op=op, **kw)
+
+    def pg(self, op: str, **kw):
+        return self.call("pg", op=op, **kw)
+
+    def has_function(self, func_id: str) -> bool:
+        return func_id in self._sent_funcs
+
+    def mark_function_sent(self, func_id: str):
+        self._sent_funcs.add(func_id)
+
+    def get_function(self, func_id: str):
+        if func_id not in self._func_cache:
+            blob = self.call("get_function", func_id=func_id)
+            self._func_cache[func_id] = deserialize_s(blob)
+        return self._func_cache[func_id]
+
+    # ---------------- execution ----------------
+    def _apply_env(self, env: dict | None):
+        if env:
+            os.environ.update({k: str(v) for k, v in env.items()})
+
+    def _decode_args(self, arg_specs, kwarg_specs):
+        args, kwargs, segs = [], {}, []
+
+        def one(a):
+            if a.ref is not None:
+                return self.get_object(a.ref)
+            v, seg = decode_payload(a.payload, zero_copy=True)
+            if seg is not None:
+                segs.append(seg)
+            return v
+
+        for a in arg_specs:
+            args.append(one(a))
+        for k, a in (kwarg_specs or {}).items():
+            kwargs[k] = one(a)
+        return args, kwargs, segs
+
+    def _encode_returns(self, spec, value):
+        """Return list of (obj_id, payload)."""
+        out = []
+        ids = spec_return_ids(spec)
+        if spec.num_returns == 1:
+            values = [value]
+        else:
+            values = list(value)
+            if len(values) != spec.num_returns:
+                raise ValueError(f"task {spec.name} returned {len(values)} values, expected {spec.num_returns}")
+        for oid, v in zip(ids, values):
+            out.append((oid, encode_value(v, obj_id=oid)))
+        return out
+
+    def _execute(self, msg):
+        spec = msg["spec"]
+        self.current_task_id = spec.task_id
+        self.assigned_resources = msg.get("resources", {})
+        self._apply_env(msg.get("env"))
+        try:
+            if spec.is_actor_creation:
+                self._create_actor_instance(spec, msg)
+                self._send({"type": "done", "task_id": spec.task_id, "returns": [], "error": None})
+                return
+            if spec.actor_id is not None:
+                fn = self._actor_method(spec.method_name)
+            else:
+                fn = self.get_function(spec.func_id)
+            args, kwargs, segs = self._decode_args(msg["args"], msg.get("kwargs"))
+            try:
+                result = fn(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    if spec.streaming:
+                        result = self._run_on_actor_loop(result)
+                    else:
+                        # async actor: complete without blocking the exec slot
+                        self._complete_async(spec, result)
+                        return
+                if spec.streaming:
+                    self._stream_generator(spec, result)
+                    return
+                if inspect.isgenerator(result):
+                    result = list(result)
+                returns = self._encode_returns(spec, result)
+            finally:
+                self._release_segments(segs)
+                del args, kwargs
+            self._send({"type": "done", "task_id": spec.task_id, "returns": returns, "error": None})
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, TaskError) else TaskError.from_exception(e, task_desc=spec.desc())
+            try:
+                self._send({"type": "done", "task_id": spec.task_id, "returns": [], "error": err})
+            except Exception:
+                traceback.print_exc()
+                try:
+                    fallback = TaskError(cause=None, tb_str=err.tb_str, task_desc=spec.desc())
+                    self._send({"type": "done", "task_id": spec.task_id, "returns": [], "error": fallback})
+                except Exception:
+                    pass
+        finally:
+            self.current_task_id = None
+
+    def _release_segments(self, segs):
+        """Close shm mappings; views still referenced by user code defer the
+        close (retried after later tasks)."""
+        pending = self._deferred_segs + list(segs or [])
+        self._deferred_segs = []
+        import gc
+
+        for seg in pending:
+            try:
+                seg.close()
+            except BufferError:
+                self._deferred_segs.append(seg)
+        if len(self._deferred_segs) > 64:
+            gc.collect()
+            still = []
+            for seg in self._deferred_segs:
+                try:
+                    seg.close()
+                except BufferError:
+                    still.append(seg)
+            self._deferred_segs = still
+
+    def _complete_async(self, spec, coro):
+        """Run an async actor method on the actor event loop; send the done
+        message from the loop's completion callback (reference: async-actor
+        fibers, task_execution/fiber.h)."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self._get_actor_loop())
+
+        def _cb(f):
+            try:
+                returns = self._encode_returns(spec, f.result())
+                self._send({"type": "done", "task_id": spec.task_id, "returns": returns, "error": None})
+            except BaseException as e:  # noqa: BLE001
+                err = TaskError.from_exception(e, task_desc=spec.desc())
+                try:
+                    self._send({"type": "done", "task_id": spec.task_id, "returns": [], "error": err})
+                except Exception:
+                    pass
+
+        fut.add_done_callback(_cb)
+
+    def _stream_generator(self, spec, gen):
+        index = 0
+        try:
+            if inspect.isasyncgen(gen):
+                gen = _drain_async_gen(self._get_actor_loop(), gen)
+            for item in gen:
+                oid = ObjectID.for_task_return(spec.task_id, index + 1)
+                payload = encode_value(item, obj_id=oid)
+                self._send({"type": "stream_item", "task_id": spec.task_id, "index": index, "obj_id": oid, "payload": payload})
+                index += 1
+            self._send({"type": "done", "task_id": spec.task_id, "returns": [], "error": None, "stream_count": index})
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError.from_exception(e, task_desc=spec.desc())
+            self._send({"type": "done", "task_id": spec.task_id, "returns": [], "error": err, "stream_count": index})
+
+    # -- actors --
+    def _create_actor_instance(self, spec, msg):
+        cls = self.get_function(spec.func_id)
+        args, kwargs, _ = self._decode_args(msg["args"], msg.get("kwargs"))
+        self.current_actor_id = spec.actor_id
+        if spec.max_concurrency > 1:
+            self._exec_pool = ThreadPoolExecutor(max_workers=spec.max_concurrency, thread_name_prefix="rt-actor")
+        self._actor_instance = cls(*args, **kwargs)
+
+    def _actor_method(self, name):
+        if self._actor_instance is None:
+            raise ActorDiedError(reason="actor instance not created")
+        if name == "__ray_terminate__":
+            return self._terminate_actor
+        if name == "__ray_ready__":
+            return lambda: True
+        fn = getattr(self._actor_instance, name, None)
+        if fn is None:
+            raise AttributeError(f"actor has no method {name!r}")
+        return fn
+
+    def _terminate_actor(self):
+        self._shutdown = True
+        return True
+
+    def _get_actor_loop(self):
+        if self._actor_loop is None:
+            loop = asyncio.new_event_loop()
+            t = threading.Thread(target=loop.run_forever, daemon=True, name="rt-actor-loop")
+            t.start()
+            self._actor_loop = loop
+        return self._actor_loop
+
+    def _run_on_actor_loop(self, coro):
+        fut = asyncio.run_coroutine_threadsafe(coro, self._get_actor_loop())
+        return fut.result()
+
+    # ---------------- main loop ----------------
+    def run(self):
+        self._send({"type": "ready", "worker_id": self.worker_id, "pid": os.getpid()})
+        while not self._shutdown:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                break
+            t = msg["type"]
+            if t == "resp":
+                self._handle_resp(msg)
+            elif t == "exec":
+                self._exec_pool.submit(self._execute, msg)
+            elif t == "exec_inline":
+                # ordered lane used for actor creation (must precede methods)
+                self._execute(msg)
+            elif t == "shutdown":
+                break
+            elif t == "ping":
+                self._send({"type": "pong"})
+        try:
+            self._exec_pool.shutdown(wait=False, cancel_futures=True)
+        except Exception:
+            pass
+        os._exit(0)
+
+
+def _drain_async_gen(loop, agen):
+    """Convert an async generator to a sync iterator via the actor loop."""
+
+    while True:
+        fut = asyncio.run_coroutine_threadsafe(agen.__anext__(), loop)
+        try:
+            yield fut.result()
+        except StopAsyncIteration:
+            return
+
+
+def spec_return_ids(spec):
+    return [ObjectID.for_task_return(spec.task_id, i) for i in range(spec.num_returns)]
+
+
+def worker_entry(conn, worker_id: str, node_id: str, env: dict | None = None):
+    """Process entry point (multiprocessing target)."""
+    if env:
+        os.environ.update(env)
+    # Workers must not inherit a driver-side TPU lock; JAX is imported lazily
+    # by user code (reference warns likewise: train/v2/jax/jax_trainer.py:88).
+    client = WorkerClient(conn, worker_id, node_id)
+    context.set_client(client)
+    client.run()

@@ -1,0 +1,134 @@
+"""DAG API: lazily-bound task/actor graphs.
+
+Reference parity: python/ray/dag/ (DAGNode, FunctionNode, ClassNode,
+ClassMethodNode; compiled execution in compiled_dag_node.py). This module
+provides the lazy .bind()/.execute() graph; compiled-graph channel execution
+for accelerator pipelines lives in ray_tpu.parallel.pipeline (the TPU-native
+equivalent of NCCL-channel compiled graphs).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.core.object_ref import ObjectRef
+
+
+class DAGNode:
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    def _resolve(self, v, memo: dict):
+        if isinstance(v, DAGNode):
+            return v._execute_memo(memo)
+        if isinstance(v, (list, tuple)):
+            return type(v)(self._resolve(x, memo) for x in v)
+        if isinstance(v, dict):
+            return {k: self._resolve(x, memo) for k, x in v.items()}
+        return v
+
+    def _resolved_args(self, memo: dict):
+        args = tuple(self._resolve(a, memo) for a in self._bound_args)
+        kwargs = {k: self._resolve(v, memo) for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _execute_memo(self, memo: dict):
+        if id(self) not in memo:
+            memo[id(self)] = self._execute_impl(memo)
+        return memo[id(self)]
+
+    def execute(self, *input_args):
+        """Run the DAG; InputNode placeholders are filled positionally."""
+        memo = {"__inputs__": input_args}
+        return self._execute_memo(memo)
+
+    def _execute_impl(self, memo):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class InputNode(DAGNode):
+    """Placeholder for execute()-time arguments (reference:
+    python/ray/dag/input_node.py)."""
+
+    _counter = 0
+
+    def __init__(self, index: int | None = None):
+        super().__init__((), {})
+        if index is None:
+            index = InputNode._counter
+            InputNode._counter += 1
+        self.index = index
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        InputNode._counter = 0
+        return False
+
+    def _execute_impl(self, memo):
+        return memo["__inputs__"][self.index]
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_impl(self, memo) -> ObjectRef:
+        args, kwargs = self._resolved_args(memo)
+        return self._remote_fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    def __init__(self, actor_cls, args, kwargs):
+        super().__init__(args, kwargs)
+        self._actor_cls = actor_cls
+        self._handle = None
+
+    def _execute_impl(self, memo):
+        if self._handle is None:
+            args, kwargs = self._resolved_args(memo)
+            self._handle = self._actor_cls.remote(*args, **kwargs)
+        return self._handle
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClassMethodBinder(self, name)
+
+
+class _ClassMethodBinder:
+    def __init__(self, class_node: ClassNode, method: str):
+        self._class_node = class_node
+        self._method = method
+
+    def bind(self, *args, **kwargs):
+        return ClassMethodNode(self._class_node, self._method, args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method: str, args, kwargs):
+        super().__init__(args, kwargs)
+        self._class_node = class_node
+        self._method = method
+
+    def _execute_impl(self, memo) -> ObjectRef:
+        handle = self._class_node._execute_memo(memo)
+        args, kwargs = self._resolved_args(memo)
+        return getattr(handle, self._method).remote(*args, **kwargs)
+
+
+class ActorMethodNode(DAGNode):
+    """bind() on an already-created actor handle's method."""
+
+    def __init__(self, handle, method: str, args, kwargs):
+        super().__init__(args, kwargs)
+        self._handle = handle
+        self._method = method
+
+    def _execute_impl(self, memo) -> ObjectRef:
+        args, kwargs = self._resolved_args(memo)
+        return getattr(self._handle, self._method).remote(*args, **kwargs)
+
+
+MultiOutputNode = list  # reference API alias: wraps several leaf nodes

@@ -1,0 +1,89 @@
+"""Exception types (reference: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at ray_tpu.get().
+
+    Reference semantics: RayTaskError wraps the user exception with the
+    remote traceback (python/ray/exceptions.py).
+    """
+
+    def __init__(self, cause: BaseException | None = None, tb_str: str = "", task_desc: str = ""):
+        self.cause = cause
+        self.tb_str = tb_str
+        self.task_desc = task_desc
+        super().__init__(f"task {task_desc} failed:\n{tb_str}")
+
+    @classmethod
+    def from_exception(cls, e: BaseException, task_desc: str = ""):
+        return cls(cause=e, tb_str="".join(traceback.format_exception(type(e), e, e.__traceback__)), task_desc=task_desc)
+
+    def __reduce__(self):
+        import pickle
+
+        cause = self.cause
+        if cause is not None:
+            try:
+                pickle.dumps(cause)
+            except Exception:
+                cause = None  # unpicklable user exception: keep the traceback string only
+        return (_rebuild_task_error, (cause, self.tb_str, self.task_desc))
+
+
+def _rebuild_task_error(cause, tb_str, task_desc):
+    return TaskError(cause=cause, tb_str=tb_str, task_desc=task_desc)
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorDiedError(RayTpuError):
+    def __init__(self, actor_id=None, reason: str = ""):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"actor {actor_id} died: {reason}")
+
+
+class ActorUnavailableError(RayTpuError):
+    """Actor temporarily unreachable (restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object was evicted/lost and could not be reconstructed from lineage."""
+
+
+class ObjectReconstructionError(ObjectLostError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PlacementGroupUnschedulableError(RayTpuError):
+    pass
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass
+
+
+class OutOfMemoryError(RayTpuError):
+    pass

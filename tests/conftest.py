@@ -1,0 +1,49 @@
+"""Test fixtures (reference pattern: python/ray/tests/conftest.py —
+ray_start_regular :596, _ray_start contextmanager :543).
+
+JAX-dependent tests run on a virtual 8-device CPU mesh: the env vars below
+must be set before any test imports jax (the reference's fake-backend
+strategy for testing multi-host GSPMD without TPUs; see SURVEY.md §4).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
+)
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rt_start():
+    """Fresh single-node runtime per test."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def rt_start_2cpu():
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def rt_local():
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, local_mode=True)
+    yield ray_tpu
+    ray_tpu.shutdown()

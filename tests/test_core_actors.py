@@ -1,0 +1,206 @@
+"""Actor tests (reference pattern: python/ray/tests/test_actor.py,
+test_actor_failures.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, TaskError
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, by=1):
+        self.n += by
+        return self.n
+
+    def read(self):
+        return self.n
+
+
+def test_actor_basic(rt_start):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    assert ray_tpu.get(c.incr.remote(5)) == 6
+    assert ray_tpu.get(c.read.remote()) == 6
+
+
+def test_actor_init_args(rt_start):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.read.remote()) == 100
+
+
+def test_actor_ordering(rt_start):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(50)]
+    assert ray_tpu.get(refs[-1]) == 50
+    assert ray_tpu.get(refs) == list(range(1, 51))
+
+
+def test_two_actors_isolated(rt_start):
+    a, b = Counter.remote(), Counter.remote()
+    ray_tpu.get([a.incr.remote(), a.incr.remote(), b.incr.remote()])
+    assert ray_tpu.get(a.read.remote()) == 2
+    assert ray_tpu.get(b.read.remote()) == 1
+
+
+def test_actor_method_error(rt_start):
+    @ray_tpu.remote
+    class Bad:
+        def boom(self):
+            raise RuntimeError("actor method failed")
+
+        def ok(self):
+            return "fine"
+
+    b = Bad.remote()
+    with pytest.raises(TaskError):
+        ray_tpu.get(b.boom.remote())
+    # actor survives method errors
+    assert ray_tpu.get(b.ok.remote()) == "fine"
+
+
+def test_actor_creation_error(rt_start):
+    @ray_tpu.remote
+    class FailsInit:
+        def __init__(self):
+            raise ValueError("init failed")
+
+        def m(self):
+            return 1
+
+    a = FailsInit.remote()
+    with pytest.raises((TaskError, ActorDiedError)):
+        ray_tpu.get(a.m.remote(), timeout=10)
+
+
+def test_named_actor(rt_start):
+    c = Counter.options(name="global_counter").remote(7)
+    ray_tpu.get(c.read.remote())  # ensure alive
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.read.remote()) == 7
+
+
+def test_kill_actor(rt_start):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    ray_tpu.kill(c)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(c.incr.remote(), timeout=10)
+
+
+def test_actor_restart(rt_start):
+    import os
+
+    @ray_tpu.remote(max_restarts=2)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def die(self):
+            os._exit(1)
+
+        def pid(self):
+            return os.getpid()
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.incr.remote()) == 1
+    pid1 = ray_tpu.get(p.pid.remote())
+    try:
+        ray_tpu.get(p.die.remote(), timeout=5)
+    except Exception:
+        pass
+    # restarted: state reset, new pid
+    deadline = time.time() + 30
+    while True:
+        try:
+            assert ray_tpu.get(p.incr.remote(), timeout=10) == 1
+            break
+        except ActorDiedError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+    assert ray_tpu.get(p.pid.remote()) != pid1
+
+
+def test_actor_handle_passed_to_task(rt_start):
+    @ray_tpu.remote
+    def use_counter(c):
+        return ray_tpu.get(c.incr.remote(10))
+
+    c = Counter.remote()
+    assert ray_tpu.get(use_counter.remote(c)) == 10
+    assert ray_tpu.get(c.read.remote()) == 10
+
+
+def test_actor_to_actor_calls(rt_start):
+    @ray_tpu.remote
+    class Front:
+        def __init__(self, backend):
+            self.backend = backend
+
+        def go(self):
+            return ray_tpu.get(self.backend.incr.remote()) + 100
+
+    c = Counter.remote()
+    f = Front.remote(c)
+    assert ray_tpu.get(f.go.remote()) == 101
+
+
+def test_async_actor(rt_start):
+    import asyncio
+
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, t, v):
+            await asyncio.sleep(t)
+            return v
+
+    a = AsyncWorker.remote()
+    t0 = time.time()
+    refs = [a.work.remote(0.5, i) for i in range(4)]
+    assert ray_tpu.get(refs) == [0, 1, 2, 3]
+    # concurrent: 4 x 0.5s sleeps should overlap
+    assert time.time() - t0 < 1.8
+
+
+def test_threaded_actor_concurrency(rt_start):
+    @ray_tpu.remote(max_concurrency=4)
+    class Slow:
+        def work(self, t):
+            time.sleep(t)
+            return t
+
+    s = Slow.remote()
+    t0 = time.time()
+    ray_tpu.get([s.work.remote(0.5) for _ in range(4)])
+    assert time.time() - t0 < 1.8
+
+
+def test_actor_streaming_method(rt_start):
+    @ray_tpu.remote
+    class Gen:
+        def stream(self, n):
+            for i in range(n):
+                yield i
+
+    g = Gen.remote()
+    out = [ray_tpu.get(r) for r in g.stream.options(num_returns="streaming").remote(4)]
+    assert out == [0, 1, 2, 3]
+
+
+def test_get_actor_after_death_fails(rt_start):
+    c = Counter.options(name="dies").remote()
+    ray_tpu.get(c.read.remote())
+    ray_tpu.kill(c)
+    time.sleep(0.5)
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("dies")

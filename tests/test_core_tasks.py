@@ -1,0 +1,188 @@
+"""Core task API tests (reference pattern: python/ray/tests/test_basic.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, TaskError, WorkerCrashedError
+
+
+def test_put_get(rt_start):
+    ref = ray_tpu.put({"a": 1, "b": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_large_numpy(rt_start):
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(rt_start):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_arg(rt_start):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    r1 = double.remote(10)
+    r2 = double.remote(r1)
+    assert ray_tpu.get(r2) == 40
+
+
+def test_task_kwargs(rt_start):
+    @ray_tpu.remote
+    def f(a, b=1, c=2):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1, c=10)) == 12
+
+
+def test_task_large_arg_and_return(rt_start):
+    @ray_tpu.remote
+    def mean_and_copy(x):
+        return float(np.mean(x)), x * 2
+
+    arr = np.ones((512, 1024), dtype=np.float32)
+    m, doubled = ray_tpu.get(mean_and_copy.remote(arr))
+    assert m == 1.0
+    assert doubled.sum() == 2 * arr.size
+
+
+def test_multiple_returns(rt_start):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(rt_start):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(TaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert "kaboom" in str(ei.value)
+    assert isinstance(ei.value.cause, ValueError)
+
+
+def test_error_through_dependency(rt_start):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("dep failed")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(TaskError):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_wait(rt_start):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.01)
+    slow = sleepy.remote(5.0)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=3.0)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_get_timeout(rt_start):
+    @ray_tpu.remote
+    def forever():
+        time.sleep(60)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(forever.remote(), timeout=0.2)
+
+
+def test_nested_tasks(rt_start):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(1)) == 12
+
+
+def test_nested_object_ref_in_list(rt_start):
+    @ray_tpu.remote
+    def consume(refs):
+        return sum(ray_tpu.get(r) for r in refs)
+
+    refs = [ray_tpu.put(i) for i in range(5)]
+    assert ray_tpu.get(consume.remote(refs)) == 10
+
+
+def test_max_retries_worker_crash(rt_start):
+    import os
+
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    with pytest.raises(WorkerCrashedError):
+        ray_tpu.get(die.remote())
+
+
+def test_retry_exceptions(rt_start):
+    import os
+    import tempfile
+
+    path = tempfile.mktemp()
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky2():
+        if not os.path.exists(path):
+            open(path, "w").write("1")
+            raise RuntimeError("first attempt fails")
+        return "ok"
+
+    assert ray_tpu.get(flaky2.remote()) == "ok"
+
+
+def test_streaming_generator(rt_start):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    out = [ray_tpu.get(ref) for ref in gen.remote(5)]
+    assert out == [0, 1, 4, 9, 16]
+
+
+def test_many_small_tasks(rt_start):
+    @ray_tpu.remote
+    def sq(i):
+        return i * i
+
+    refs = [sq.remote(i) for i in range(100)]
+    assert ray_tpu.get(refs) == [i * i for i in range(100)]
+
+
+def test_local_mode(rt_local):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(2, 3)) == 5
