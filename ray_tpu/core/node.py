@@ -76,8 +76,12 @@ class WorkerHandle:
     proc: object
     conn: object  # driver-side end of the duplex pipe
     node_id: NodeID
-    state: str = "starting"  # starting | idle | busy | actor | dead
+    state: str = "starting"  # starting | idle | busy | actor | retiring | dead
     actor_id: object = None
+    # fresh = has never executed user code; TPU tasks require a fresh worker
+    # (chip-isolation env must precede any possible jax import)
+    fresh: bool = True
+    retired_chips: object = None
     running_tasks: dict = field(default_factory=dict)  # task_id -> spec
     env_binding: dict = field(default_factory=dict)  # sticky env (TPU chips)
     last_idle: float = field(default_factory=time.monotonic)

@@ -123,13 +123,20 @@ class Runtime:
         # simultaneously blocked workers to avoid starving put/submit RPCs.
         self._req_pool = ThreadPoolExecutor(max_workers=256, thread_name_prefix="rt-req")
 
+        from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
         base_res = dict(resources or {})
         base_res.setdefault("CPU", float(os.cpu_count() or 4))
         base_res.setdefault("memory", float(2**33))
-        base_res.setdefault("TPU", float(_detect_tpu_chips()))
+        base_res.setdefault("TPU", float(TPUAcceleratorManager.get_current_node_num_accelerators()))
         if base_res.get("TPU", 0) <= 0:
             base_res.pop("TPU", None)
-        head = Node(None, base_res, labels={"ray_tpu.io/node-type": "head", **(labels or {})})
+        # slice gang-scheduling resources + labels when running on a TPU VM
+        # (reference: tpu.py:576-672)
+        for k, v in TPUAcceleratorManager.get_current_node_additional_resources().items():
+            base_res.setdefault(k, v)
+        node_labels = {"ray_tpu.io/node-type": "head", **TPUAcceleratorManager.get_current_node_labels(), **(labels or {})}
+        head = Node(None, base_res, labels=node_labels)
         self.head_node = head
         self.node_id = head.node_id
         self.nodes[head.node_id] = head
@@ -664,22 +671,28 @@ class Runtime:
 
     def _dispatch_node(self, node: Node):
         while node.dispatch_queue:
+            spec, alloc, chips = node.dispatch_queue[0]
             idle = [w for w in node.idle_workers() if not w.env_binding]
+            if chips:
+                # chip-isolation env must be set before the worker can ever
+                # import jax: only never-used workers qualify
+                idle = [w for w in idle if w.fresh]
             if not idle:
                 starting = sum(1 for w in node.workers.values() if w.state == "starting")
                 nonactor = sum(1 for w in node.workers.values() if w.state in ("starting", "idle", "busy"))
                 limit = int(node.total_resources.get("CPU", 1)) + self._worker_count_limit_extra
-                if nonactor < limit and starting < len(node.dispatch_queue):
+                if (nonactor < limit or chips) and starting < len(node.dispatch_queue):
                     node.start_worker()
                 return
-            spec, alloc, chips = node.dispatch_queue.pop(0)
-            worker = idle[0]
-            self._dispatch_to_worker(node, worker, spec, alloc, chips)
+            node.dispatch_queue.pop(0)
+            self._dispatch_to_worker(node, idle[0], spec, alloc, chips)
 
     def _dispatch_to_worker(self, node: Node, worker: WorkerHandle, spec: TaskSpec, alloc, chips):
         env = {}
         if chips:
-            env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in chips)
+            from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+            env.update(TPUAcceleratorManager.worker_env_for_chips(chips))
             worker.env_binding = {"TPU_VISIBLE_CHIPS": env["TPU_VISIBLE_CHIPS"]}
         if spec.runtime_env and spec.runtime_env.get("env_vars"):
             env.update(spec.runtime_env["env_vars"])
@@ -700,6 +713,7 @@ class Runtime:
                 astate.allocation = (node, alloc, chips)
         else:
             worker.state = "busy"
+        worker.fresh = False
         worker.running_tasks[spec.task_id] = (spec, (node, alloc, chips))
         self.task_manager.mark_running(spec.task_id, node.node_id, worker.worker_id)
         try:
@@ -828,21 +842,22 @@ class Runtime:
         spec, allocation = entry
         if allocation is not None and not spec.is_actor_creation:
             anode, alloc, chips = allocation
-            self._release_alloc(anode, alloc, chips)
-            if w.state == "busy":
-                if w.env_binding:
-                    # TPU-bound workers are single-use: the chip binding is
-                    # baked into the process (jax backend init); retire it so
-                    # the chips go to a fresh worker (reference: worker_pool
-                    # kills workers with exclusive accelerator envs).
-                    w.state = "dead"
-                    node.remove_worker(w.worker_id)
-                    try:
-                        w.send({"type": "shutdown"})
-                        w.conn.close()
-                    except Exception:
-                        pass
-                else:
+            if w.state == "busy" and w.env_binding:
+                # TPU-bound workers are single-use: the chip binding is baked
+                # into the process (jax backend init). Release CPU-side
+                # resources now but hold the chips until the process has
+                # actually exited — a fresh worker must not bind chips the
+                # dying libtpu still holds.
+                self._release_alloc(anode, alloc, [])
+                w.retired_chips = (anode, chips)
+                w.state = "retiring"
+                try:
+                    w.send({"type": "shutdown"})
+                except Exception:
+                    self._finish_retirement(node, w)
+            else:
+                self._release_alloc(anode, alloc, chips)
+                if w.state == "busy":
                     w.state = "idle"
                     w.last_idle = time.monotonic()
         err = msg.get("error")
@@ -915,9 +930,27 @@ class Runtime:
             gen.items.append(obj_id)
             self._gen_cond.notify_all()
 
+    def _finish_retirement(self, node: Node, w: WorkerHandle):
+        """The retired TPU worker's process is gone: chips are safe to reuse."""
+        retired = getattr(w, "retired_chips", None)
+        if retired is not None:
+            anode, chips = retired
+            w.retired_chips = None
+            anode.return_tpu_chips(chips)
+        w.state = "dead"
+        node.remove_worker(w.worker_id)
+        try:
+            w.conn.close()
+        except Exception:
+            pass
+        self.scheduler.wake()
+
     # ---- worker death / actor restart ----
     def _on_worker_death(self, node: Node, w: WorkerHandle, reason: str):
         if w.state == "dead" or self._stopped:
+            return
+        if w.state == "retiring":
+            self._finish_retirement(node, w)
             return
         was_actor = w.state == "actor"
         w.state = "dead"
@@ -1278,6 +1311,11 @@ def _sched_options(opts: dict, is_actor: bool = False) -> SchedulingOptions:
         resources["CPU"] = float(num_cpus)
     num_tpus = opts.get("num_tpus") or opts.get("num_gpus")
     if num_tpus:
+        from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+        ok, msg = TPUAcceleratorManager.validate_resource_request_quantity(num_tpus)
+        if not ok:
+            raise ValueError(msg)
         resources["TPU"] = float(num_tpus)
     if opts.get("memory"):
         resources["memory"] = float(opts["memory"])
@@ -1367,16 +1405,3 @@ def _picklable_error(e: BaseException) -> BaseException:
         return TaskError(cause=None, tb_str=str(e), task_desc="rpc")
 
 
-def _detect_tpu_chips() -> int:
-    """TPU chip autodetection (reference semantics:
-    python/ray/_private/accelerators/tpu.py:294-313 — /dev/accel* then
-    /dev/vfio)."""
-    import glob
-
-    env = os.environ.get("RT_NUM_TPUS")
-    if env is not None:
-        return int(env)
-    n = len(glob.glob("/dev/accel*"))
-    if n == 0:
-        n = len(glob.glob("/dev/vfio/[0-9]*"))
-    return n

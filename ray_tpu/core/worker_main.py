@@ -35,9 +35,11 @@ class WorkerClient:
     operation is an RPC over the pipe to the node manager."""
 
     def __init__(self, conn, worker_id: str, node_id: str):
+        from ray_tpu.core.ids import NodeID, WorkerID
+
         self.conn = conn
-        self.worker_id = worker_id
-        self.node_id = node_id
+        self.worker_id = WorkerID.from_hex(worker_id)
+        self.node_id = NodeID.from_hex(node_id)
         self.job_id = None
         self._send_lock = threading.Lock()
         self._req_lock = threading.Lock()

@@ -1,0 +1,135 @@
+"""TPU slice gang scheduling.
+
+Reference parity: python/ray/util/tpu.py — SlicePlacementGroup (:52),
+slice_placement_group (:227), reserve_tpu_slice (tpu accelerator module
+:213-264): reserve the slice head via a label-selected placement group, then
+build a full-slice PG (one bundle per host, SPREAD across the slice's
+hosts) so a worker group lands on every host of one slice atomically.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ray_tpu.accelerators.tpu import chips_per_host, num_hosts, pod_type_chip_count
+from ray_tpu.core.context import get_client
+from ray_tpu.util.placement_group import PlacementGroup, placement_group, remove_placement_group
+
+logger = logging.getLogger(__name__)
+
+
+def reserve_tpu_slice(topology: str, accelerator_version: str, timeout_s: float = 600.0) -> str | None:
+    """Reserve one whole slice by claiming its head resource; returns the
+    slice name (the per-slice resource key) or None."""
+    pod_type = _pod_type(topology, accelerator_version)
+    head_resource = f"TPU-{pod_type}-head"
+    pg = placement_group([{head_resource: 1}], strategy="STRICT_PACK", name=f"slice-head-{pod_type}")
+    if not pg.wait(timeout_seconds=timeout_s):
+        remove_placement_group(pg)
+        return None
+    # find which slice we landed on via the chosen node's labels
+    client = get_client()
+    table = {row["pg_id"]: row for row in client.pg("table")}
+    row = table.get(pg.id.hex())
+    slice_name = None
+    if row and row["nodes"]:
+        for n in client.cluster_info("nodes"):
+            if n["node_id"] == row["nodes"][0]:
+                slice_name = n["labels"].get("ray_tpu.io/tpu-slice-name")
+                break
+    # head PG's job is done once we know the slice; the slice PG pins hosts
+    if slice_name is None:
+        remove_placement_group(pg)
+        return None
+    _head_pgs[slice_name] = pg
+    return slice_name
+
+
+_head_pgs: dict = {}
+
+
+def _pod_type(topology: str, accelerator_version: str) -> str:
+    ver = accelerator_version.lower()
+    gen = {"v5e": "v5litepod", "v5litepod": "v5litepod"}.get(ver, ver)
+    chips = 1
+    for p in topology.lower().split("x"):
+        chips *= int(p)
+    from ray_tpu.accelerators.tpu import GENERATION_CORES_PER_CHIP
+
+    cores = chips * GENERATION_CORES_PER_CHIP.get(gen, 1)
+    return f"{gen}-{cores}"
+
+
+class SlicePlacementGroup:
+    """Gang reservation of a full TPU slice: one bundle per host carrying
+    that host's chips + the slice-name resource (reference: util/tpu.py:52)."""
+
+    def __init__(
+        self,
+        topology: str,
+        accelerator_version: str = "v5e",
+        chips_per_host_override: int | None = None,
+        timeout_s: float = 600.0,
+    ):
+        self.topology = topology
+        self.accelerator_version = accelerator_version
+        self.pod_type = _pod_type(topology, accelerator_version)
+        self._chips_per_host = chips_per_host_override or chips_per_host(self.pod_type, topology)
+        self._num_hosts = max(pod_type_chip_count(self.pod_type) // self._chips_per_host, 1)
+        self.slice_name = reserve_tpu_slice(topology, accelerator_version, timeout_s=timeout_s)
+        if self.slice_name is None:
+            raise TimeoutError(f"could not reserve a {self.pod_type} slice (head resource unavailable)")
+        bundles = [
+            {"TPU": float(self._chips_per_host), self.slice_name: 1.0}
+            for _ in range(self._num_hosts)
+        ]
+        self._pg = placement_group(bundles, strategy="STRICT_SPREAD", name=f"slice-{self.slice_name}")
+
+    @property
+    def placement_group(self) -> PlacementGroup:
+        return self._pg
+
+    @property
+    def num_hosts(self) -> int:
+        return self._num_hosts
+
+    @property
+    def chips_per_host(self) -> int:
+        return self._chips_per_host
+
+    @property
+    def num_chips(self) -> int:
+        return self._num_hosts * self._chips_per_host
+
+    def wait(self, timeout_seconds: float | None = None) -> bool:
+        return self._pg.wait(timeout_seconds=timeout_seconds)
+
+    def remove(self):
+        remove_placement_group(self._pg)
+        head = _head_pgs.pop(self.slice_name, None)
+        if head is not None:
+            remove_placement_group(head)
+
+
+def slice_placement_group(topology: str, accelerator_version: str = "v5e", **kw) -> SlicePlacementGroup:
+    return SlicePlacementGroup(topology, accelerator_version, **kw)
+
+
+def simulate_tpu_slice_nodes(client, pod_type: str, slice_name: str, num_cpus_per_host: int = 8):
+    """Test/dev helper: register simulated nodes shaped like one TPU slice
+    (the in-process analogue of the reference's fake multi-node cluster +
+    GKE env detection)."""
+    cph = chips_per_host(pod_type)
+    hosts = num_hosts(pod_type)
+    nodes = []
+    for wid in range(hosts):
+        resources = {"CPU": float(num_cpus_per_host), "TPU": float(cph), slice_name: 1.0}
+        if wid == 0:
+            resources[f"TPU-{pod_type}-head"] = 1.0
+        labels = {
+            "ray_tpu.io/tpu-slice-name": slice_name,
+            "ray_tpu.io/tpu-worker-id": str(wid),
+            "ray_tpu.io/tpu-pod-type": pod_type,
+        }
+        nodes.append(client.add_node(resources, labels=labels))
+    return nodes

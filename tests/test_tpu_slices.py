@@ -1,0 +1,98 @@
+"""TPU slice model + gang scheduling tests (reference pattern:
+python/ray/tests/accelerators/test_tpu.py, test_tpu_slice)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.accelerators.tpu import (
+    TPUAcceleratorManager,
+    chips_per_host,
+    num_hosts,
+    pod_type_chip_count,
+)
+from ray_tpu.core import context
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+from ray_tpu.util.tpu import SlicePlacementGroup, simulate_tpu_slice_nodes
+
+
+def test_pod_type_math():
+    assert pod_type_chip_count("v5litepod-16") == 16
+    assert pod_type_chip_count("v4-32") == 16  # 2 cores/chip
+    assert chips_per_host("v5litepod-16") == 4
+    assert chips_per_host("v5litepod-8") == 8
+    assert chips_per_host("v5litepod-4") == 4
+    assert chips_per_host("v5litepod-1") == 1
+    assert num_hosts("v5litepod-16") == 4
+    assert num_hosts("v4-32") == 4
+
+
+def test_chip_count_validation():
+    ok, _ = TPUAcceleratorManager.validate_resource_request_quantity(4)
+    assert ok
+    ok, msg = TPUAcceleratorManager.validate_resource_request_quantity(3)
+    assert not ok and "chip" in msg
+
+
+def test_worker_env_isolation():
+    env = TPUAcceleratorManager.worker_env_for_chips([1, 2])
+    assert env["TPU_VISIBLE_CHIPS"] == "1,2"
+    assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "1,2,1"
+
+
+def test_slice_placement_group_gang(rt_start):
+    client = context.get_client()
+    simulate_tpu_slice_nodes(client, "v5litepod-16", "slice-a")
+
+    spg = SlicePlacementGroup("4x4", "v5e", timeout_s=10)
+    assert spg.num_hosts == 4
+    assert spg.chips_per_host == 4
+    assert spg.slice_name == "slice-a"
+    assert spg.wait(timeout_seconds=10)
+
+    # one actor per host inside the slice PG, taking the host's 4 chips
+    @ray_tpu.remote(num_cpus=0, num_tpus=4)
+    class HostWorker:
+        def where(self):
+            import os
+
+            assert os.environ.get("TPU_VISIBLE_CHIPS") == "0,1,2,3"
+            return ray_tpu.get_runtime_context().node_id.hex()
+
+    actors = [
+        HostWorker.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=spg.placement_group, placement_group_bundle_index=i
+            )
+        ).remote()
+        for i in range(spg.num_hosts)
+    ]
+    hosts = ray_tpu.get([a.where.remote() for a in actors])
+    assert len(set(hosts)) == 4  # strict spread: one actor per host
+    spg.remove()
+
+
+def test_slice_reservation_exclusive(rt_start):
+    """Two slice PGs cannot grab the same slice (head resource is 1)."""
+    client = context.get_client()
+    simulate_tpu_slice_nodes(client, "v5litepod-8", "slice-b")
+
+    spg1 = SlicePlacementGroup("2x4", "v5e", timeout_s=5)
+    assert spg1.slice_name == "slice-b"
+    with pytest.raises(TimeoutError):
+        SlicePlacementGroup("2x4", "v5e", timeout_s=1.0)
+    spg1.remove()
+    # after removal the slice is reservable again
+    spg2 = SlicePlacementGroup("2x4", "v5e", timeout_s=5)
+    assert spg2.slice_name == "slice-b"
+    spg2.remove()
+
+
+def test_two_slices_pick_free_one(rt_start):
+    client = context.get_client()
+    simulate_tpu_slice_nodes(client, "v5litepod-8", "slice-c")
+    simulate_tpu_slice_nodes(client, "v5litepod-8", "slice-d")
+    a = SlicePlacementGroup("2x4", "v5e", timeout_s=5)
+    b = SlicePlacementGroup("2x4", "v5e", timeout_s=5)
+    assert {a.slice_name, b.slice_name} == {"slice-c", "slice-d"}
+    a.remove()
+    b.remove()
