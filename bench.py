@@ -1,0 +1,123 @@
+"""Headline benchmark: Llama SFT train-step MFU on the local TPU chip.
+
+Prints exactly ONE JSON line:
+  {"metric": "llama_sft_mfu", "value": <MFU>, "unit": "mfu", "vs_baseline": <MFU/0.35>}
+
+Baseline: the reference's north-star target of 35% MFU for Llama SFT on
+v5e (BASELINE.md; the reference publishes no absolute LLM throughput of
+its own). The model is scaled to fill one chip's HBM; on a pod the same
+program scales via the dp/fsdp mesh (see __graft_entry__.dryrun_multichip).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+PEAK_FLOPS = {
+    # bf16 peak FLOP/s per chip
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 197e12,
+    "TPU v4": 275e12,
+    "cpu": 1e12,  # nominal, for smoke runs only
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for k, v in PEAK_FLOPS.items():
+        if k.lower() in str(kind).lower():
+            return v
+    return PEAK_FLOPS["cpu"]
+
+
+def main():
+    import jax
+    import numpy as np
+    import optax
+
+    from ray_tpu.models.llama import LlamaConfig, flops_per_token, init_params, loss_fn, param_logical_axes
+    from ray_tpu.parallel.mesh import create_mesh
+    from ray_tpu.parallel.train_step import make_train_step, shard_batch
+
+    dev = jax.devices()[0]
+    on_tpu = "tpu" in str(getattr(dev, "platform", "")).lower() or "axon" in str(getattr(dev, "platform", "")).lower()
+
+    if on_tpu:
+        # ~940M-param model: fills a 16GB v5e chip with bf16 adam state
+        cfg = LlamaConfig(
+            vocab_size=32000,
+            hidden_size=2048,
+            intermediate_size=5632,
+            num_layers=18,
+            num_heads=16,
+            num_kv_heads=8,
+            max_seq_len=2048,
+        )
+        batch, seq, steps = 8, 2048, 10
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps = 4, 128, 3
+
+    mesh = create_mesh(dp=len(jax.devices()))
+    init_fn, compile_step, _ = make_train_step(
+        partial(loss_fn, config=cfg), optax.adamw(3e-4, weight_decay=0.01), mesh, param_logical_axes(cfg)
+    )
+    state, shardings = init_fn(jax.random.PRNGKey(0), partial(init_params, cfg))
+    step = compile_step(shardings)
+
+    rng = np.random.default_rng(0)
+    data = {
+        "tokens": rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
+        "targets": rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
+    }
+    sb = shard_batch(data, mesh)
+
+    # warmup/compile
+    for _ in range(2):
+        state, metrics = step(state, sb)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, sb)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_s = batch * seq * steps / dt
+    achieved = flops_per_token(cfg, seq) * tokens_per_s
+    mfu = achieved / (peak_flops(dev) * len(jax.devices()))
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama_sft_mfu",
+                "value": round(mfu, 4),
+                "unit": "mfu",
+                "vs_baseline": round(mfu / 0.35, 4),
+                "detail": {
+                    "tokens_per_s": round(tokens_per_s, 1),
+                    "params": cfg.num_params(),
+                    "device": str(getattr(dev, "device_kind", dev)),
+                    "n_devices": len(jax.devices()),
+                    "batch": batch,
+                    "seq": seq,
+                    "loss": round(float(metrics["loss"]), 4),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"metric": "llama_sft_mfu", "value": 0.0, "unit": "mfu", "vs_baseline": 0.0, "error": str(e)[:300]}))
+        sys.exit(1)

@@ -1,0 +1,17 @@
+from ray_tpu.collective.collective import (  # noqa: F401
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    declare_collective_group,
+    destroy_collective_group,
+    get_rank,
+    get_world_size,
+    init_collective_group,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from ray_tpu.collective.types import Backend, ReduceOp  # noqa: F401

@@ -1,0 +1,263 @@
+"""Collective communication API on actor groups.
+
+Reference parity: python/ray/util/collective/collective.py — GroupManager
+(:76), init_collective_group, ops allreduce/reduce/broadcast/allgather/
+reducescatter/send/recv/barrier (:339-735). The reference's NCCL rendezvous
+(rank-0 creating a NCCLUniqueIDStore named actor,
+nccl_collective_group.py:29-69) maps here to a named rendezvous actor; the
+data plane is the host object store (DCN-equivalent). The ICI fast path is
+NOT this API — it is GSPMD collectives inside jitted programs (see
+ray_tpu.parallel) — matching the TPU split: control/host tensors over DCN,
+device tensors inside XLA programs.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.collective.types import Backend, ReduceOp, apply_reduce
+
+
+class _GroupInfo:
+    def __init__(self, name, world_size, rank, backend, handle):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.backend = backend
+        self.handle = handle
+        self.round = 0
+        self.p2p_seq: dict = {}  # (kind, peer, tag) -> count
+        self.lock = threading.Lock()
+
+    def next_round(self) -> int:
+        with self.lock:
+            self.round += 1
+            return self.round
+
+    def next_p2p(self, kind: str, peer: int, tag: int) -> int:
+        """Per-(direction, peer, tag) sequence number so repeated sends on
+        one tag match their recvs in order instead of clobbering a slot."""
+        with self.lock:
+            key = (kind, peer, tag)
+            self.p2p_seq[key] = self.p2p_seq.get(key, 0) + 1
+            return self.p2p_seq[key]
+
+
+_groups: dict[str, _GroupInfo] = {}
+
+
+@ray_tpu.remote(num_cpus=0)
+class CollectiveRendezvous:
+    """Named actor every rank rendezvouses on (reference:
+    NCCLUniqueIDStore pattern, nccl_collective_group.py:29-69). Async so
+    waiting ranks don't block one another."""
+
+    def __init__(self, world_size: int):
+        import asyncio
+
+        self.world_size = world_size
+        self.rounds: dict = {}
+        self._asyncio = asyncio
+
+    def _entry(self, key):
+        if key not in self.rounds:
+            self.rounds[key] = {"data": {}, "event": self._asyncio.Event(), "result": None, "done": 0}
+        return self.rounds[key]
+
+    async def exchange(self, key, rank, payload, op: str, mode: str):
+        from ray_tpu._config import get_config
+
+        e = self._entry(key)
+        e["data"][rank] = payload
+        if len(e["data"]) == self.world_size:
+            arrays = [e["data"][r] for r in range(self.world_size)]
+            if mode == "allreduce":
+                e["result"] = apply_reduce(ReduceOp(op), arrays)
+            elif mode == "allgather":
+                e["result"] = arrays
+            elif mode == "reducescatter":
+                red = apply_reduce(ReduceOp(op), arrays)
+                e["result"] = np.array_split(red, self.world_size, axis=0)
+            elif mode == "barrier":
+                e["result"] = True
+            elif mode == "broadcast":
+                e["result"] = None  # picked below by src rank lookup
+                e["bcast"] = e["data"]
+            e["event"].set()
+        await self._asyncio.wait_for(e["event"].wait(), timeout=get_config().collective_timeout_s)
+        try:
+            if mode == "reducescatter":
+                return e["result"][rank]
+            if mode == "broadcast":
+                src = int(op)  # op carries src_rank for broadcast
+                return e["bcast"][src]
+            return e["result"]
+        finally:
+            # precise GC: the round is dropped once every rank has read it
+            e["done"] += 1
+            if e["done"] == self.world_size:
+                self.rounds.pop(key, None)
+
+    async def p2p_send(self, key, payload):
+        e = self._entry(key)
+        e["data"][0] = payload
+        e["event"].set()
+
+    async def p2p_recv(self, key):
+        from ray_tpu._config import get_config
+
+        e = self._entry(key)
+        await self._asyncio.wait_for(e["event"].wait(), timeout=get_config().collective_timeout_s)
+        val = e["data"][0]
+        self.rounds.pop(key, None)
+        return val
+
+    def reset(self):
+        self.rounds.clear()
+        return True
+
+
+def _rendezvous_name(group_name: str) -> str:
+    return f"rt_collective::{group_name}"
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: Backend | str = Backend.OBJECT_STORE,
+    group_name: str = "default",
+):
+    """Call on every rank (reference: collective.py:init_collective_group)."""
+    backend = Backend.normalize(backend)
+    name = _rendezvous_name(group_name)
+    if rank == 0:
+        handle = CollectiveRendezvous.options(name=name, lifetime="detached").remote(world_size)
+        ray_tpu.get(handle.__ray_ready__())
+    else:
+        import time
+
+        deadline = time.time() + 60
+        while True:
+            try:
+                handle = ray_tpu.get_actor(name)
+                break
+            except ValueError:
+                if time.time() > deadline:
+                    raise TimeoutError(f"rendezvous actor for group {group_name!r} never appeared") from None
+                time.sleep(0.05)
+    _groups[group_name] = _GroupInfo(group_name, world_size, rank, backend, handle)
+
+
+def create_collective_group(actors, world_size: int, ranks: list[int], backend="object_store", group_name: str = "default"):
+    """Declare a group across actor handles (driver-side; reference:
+    collective.py:create_collective_group). Each actor must then call
+    init_collective_group in its own process."""
+    return declare_collective_group(actors, world_size=world_size, ranks=ranks, backend=backend, group_name=group_name)
+
+
+def declare_collective_group(actors, world_size=None, ranks=None, backend="object_store", group_name="default"):
+    world_size = world_size or len(actors)
+    ranks = ranks or list(range(len(actors)))
+    refs = []
+    for actor, rank in zip(actors, ranks):
+        refs.append(actor.__rt_init_collective__.remote(world_size, rank, str(backend), group_name))
+    return refs
+
+
+def destroy_collective_group(group_name: str = "default"):
+    g = _groups.pop(group_name, None)
+    if g is not None and g.rank == 0:
+        try:
+            ray_tpu.kill(g.handle)
+        except Exception:
+            pass
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _groups[group_name].rank
+
+
+def get_world_size(group_name: str = "default") -> int:
+    return _groups[group_name].world_size
+
+
+def _g(group_name) -> _GroupInfo:
+    if group_name not in _groups:
+        raise RuntimeError(f"collective group {group_name!r} not initialized in this process")
+    return _groups[group_name]
+
+
+def _roundtrip(g: _GroupInfo, tensor, op, mode, round_key=None):
+    key = round_key or f"{mode}:{g.next_round()}"
+    payload = None if tensor is None else np.asarray(tensor)
+    op_str = op.value if isinstance(op, ReduceOp) else str(op)
+    return ray_tpu.get(g.handle.exchange.remote(key, g.rank, payload, op_str, mode))
+
+
+def _like(result, tensor):
+    """Return result with the same array flavor as the input."""
+    try:
+        import jax.numpy as jnp
+
+        if hasattr(tensor, "devices") or type(tensor).__module__.startswith("jax"):
+            return jnp.asarray(result)
+    except Exception:
+        pass
+    return result
+
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    g = _g(group_name)
+    return _like(_roundtrip(g, tensor, op, "allreduce"), tensor)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    g = _g(group_name)
+    out = _roundtrip(g, tensor, op, "allreduce")
+    return _like(out, tensor) if g.rank == dst_rank else tensor
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _g(group_name)
+    return _like(_roundtrip(g, tensor, src_rank, "broadcast"), tensor)
+
+
+def allgather(tensor, group_name: str = "default"):
+    g = _g(group_name)
+    return [_like(r, tensor) for r in _roundtrip(g, tensor, "sum", "allgather")]
+
+
+def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    g = _g(group_name)
+    return _like(_roundtrip(g, tensor, op, "reducescatter"), tensor)
+
+
+def barrier(group_name: str = "default"):
+    g = _g(group_name)
+    _roundtrip(g, None, "sum", "barrier")
+
+
+def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0):
+    g = _g(group_name)
+    seq = g.next_p2p("send", dst_rank, tag)
+    key = f"p2p:{g.rank}->{dst_rank}:{tag}:{seq}"
+    ray_tpu.get(g.handle.p2p_send.remote(key, np.asarray(tensor)))
+
+
+def recv(shape_or_tensor, src_rank: int, group_name: str = "default", tag: int = 0):
+    g = _g(group_name)
+    seq = g.next_p2p("recv", src_rank, tag)
+    key = f"p2p:{src_rank}->{g.rank}:{tag}:{seq}"
+    return _like(ray_tpu.get(g.handle.p2p_recv.remote(key)), shape_or_tensor)
+
+
+class CollectiveActorMixin:
+    """Mixin giving actors the __rt_init_collective__ hook used by
+    declare_collective_group."""
+
+    def __rt_init_collective__(self, world_size, rank, backend, group_name):
+        init_collective_group(world_size, rank, backend, group_name)
+        return rank

@@ -1,0 +1,53 @@
+"""Collective types (reference: python/ray/util/collective/types.py:35-57 —
+backends NCCL/gloo/NIXL there; here the backends are TPU-native)."""
+
+from __future__ import annotations
+
+from enum import Enum
+
+
+class Backend(str, Enum):
+    # rendezvous-actor backend: tensors exchanged through the object store
+    # (host memory / DCN) — works anywhere, any process topology
+    OBJECT_STORE = "object_store"
+    # alias kept for API compatibility with code written for gloo
+    GLOO = "gloo"
+    # XLA backend: for jax.Array collectives the op is a tiny jitted program
+    # over a shared mesh (ICI); requires all ranks in one jax process OR
+    # jax.distributed multi-host init
+    XLA = "xla"
+
+    @staticmethod
+    def normalize(b: "Backend | str") -> "Backend":
+        b = Backend(b) if not isinstance(b, Backend) else b
+        if b == Backend.GLOO:
+            return Backend.OBJECT_STORE
+        if b in (Backend.OBJECT_STORE, Backend.XLA):
+            return b
+        raise ValueError(f"unsupported backend {b} (NCCL/MPI are not part of a TPU build)")
+
+
+class ReduceOp(str, Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    MEAN = "mean"
+
+
+def apply_reduce(op: ReduceOp, arrays: list):
+    import numpy as np
+
+    op = ReduceOp(op)
+    stack = np.stack([np.asarray(a) for a in arrays])
+    if op == ReduceOp.SUM:
+        return stack.sum(axis=0)
+    if op == ReduceOp.PRODUCT:
+        return stack.prod(axis=0)
+    if op == ReduceOp.MIN:
+        return stack.min(axis=0)
+    if op == ReduceOp.MAX:
+        return stack.max(axis=0)
+    if op == ReduceOp.MEAN:
+        return stack.mean(axis=0)
+    raise ValueError(op)

@@ -53,6 +53,7 @@ class WorkerClient:
         self._exec_pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="rt-exec")
         self._actor_instance = None
         self._actor_loop = None  # asyncio loop thread for async actors
+        self._actor_loop_lock = threading.Lock()
         self._func_cache: dict[str, object] = {}
         self._sent_funcs: set[str] = set()
         # shm mappings whose close was deferred because user code still
@@ -339,12 +340,14 @@ class WorkerClient:
         return True
 
     def _get_actor_loop(self):
-        if self._actor_loop is None:
-            loop = asyncio.new_event_loop()
-            t = threading.Thread(target=loop.run_forever, daemon=True, name="rt-actor-loop")
-            t.start()
-            self._actor_loop = loop
-        return self._actor_loop
+        # exec-pool threads (max_concurrency of them) race here; one loop only
+        with self._actor_loop_lock:
+            if self._actor_loop is None:
+                loop = asyncio.new_event_loop()
+                t = threading.Thread(target=loop.run_forever, daemon=True, name="rt-actor-loop")
+                t.start()
+                self._actor_loop = loop
+            return self._actor_loop
 
     def _run_on_actor_loop(self, coro):
         fut = asyncio.run_coroutine_threadsafe(coro, self._get_actor_loop())

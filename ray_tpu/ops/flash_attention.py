@@ -1,0 +1,382 @@
+"""Flash attention: Pallas TPU kernels (fwd + bwd) with XLA fallback.
+
+The hot op of the framework (the reference delegates attention to
+torch/vLLM CUDA kernels; here it is TPU-native). Forward and backward are
+Pallas kernels tiled for the MXU: online softmax with f32 accumulation in
+VMEM scratch across the kv grid dimension; backward never materializes the
+[T, T] probability matrix (dq kernel iterates kv blocks, dk/dv kernel
+iterates q blocks). O(T) residuals: output + logsumexp.
+
+Layout: [batch, num_heads, seq, head_dim] (GQA: kv heads broadcast).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+# ----------------------------------------------------------------------
+# reference / fallback implementation (XLA; used on CPU)
+# ----------------------------------------------------------------------
+def attention_xla(q, k, v, causal: bool = True, scale: float | None = None, segment_ids=None):
+    """Plain XLA attention, f32 softmax. q,k,v: [B, H, T, D]."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    logits = _apply_masks(logits, causal, segment_ids)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _apply_masks(logits, causal, segment_ids):
+    B, H, Tq, Tk = logits.shape
+    if causal:
+        qi = jax.lax.broadcasted_iota(jnp.int32, (Tq, Tk), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1)
+        logits = jnp.where((ki <= qi)[None, None], logits, _NEG_INF)
+    if segment_ids is not None:
+        same = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        logits = jnp.where(same, logits, _NEG_INF)
+    return logits
+
+
+# ----------------------------------------------------------------------
+# pallas forward kernel
+# ----------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[:] = m_new
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:] + jnp.log(l))[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k"))
+def _fwd_pallas(q, k, v, causal=True, scale=None, block_q=512, block_k=512):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    if scale is None:
+        scale = D**-0.5
+    block_q = min(block_q, T)
+    block_k = min(block_k, Tk)
+    grid = (B * H, pl.cdiv(T, block_q), pl.cdiv(Tk, block_k))
+    qs, ks, vs = (x.reshape(B * H, x.shape[2], D) for x in (q, k, v))
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * H * T * Tk * D,
+            bytes_accessed=(qs.size + ks.size + vs.size) * 2,
+            transcendentals=B * H * T * Tk,
+        ),
+    )(qs, ks, vs)
+    return o.reshape(B, H, T, D), lse.reshape(B, H, T)
+
+
+# ----------------------------------------------------------------------
+# pallas backward kernels
+# ----------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *, scale, causal, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == n_k - 1)
+    def _fin():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])  # [bq, bk]
+        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        # skip q blocks entirely before this kv block
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qi == n_q - 1)
+    def _fin():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k"))
+def _bwd_pallas(q, k, v, o, lse, g, causal=True, scale=None, block_q=512, block_k=512):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    if scale is None:
+        scale = D**-0.5
+    block_q = min(block_q, T)
+    block_k = min(block_k, Tk)
+    qs, ks, vs, dos = (x.reshape(B * H, x.shape[2], D) for x in (q, k, v, g))
+    lse3 = lse.reshape(B * H, 1, T)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1).reshape(B * H, 1, T)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k),
+        grid=(B * H, pl.cdiv(T, block_q), pl.cdiv(Tk, block_k)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+    )(qs, ks, vs, dos, lse3, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k),
+        grid=(B * H, pl.cdiv(Tk, block_k), pl.cdiv(T, block_q)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+    )(qs, ks, vs, dos, lse3, delta)
+
+    return (
+        dq.reshape(B, H, T, D),
+        dk.reshape(B, H, Tk, D),
+        dv.reshape(B, H, Tk, D),
+    )
+
+
+# ----------------------------------------------------------------------
+# custom VJP
+# ----------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True, scale: float | None = None, impl: str = "auto"):
+    """Flash attention with GQA support. q: [B,H,T,D]; k,v: [B,Hkv,T,D].
+
+    impl: "auto" (pallas on TPU when head_dim tiles), "pallas", or "xla".
+    """
+    out, _ = _flash_fwd(q, k, v, causal, scale, impl)
+    return out
+
+
+def _broadcast_kv(q, k, v):
+    H, Hkv = q.shape[1], k.shape[1]
+    if H != Hkv:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    return k, v
+
+
+def _flash_fwd(q, k, v, causal, scale, impl="auto"):
+    kb, vb = _broadcast_kv(q, k, v)
+    if _use_pallas(q, impl):
+        o, lse = _fwd_pallas(q, kb, vb, causal=causal, scale=scale)
+    else:
+        o, lse = _fwd_xla_with_lse(q, kb, vb, causal, scale)
+    return o, (q, k, v, o, lse)
+
+
+def _fwd_xla_with_lse(q, k, v, causal, scale):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    logits = _apply_masks(logits, causal, None)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    probs = jnp.exp(logits - lse[..., None]).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v), lse
+
+
+def _flash_bwd(causal, scale, impl, residuals, g):
+    q, k, v, o, lse = residuals
+    kb, vb = _broadcast_kv(q, k, v)
+    if _use_pallas(q, impl):
+        dq, dk, dv = _bwd_pallas(q, kb, vb, o, lse, g, causal=causal, scale=scale)
+    else:
+        dq, dk, dv = _bwd_xla(q, kb, vb, o, lse, g, causal, scale)
+    H, Hkv = q.shape[1], k.shape[1]
+    if H != Hkv:
+        rep = H // Hkv
+        dk = dk.reshape(dk.shape[0], Hkv, rep, *dk.shape[2:]).sum(axis=2).astype(k.dtype)
+        dv = dv.reshape(dv.shape[0], Hkv, rep, *dv.shape[2:]).sum(axis=2).astype(v.dtype)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _bwd_xla(q, k, v, o, lse, g, causal, scale):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    logits = _apply_masks(logits, causal, None)
+    p = jnp.exp(logits - lse[..., None])
+    g32 = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+    delta = jnp.sum(g32 * o.astype(jnp.float32), axis=-1, keepdims=True)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", g32, v.astype(jnp.float32))
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32)) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32)) * scale
+    return dq, dk, dv
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+# kept for callers/tests that used the older name
+_flash_fwd_pallas = _fwd_pallas
+
+
+def _use_pallas(q, impl: str = "auto") -> bool:
+    import os
+
+    if impl == "auto":
+        impl = os.environ.get("RT_ATTENTION_IMPL", "auto")
+    if impl == "xla":
+        return False
+    if impl == "pallas":
+        return True
+    try:
+        # axon is the tunneled TPU PJRT plugin; same hardware
+        return q.shape[-1] in (64, 128, 256) and jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False

@@ -1,0 +1,163 @@
+"""Device mesh construction + logical sharding rules.
+
+The TPU-native core of the framework: every parallelism strategy the
+reference delegates to NCCL/torch (DP via DDP allreduce, FSDP param
+sharding, TP via vLLM engine args — SURVEY.md §2.5) is expressed here as
+GSPMD sharding over a named `jax.sharding.Mesh`:
+
+  axis   | role
+  -------|----------------------------------------------------------
+  dp     | data parallel (batch split; gradients psum over dp)
+  fsdp   | fully-sharded data parallel (params/opt-state sharded; ZeRO)
+  tp     | tensor parallel (matmul column/row sharding over ICI)
+  sp     | sequence/context parallel (ring attention over sequence)
+  ep     | expert parallel (MoE expert sharding + all-to-all dispatch)
+  pp     | pipeline stages (usually across slices / DCN)
+
+XLA inserts the collectives (psum/all-gather/reduce-scatter/ppermute) on
+ICI automatically from these shardings — no NCCL anywhere.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "tp", "ep")
+
+
+@dataclass
+class MeshConfig:
+    """Sizes per logical axis; -1 means 'absorb remaining devices'."""
+
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = {"pp": self.pp, "dp": self.dp, "fsdp": self.fsdp, "sp": self.sp, "tp": self.tp, "ep": self.ep}
+        fixed = math.prod(v for v in sizes.values() if v > 0)
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one axis may be -1, got {wild}")
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(f"{n_devices} devices not divisible by fixed axes product {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh axes product {fixed} != device count {n_devices}")
+        return sizes
+
+
+def create_mesh(
+    config: MeshConfig | dict | None = None,
+    devices=None,
+    **axis_sizes,
+) -> Mesh:
+    """Build a named mesh over the given (default: all) devices.
+
+    create_mesh(dp=4)            -> 1D data-parallel mesh
+    create_mesh(dp=2, tp=4)      -> 2D mesh, tp innermost (fastest ICI)
+    create_mesh(MeshConfig(...)) -> from config
+
+    Axis order puts tp/ep innermost so tensor-parallel collectives ride the
+    shortest ICI hops, and pp outermost (cross-slice / DCN), matching the
+    scaling-book recipe.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if config is None:
+        config = MeshConfig(**{**{"dp": -1}, **axis_sizes}) if axis_sizes else MeshConfig()
+    elif isinstance(config, dict):
+        config = MeshConfig(**config)
+    sizes = config.resolve(len(devices))
+    axes = [a for a in AXIS_ORDER if sizes[a] > 1] or ["dp"]
+    shape = [sizes[a] for a in axes]
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=tuple(axes))
+
+
+def mesh_axes(mesh: Mesh) -> dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def axis_or_none(mesh: Mesh, *names: str):
+    """The subset of `names` present in the mesh (for PartitionSpecs that
+    degrade gracefully when an axis is absent)."""
+    present = [n for n in names if n in mesh.axis_names]
+    if not present:
+        return None
+    return tuple(present) if len(present) > 1 else present[0]
+
+
+# ----------------------------------------------------------------------
+# logical sharding rules
+# ----------------------------------------------------------------------
+@dataclass
+class ShardingRules:
+    """Map logical array-dimension names to mesh axes (flax-style
+    partitioning rules, applied to pytrees of logical axis annotations)."""
+
+    rules: dict[str, object] = field(
+        default_factory=lambda: {
+            "batch": ("dp", "fsdp"),  # batch dim split over dp (+fsdp data shards)
+            "sequence": "sp",
+            "embed": "fsdp",  # param sharding axis (ZeRO-3 over fsdp)
+            "heads": "tp",
+            "kv_heads": "tp",
+            "mlp": "tp",
+            "vocab": "tp",
+            "expert": "ep",
+            "stage": "pp",
+            None: None,
+        }
+    )
+
+    def spec(self, logical_axes: tuple, mesh: Mesh) -> P:
+        out = []
+        used = set()
+        for ax in logical_axes:
+            m = self.rules.get(ax)
+            if m is None:
+                out.append(None)
+                continue
+            names = (m,) if isinstance(m, str) else tuple(m)
+            names = tuple(n for n in names if n in mesh.axis_names and n not in used)
+            used.update(names)
+            if not names:
+                out.append(None)
+            elif len(names) == 1:
+                out.append(names[0])
+            else:
+                out.append(names)
+        return P(*out)
+
+    def sharding(self, logical_axes: tuple, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(logical_axes, mesh))
+
+    def tree_shardings(self, logical_tree, mesh: Mesh):
+        """Pytree of logical-axis tuples -> pytree of NamedShardings."""
+        return jax.tree.map(
+            lambda axes: self.sharding(axes, mesh),
+            logical_tree,
+            is_leaf=lambda x: isinstance(x, tuple) and all(a is None or isinstance(a, str) for a in x),
+        )
+
+
+DEFAULT_RULES = ShardingRules()
+
+
+def shard_batch_spec(mesh: Mesh) -> P:
+    """PartitionSpec for input batches: batch over dp(+fsdp), sequence over sp."""
+    return P(axis_or_none(mesh, "dp", "fsdp"), axis_or_none(mesh, "sp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

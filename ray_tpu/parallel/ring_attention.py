@@ -1,0 +1,133 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO sequence-parallel implementation (SURVEY.md §5.7:
+grep for ulysses/ring_attention/context_parallel over python/ray + rllib is
+empty; long sequences are delegated to engines). Here it is first-class and
+TPU-native:
+
+- ring_attention: blockwise attention with online-softmax merging while
+  K/V shards rotate around the `sp` mesh axis via `lax.ppermute` (ICI
+  neighbor exchange — the ring topology IS the TPU interconnect). Memory
+  per chip: O(T/sp); compute overlaps with the rotation.
+- ulysses_attention: all-to-all head<->sequence reshard over `sp` (each
+  chip sees the full sequence for H/sp heads), full local attention, then
+  the inverse all-to-all. One collective round instead of sp ring steps —
+  better when heads >= sp and ICI all-to-all bandwidth is plentiful.
+
+Both are called INSIDE shard_map over the mesh (see sp_attention entry
+point) so XLA lowers the permutes onto ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_off, k_off, causal, scale):
+    """Unnormalized blockwise attention: returns (acc, m, l).
+
+    q: [B,H,Tq,D], k/v: [B,H,Tk,D]; offsets are global position starts used
+    for causal masking across ring steps.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        Tq, Tk = q.shape[2], k.shape[2]
+        qp = q_off + lax.broadcasted_iota(jnp.int32, (Tq, Tk), 0)
+        kp = k_off + lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1)
+        s = jnp.where((kp <= qp)[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def ring_attention_local(q, k, v, axis_name: str = "sp", causal: bool = True, scale: float | None = None):
+    """Runs inside shard_map: q,k,v are the local sequence shards
+    [B, H, T/sp, D]. Returns the local output shard."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    Tl = q.shape[2]
+    q32 = q.astype(jnp.float32)
+
+    def _merge(carry, kv, i):
+        m_acc, l_acc, o_acc = carry
+        k_i, v_i = kv
+        src = (my - i) % n  # whose kv shard we currently hold
+        acc, m_b, l_b = _block_attn(q32, k_i.astype(jnp.float32), v_i, my * Tl, src * Tl, causal, scale)
+        m_new = jnp.maximum(m_acc, m_b)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_b - m_new)
+        l_new = alpha * l_acc + beta * l_b
+        o_new = o_acc * alpha + acc * beta
+        return m_new, l_new, o_new
+
+    def step(carry, i):
+        softmax_carry, kv = carry
+        new_carry = _merge(softmax_carry, kv, i)
+        # rotate kv to the next device (ring over ICI)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kv_next = jax.tree.map(lambda t: lax.ppermute(t, axis_name, perm), kv)
+        return (new_carry, kv_next), None
+
+    B, H, _, D = q.shape
+    init = (
+        jnp.full((B, H, Tl, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((B, H, Tl, 1), jnp.float32),
+        jnp.zeros((B, H, Tl, D), jnp.float32),
+    )
+    # scan n-1 (attend, rotate) steps, then a final attend with no rotation
+    # (the last hop's result would be discarded — skip the wasted ICI round)
+    (carry, kv_last), _ = lax.scan(step, (init, (k, v)), jnp.arange(n - 1))
+    m_f, l_f, o_f = _merge(carry, kv_last, n - 1)
+    out = o_f / jnp.maximum(l_f, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention_local(q, k, v, axis_name: str = "sp", causal: bool = True, scale: float | None = None, attn_fn=None):
+    """Runs inside shard_map: all-to-all so each chip gets full sequence for
+    H/sp heads, local full attention, inverse all-to-all."""
+    n = lax.psum(1, axis_name)
+    # [B, H, Tl, D] -> [B, H/n, T, D]
+    q2 = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    k2 = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    v2 = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    if attn_fn is None:
+        from ray_tpu.ops.flash_attention import attention_xla
+
+        attn_fn = functools.partial(attention_xla, causal=causal, scale=scale)
+    o2 = attn_fn(q2, k2, v2)
+    # [B, H/n, T, D] -> [B, H, Tl, D]
+    return lax.all_to_all(o2, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def sp_attention(q, k, v, mesh: Mesh, impl: str = "ring", causal: bool = True):
+    """Top-level entry: q,k,v globally [B, H, T, D] sharded over sp on T.
+    Wraps the local kernels in shard_map over the full mesh."""
+    from jax.experimental.shard_map import shard_map
+
+    if "sp" not in mesh.axis_names:
+        from ray_tpu.ops.flash_attention import attention_xla
+
+        return attention_xla(q, k, v, causal=causal)
+    batch_ax = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
+    spec = P(batch_ax, None, "sp", None)
+    local = ring_attention_local if impl == "ring" else ulysses_attention_local
+
+    fn = shard_map(
+        functools.partial(local, axis_name="sp", causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return fn(q, k, v)

@@ -9,11 +9,18 @@ strategy for testing multi-host GSPMD without TPUs; see SURVEY.md §4).
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault(
-    "XLA_FLAGS",
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
-)
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# sitecustomize registers the axon TPU plugin and prepends it to
+# jax_platforms; override here (before any backend is initialized) so the
+# test mesh is 8 virtual CPU devices.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 import pytest  # noqa: E402
 

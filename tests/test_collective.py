@@ -1,0 +1,93 @@
+"""Collective API tests (reference pattern:
+python/ray/util/collective/tests/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.collective.collective import CollectiveActorMixin
+
+
+@ray_tpu.remote(num_cpus=0)
+class Rank(CollectiveActorMixin):
+    def __init__(self):
+        self.rank = None
+
+    def setup(self, world_size, rank, group):
+        import ray_tpu.collective as col
+
+        col.init_collective_group(world_size, rank, "object_store", group)
+        self.rank = rank
+        return rank
+
+    def do_allreduce(self, group):
+        import ray_tpu.collective as col
+
+        t = np.full((4,), float(self.rank + 1))
+        return col.allreduce(t, group)
+
+    def do_allgather(self, group):
+        import ray_tpu.collective as col
+
+        return col.allgather(np.array([self.rank]), group)
+
+    def do_reducescatter(self, group):
+        import ray_tpu.collective as col
+
+        t = np.arange(8, dtype=np.float64)
+        return col.reducescatter(t, group)
+
+    def do_broadcast(self, group):
+        import ray_tpu.collective as col
+
+        t = np.array([42.0 if self.rank == 0 else 0.0])
+        return col.broadcast(t, src_rank=0, group_name=group)
+
+    def do_sendrecv(self, group):
+        import ray_tpu.collective as col
+
+        if self.rank == 0:
+            col.send(np.array([7.0]), dst_rank=1, group_name=group)
+            return None
+        return col.recv(np.zeros(1), src_rank=0, group_name=group)
+
+
+def _make_group(n, group):
+    actors = [Rank.remote() for _ in range(n)]
+    ray_tpu.get([a.setup.remote(n, i, group) for i, a in enumerate(actors)])
+    return actors
+
+
+def test_allreduce(rt_start):
+    actors = _make_group(4, "g1")
+    outs = ray_tpu.get([a.do_allreduce.remote("g1") for a in actors])
+    for o in outs:
+        np.testing.assert_allclose(o, np.full((4,), 1.0 + 2 + 3 + 4))
+
+
+def test_allgather(rt_start):
+    actors = _make_group(3, "g2")
+    outs = ray_tpu.get([a.do_allgather.remote("g2") for a in actors])
+    for o in outs:
+        assert [int(x[0]) for x in o] == [0, 1, 2]
+
+
+def test_reducescatter(rt_start):
+    actors = _make_group(2, "g3")
+    outs = ray_tpu.get([a.do_reducescatter.remote("g3") for a in actors])
+    # sum over 2 ranks of arange(8) -> 2*arange(8); rank r gets its split
+    np.testing.assert_allclose(outs[0], 2 * np.arange(4, dtype=np.float64))
+    np.testing.assert_allclose(outs[1], 2 * np.arange(4, 8, dtype=np.float64))
+
+
+def test_broadcast(rt_start):
+    actors = _make_group(3, "g4")
+    outs = ray_tpu.get([a.do_broadcast.remote("g4") for a in actors])
+    for o in outs:
+        np.testing.assert_allclose(o, [42.0])
+
+
+def test_send_recv(rt_start):
+    actors = _make_group(2, "g5")
+    outs = ray_tpu.get([a.do_sendrecv.remote("g5") for a in actors])
+    np.testing.assert_allclose(outs[1], [7.0])
