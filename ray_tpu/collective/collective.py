@@ -176,6 +176,15 @@ def destroy_collective_group(group_name: str = "default"):
             pass
 
 
+def cleanup_group_actor(group_name: str):
+    """Driver/controller-side: kill a group's (detached) rendezvous actor by
+    name — used to reap groups whose ranks died without destroy."""
+    try:
+        ray_tpu.kill(ray_tpu.get_actor(_rendezvous_name(group_name)))
+    except Exception:
+        pass
+
+
 def get_rank(group_name: str = "default") -> int:
     return _groups[group_name].rank
 

@@ -1,0 +1,40 @@
+"""ray_tpu.train: distributed training on the actor runtime.
+
+TPU-native Train library (reference: python/ray/train + train/v2): a
+controller/worker-group topology where each worker is an actor on one host
+of a TPU slice, `jax.distributed` is bootstrapped across workers, and the
+user's step function runs under pjit/GSPMD so DP/FSDP/TP/SP are sharding
+configs, not wrapper modules (reference equivalents:
+train/v2/api/data_parallel_trainer.py, train/v2/jax/jax_trainer.py:19).
+"""
+
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.context import TrainContext, get_context
+from ray_tpu.train.result import Result
+from ray_tpu.train.session import get_checkpoint, get_dataset_shard, report
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, TorchTrainer
+from ray_tpu.train.errors import TrainingFailedError
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TorchTrainer",
+    "TrainContext",
+    "TrainingFailedError",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "report",
+]

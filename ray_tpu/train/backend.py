@@ -1,0 +1,164 @@
+"""Backend plugin interface + JAX and Torch backends.
+
+Reference parity: python/ray/train/backend.py (Backend/BackendConfig) and
+train/v2/jax/config.py:56-96 (_JaxBackend.on_start running
+``jax.distributed.initialize(coordinator, num_workers, index)`` on every
+worker) — the TPU-native path. TorchConfig mirrors train/torch/config.py
+(TCP rendezvous + gloo) for CPU-parity workloads.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class BackendConfig:
+    # set in every worker process before the user loop imports jax/torch
+    # (e.g. {"LIBTPU_INIT_ARGS": ...}, XLA flags)
+    env_vars: dict | None = None
+
+    @property
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Hooks called by the controller around worker-group lifecycle."""
+
+    def on_start(self, worker_group, backend_config):
+        pass
+
+    def on_training_start(self, worker_group, backend_config):
+        pass
+
+    def on_shutdown(self, worker_group, backend_config):
+        pass
+
+
+# ----------------------------------------------------------------------
+# JAX backend (the primary one)
+# ----------------------------------------------------------------------
+@dataclass
+class JaxConfig(BackendConfig):
+    """TPU/JAX distributed bootstrap.
+
+    distributed: "auto" initializes jax.distributed when num_workers > 1
+    (coordination service over DCN; XLA then compiles collectives onto
+    ICI), "never" skips (single host / tests), "always" forces.
+    """
+
+    distributed: str = "auto"
+
+    @property
+    def backend_cls(self):
+        return _JaxBackend
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group, backend_config: "JaxConfig"):
+        n = len(worker_group)
+        mode = backend_config.distributed
+        if mode == "never" or (mode == "auto" and n <= 1):
+            return
+        # coordinator = worker 0's host (slice worker 0 per the reference's
+        # TPU topology: tpu.py worker-id labels); pick a free port there
+        host, port = worker_group.execute_single(0, _free_coordinator_addr)
+        coordinator = f"{host}:{port}"
+        worker_group.execute(_init_jax_distributed, coordinator, n)
+
+    def on_shutdown(self, worker_group, backend_config):
+        try:
+            worker_group.execute(_shutdown_jax_distributed)
+        except Exception:
+            pass
+
+
+def _free_coordinator_addr():
+    """Runs ON worker 0: its routable IP + a free port (other hosts of the
+    slice must be able to dial it — 127.0.0.1 would only work single-host)."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    try:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        probe.connect(("8.8.8.8", 80))  # no packets sent; just picks the egress iface
+        host = probe.getsockname()[0]
+        probe.close()
+    except OSError:
+        host = "127.0.0.1"
+    return host, port
+
+
+def _init_jax_distributed(coordinator: str, num_processes: int):
+    # import jax only inside workers — the driver must stay off the TPU
+    # (reference warning: jax_trainer.py:88-89)
+    import jax
+
+    from ray_tpu.train import context as _ctx
+
+    rank = _ctx.get_context().get_world_rank()
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=rank,
+    )
+
+
+def _shutdown_jax_distributed():
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+# ----------------------------------------------------------------------
+# Torch backend (CPU parity; reference: train/torch/config.py)
+# ----------------------------------------------------------------------
+@dataclass
+class TorchConfig(BackendConfig):
+    backend: str = "gloo"
+    init_method: str = "tcp"
+    timeout_s: int = 1800
+
+    @property
+    def backend_cls(self):
+        return _TorchBackend
+
+
+class _TorchBackend(Backend):
+    def on_start(self, worker_group, backend_config: "TorchConfig"):
+        n = len(worker_group)
+        if n <= 1:
+            return
+        host, port = worker_group.execute_single(0, _free_coordinator_addr)
+        worker_group.execute(
+            _init_torch_process_group, f"tcp://{host}:{port}", n, backend_config.backend
+        )
+
+    def on_shutdown(self, worker_group, backend_config):
+        try:
+            worker_group.execute(_destroy_torch_process_group)
+        except Exception:
+            pass
+
+
+def _init_torch_process_group(init_method: str, world_size: int, backend: str):
+    import torch.distributed as dist
+
+    from ray_tpu.train import context as _ctx
+
+    rank = _ctx.get_context().get_world_rank()
+    dist.init_process_group(backend=backend, init_method=init_method, world_size=world_size, rank=rank)
+
+
+def _destroy_torch_process_group():
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        dist.destroy_process_group()

