@@ -1,0 +1,51 @@
+"""ray_tpu.data: streaming distributed datasets.
+
+Reference parity: python/ray/data (70 KLoC engine, SURVEY.md §2.4/§3.7) —
+lazy plans over Arrow blocks in the shared-memory object store, executed
+by a pull-driven streaming pipeline with bounded in-flight windows;
+feeds ray_tpu.train via streaming_split / get_dataset_shard.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.dataset import (
+    Dataset,
+    GroupedData,
+    MaterializedDataset,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_images,
+    read_json,
+    read_numpy,
+    read_parquet,
+)
+from ray_tpu.data.datasource import Datasource, ReadTask
+from ray_tpu.data.iterator import DataIterator
+
+__all__ = [
+    "Block",
+    "BlockAccessor",
+    "DataIterator",
+    "Dataset",
+    "Datasource",
+    "GroupedData",
+    "MaterializedDataset",
+    "ReadTask",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_binary_files",
+    "read_csv",
+    "read_datasource",
+    "read_images",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+]

@@ -1,0 +1,121 @@
+"""Blocks: the unit of data movement — Arrow tables in the object store.
+
+Reference parity: python/ray/data/block.py + _internal/arrow_block.py —
+a Dataset is a list of ObjectRef[Block]; only refs flow through the
+executor, block payloads stay in the (shared-memory) object store.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+
+
+class BlockAccessor:
+    """Format bridge + row-wise ops over one Arrow block."""
+
+    def __init__(self, block: Block):
+        self.block = block
+
+    # ---------------- construction ----------------
+    @staticmethod
+    def batch_to_block(batch: Any) -> Block:
+        """dict-of-arrays | pandas | arrow | list-of-rows -> Arrow table."""
+        if isinstance(batch, pa.Table):
+            return batch
+        if isinstance(batch, dict):
+            cols = {}
+            for k, v in batch.items():
+                v = np.asarray(v) if not isinstance(v, (pa.Array, pa.ChunkedArray, list)) else v
+                if isinstance(v, np.ndarray) and v.ndim > 1:
+                    # tensor column: list-of-lists arrow representation
+                    cols[k] = pa.array(list(v))
+                else:
+                    cols[k] = v
+            return pa.table(cols)
+        try:
+            import pandas as pd
+
+            if isinstance(batch, pd.DataFrame):
+                return pa.Table.from_pandas(batch, preserve_index=False)
+        except ImportError:
+            pass
+        if isinstance(batch, list):
+            if batch and isinstance(batch[0], dict):
+                return pa.Table.from_pylist(batch)
+            return pa.table({"item": pa.array(batch)})
+        if isinstance(batch, np.ndarray):
+            return BlockAccessor.batch_to_block({"data": batch})
+        raise TypeError(f"cannot convert {type(batch)} to a block")
+
+    @staticmethod
+    def rows_to_block(rows: list[dict]) -> Block:
+        return pa.Table.from_pylist(rows)
+
+    # ---------------- properties ----------------
+    def num_rows(self) -> int:
+        return self.block.num_rows
+
+    def size_bytes(self) -> int:
+        return self.block.nbytes
+
+    def schema(self):
+        return self.block.schema
+
+    # ---------------- conversion ----------------
+    def to_arrow(self) -> pa.Table:
+        return self.block
+
+    def to_pandas(self):
+        return self.block.to_pandas()
+
+    def to_numpy(self, columns=None) -> dict[str, np.ndarray]:
+        cols = columns or self.block.column_names
+        out = {}
+        for c in cols:
+            col = self.block.column(c)
+            try:
+                out[c] = col.to_numpy(zero_copy_only=False)
+            except (pa.ArrowInvalid, NotImplementedError):
+                out[c] = np.array(col.to_pylist(), dtype=object)
+            if out[c].dtype == object and len(out[c]) and isinstance(out[c][0], (list, np.ndarray)):
+                try:
+                    out[c] = np.stack([np.asarray(x) for x in out[c]])
+                except ValueError:
+                    pass
+        return out
+
+    def to_batch(self, batch_format: str):
+        if batch_format in ("numpy", "default"):
+            return self.to_numpy()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("pyarrow", "arrow"):
+            return self.block
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    # ---------------- row ops ----------------
+    def iter_rows(self) -> Iterable[dict]:
+        for batch in self.block.to_batches():
+            yield from batch.to_pylist()
+
+    def slice(self, start: int, end: int) -> Block:
+        return self.block.slice(start, end - start)
+
+    def take_indices(self, idx) -> Block:
+        return self.block.take(pa.array(idx))
+
+    @staticmethod
+    def concat(blocks: list[Block]) -> Block:
+        blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
+        if not blocks:
+            return pa.table({})
+        return pa.concat_tables(blocks, promote_options="default")
+
+
+def block_size_rows(block: Block) -> int:
+    return block.num_rows

@@ -1,0 +1,478 @@
+"""Dataset: the lazy, streaming distributed dataset.
+
+Reference parity: python/ray/data/dataset.py — lazy logical plan, executed
+by the streaming executor on iteration/materialize (SURVEY.md §3.7).
+Transforms return new Datasets sharing the upstream plan (immutable).
+"""
+
+from __future__ import annotations
+
+import builtins
+import functools
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.datasource import Datasource, ReadTask
+from ray_tpu.data.executor import AllToAllSpec, LimitSpec, MapSpec, execute_plan
+from ray_tpu.data.iterator import DataIterator, SplitCoordinator, SplitIterator
+
+
+class Dataset:
+    def __init__(self, source_tasks: list[ReadTask], ops: tuple = ()):
+        self._source_tasks = source_tasks
+        self._ops = tuple(ops)
+
+    # ---------------- plan building ----------------
+    def _with_op(self, op) -> "Dataset":
+        return Dataset(self._source_tasks, self._ops + (op,))
+
+    def map_batches(
+        self,
+        fn: Callable | type,
+        *,
+        batch_size: int | None = None,
+        batch_format: str = "numpy",
+        concurrency: int | None = None,
+        num_cpus: float = 1.0,
+        fn_args: tuple = (),
+        fn_kwargs: dict | None = None,
+        fn_constructor_args: tuple = (),
+        fn_constructor_kwargs: dict | None = None,
+        zero_copy_batch: bool = False,
+    ) -> "Dataset":
+        return self._with_op(
+            MapSpec(
+                "map_batches",
+                fn,
+                fn_args=fn_args,
+                fn_kwargs=fn_kwargs or {},
+                fn_constructor_args=fn_constructor_args,
+                fn_constructor_kwargs=fn_constructor_kwargs or {},
+                batch_size=batch_size,
+                batch_format=batch_format,
+                concurrency=concurrency,
+                num_cpus=num_cpus,
+                zero_copy_batch=zero_copy_batch,
+            )
+        )
+
+    def map(self, fn, *, concurrency=None, num_cpus: float = 1.0, fn_args=(), fn_kwargs=None) -> "Dataset":
+        return self._with_op(
+            MapSpec("map", fn, fn_args=fn_args, fn_kwargs=fn_kwargs or {}, concurrency=concurrency, num_cpus=num_cpus)
+        )
+
+    def filter(self, fn, *, concurrency=None, fn_args=(), fn_kwargs=None) -> "Dataset":
+        return self._with_op(MapSpec("filter", fn, fn_args=fn_args, fn_kwargs=fn_kwargs or {}, concurrency=concurrency))
+
+    def flat_map(self, fn, *, concurrency=None, fn_args=(), fn_kwargs=None) -> "Dataset":
+        return self._with_op(MapSpec("flat_map", fn, fn_args=fn_args, fn_kwargs=fn_kwargs or {}, concurrency=concurrency))
+
+    def add_column(self, name: str, fn) -> "Dataset":
+        def add(batch):
+            batch[name] = fn(batch)
+            return batch
+
+        return self.map_batches(add, batch_format="pandas")
+
+    def drop_columns(self, cols: list[str]) -> "Dataset":
+        return self.map_batches(lambda b: {k: v for k, v in b.items() if k not in cols})
+
+    def select_columns(self, cols: list[str]) -> "Dataset":
+        return self.map_batches(lambda b: {k: b[k] for k in cols})
+
+    def rename_columns(self, mapping: dict) -> "Dataset":
+        return self.map_batches(lambda b: {mapping.get(k, k): v for k, v in b.items()})
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with_op(LimitSpec(n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with_op(AllToAllSpec("repartition", {"num_blocks": num_blocks}))
+
+    def random_shuffle(self, *, seed: int | None = None) -> "Dataset":
+        return self._with_op(AllToAllSpec("random_shuffle", {"seed": seed}))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._with_op(AllToAllSpec("sort", {"key": key, "descending": descending}))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Only unions of plain (un-transformed or materialized) datasets
+        keep laziness; otherwise operands materialize."""
+        all_tasks = list(self._materialized_tasks())
+        for o in others:
+            all_tasks += o._materialized_tasks()
+        return Dataset(all_tasks)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        left = self.materialize()
+        right = other.materialize()
+        lt = BlockAccessor.concat(ray_tpu.get(left._refs))
+        rt = BlockAccessor.concat(ray_tpu.get(right._refs))
+        if lt.num_rows != rt.num_rows:
+            raise ValueError(f"zip row mismatch: {lt.num_rows} vs {rt.num_rows}")
+        merged = lt
+        for name in rt.column_names:
+            out_name = name if name not in lt.column_names else f"{name}_1"
+            merged = merged.append_column(out_name, rt.column(name))
+        return from_arrow(merged)
+
+    # ---------------- execution ----------------
+    def _ref_stream(self):
+        return execute_plan(list(self._source_tasks), list(self._ops))
+
+    def _materialized_tasks(self) -> list[ReadTask]:
+        if not self._ops:
+            return list(self._source_tasks)
+        mat = self.materialize()
+        return mat._source_tasks
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(self._ref_stream)
+
+    def iter_batches(self, **kw):
+        return self.iterator().iter_batches(**kw)
+
+    def iter_rows(self):
+        return self.iterator().iter_rows()
+
+    def iter_torch_batches(self, **kw):
+        return self.iterator().iter_torch_batches(**kw)
+
+    def materialize(self) -> "MaterializedDataset":
+        return MaterializedDataset(list(self._ref_stream()))
+
+    def take(self, n: int = 20) -> list[dict]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> list[dict]:
+        return list(self.iter_rows())
+
+    def take_batch(self, batch_size: int = 20, batch_format: str = "numpy"):
+        for b in self.iter_batches(batch_size=batch_size, batch_format=batch_format):
+            return b
+        return {}
+
+    def count(self) -> int:
+        # submit all count kernels first, then one batched get (keeps the
+        # streaming window full instead of serializing on each block)
+        refs = [_count_block.remote(r) for r in self._ref_stream()]
+        return sum(ray_tpu.get(refs))
+
+    def schema(self):
+        for ref in self._ref_stream():
+            return ray_tpu.get(ref).schema
+        return None
+
+    def columns(self) -> list[str]:
+        s = self.schema()
+        return list(s.names) if s is not None else []
+
+    # ---------------- aggregations ----------------
+    def _agg(self, col: str, kind: str):
+        refs = [_agg_block.remote(r, col, kind) for r in self._ref_stream()]
+        vals = [v for v in ray_tpu.get(refs) if v is not None]
+        if not vals:
+            return None
+        if kind in ("sum", "count"):
+            return sum(vals)
+        if kind == "min":
+            return min(vals)
+        if kind == "max":
+            return max(vals)
+        if kind == "sum_count":  # single-pass mean support
+            return (sum(s for s, _ in vals), sum(c for _, c in vals))
+        raise ValueError(kind)
+
+    def sum(self, col: str):
+        return self._agg(col, "sum")
+
+    def min(self, col: str):
+        return self._agg(col, "min")
+
+    def max(self, col: str):
+        return self._agg(col, "max")
+
+    def mean(self, col: str):
+        # one pass over the plan (sum+count per block), not two executions
+        out = self._agg(col, "sum_count")
+        if out is None:
+            return None
+        s, c = out
+        return None if not c else s / c
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # ---------------- splits ----------------
+    def split(self, n: int, *, equal: bool = False) -> list["MaterializedDataset"]:
+        refs = list(self._ref_stream())
+        if equal:
+            total = BlockAccessor.concat(ray_tpu.get(refs))
+            rows = total.num_rows - (total.num_rows % n)
+            per = rows // n
+            return [
+                MaterializedDataset([ray_tpu.put(BlockAccessor(total).slice(i * per, (i + 1) * per))])
+                for i in builtins.range(n)
+            ]
+        outs = [[] for _ in builtins.range(n)]
+        for i, r in enumerate(refs):
+            outs[i % n].append(r)
+        return [MaterializedDataset(o) for o in outs]
+
+    def streaming_split(self, n: int, *, equal: bool = False, locality_hints=None) -> list[DataIterator]:
+        coord = SplitCoordinator.remote(self, n, equal)
+        return [SplitIterator(coord, i) for i in builtins.range(n)]
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False, seed=None):
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        mat = ds.materialize()  # single plan execution; count from the blocks
+        merged = BlockAccessor.concat(ray_tpu.get(mat._refs))
+        total = merged.num_rows
+        k = int(total * (1 - test_size))
+        acc = BlockAccessor(merged)
+        return (
+            MaterializedDataset([ray_tpu.put(acc.slice(0, k))]),
+            MaterializedDataset([ray_tpu.put(acc.slice(k, merged.num_rows))]),
+        )
+
+    # ---------------- writes ----------------
+    def _write(self, path: str, fmt: str):
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        refs = [
+            _write_block.remote(ref, path, i, fmt) for i, ref in enumerate(self._ref_stream())
+        ]
+        return ray_tpu.get(refs)
+
+    def write_parquet(self, path: str):
+        return self._write(path, "parquet")
+
+    def write_csv(self, path: str):
+        return self._write(path, "csv")
+
+    def write_json(self, path: str):
+        return self._write(path, "json")
+
+    def to_pandas(self):
+        return BlockAccessor.concat(ray_tpu.get(list(self._ref_stream()))).to_pandas()
+
+    def to_arrow_refs(self):
+        return list(self._ref_stream())
+
+    def __repr__(self):
+        ops = " -> ".join(type(o).__name__ for o in self._ops) or "read"
+        return f"Dataset({len(self._source_tasks)} source tasks, plan: {ops})"
+
+
+class MaterializedDataset(Dataset):
+    """A dataset whose blocks already exist in the object store."""
+
+    def __init__(self, refs: list):
+        self._refs = refs
+        super().__init__([ReadTask(None) for _ in refs])
+
+    def _ref_stream(self):
+        if self._ops:
+            return execute_plan_from_refs(self._refs, list(self._ops))
+        return iter(self._refs)
+
+    def _with_op(self, op):
+        out = MaterializedDataset(self._refs)
+        out._ops = self._ops + (op,)
+        return out
+
+    def _materialized_tasks(self):
+        if self._ops:
+            return self.materialize()._source_tasks
+        return [ReadTask(lambda b=b: iter([b]), num_rows=None) for b in ray_tpu.get(self._refs)]
+
+    def num_blocks(self) -> int:
+        return len(self._refs)
+
+
+def execute_plan_from_refs(refs, ops):
+    return execute_plan([], ops) if not refs else _execute_from_refs(refs, ops)
+
+
+def _execute_from_refs(refs, ops):
+    from ray_tpu.data import executor as ex
+
+    stream = iter(refs)
+    for op in ops:
+        if isinstance(op, MapSpec):
+            stream = ex._map_stage(stream, op)
+        elif isinstance(op, LimitSpec):
+            stream = ex._limit_stage(stream, op.n)
+        elif isinstance(op, AllToAllSpec):
+            stream = ex._all_to_all_stage(stream, op)
+    return stream
+
+
+class GroupedData:
+    """Hash-shuffle groupby (reference: data/grouped_data.py + hash_shuffle
+    physical op)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self.ds = ds
+        self.key = key
+
+    def _grouped_blocks(self):
+        sorted_ds = self.ds.sort(self.key)
+        return list(sorted_ds._ref_stream())
+
+    def _apply(self, agg_fn_name: str, cols: list[str] | None):
+        refs = self._grouped_blocks()
+        merged = BlockAccessor.concat(ray_tpu.get(refs))
+        df = merged.to_pandas()
+        g = df.groupby(self.key, sort=True)
+        if agg_fn_name == "count":
+            out = g.size().reset_index(name="count()")
+        else:
+            cols = cols or [c for c in df.columns if c != self.key]
+            out = getattr(g[cols], agg_fn_name)().reset_index()
+            out.columns = [self.key] + [f"{agg_fn_name}({c})" for c in cols]
+        return from_pandas(out)
+
+    def count(self):
+        return self._apply("count", None)
+
+    def sum(self, *cols):
+        return self._apply("sum", list(cols) or None)
+
+    def mean(self, *cols):
+        return self._apply("mean", list(cols) or None)
+
+    def min(self, *cols):
+        return self._apply("min", list(cols) or None)
+
+    def max(self, *cols):
+        return self._apply("max", list(cols) or None)
+
+    def map_groups(self, fn, *, batch_format: str = "pandas"):
+        refs = self._grouped_blocks()
+        merged = BlockAccessor.concat(ray_tpu.get(refs))
+        df = merged.to_pandas()
+        outs = []
+        for _, group in df.groupby(self.key, sort=True):
+            res = fn(group if batch_format == "pandas" else BlockAccessor.batch_to_block(group))
+            outs.append(BlockAccessor.batch_to_block(res))
+        return MaterializedDataset([ray_tpu.put(b) for b in outs])
+
+
+# ----------------------------------------------------------------------
+# remote kernels for terminal ops
+# ----------------------------------------------------------------------
+@ray_tpu.remote
+def _count_block(block: Block) -> int:
+    return block.num_rows
+
+
+@ray_tpu.remote
+def _agg_block(block: Block, col: str, kind: str):
+    acc = BlockAccessor(block)
+    if block.num_rows == 0:
+        return None
+    vals = acc.to_numpy([col])[col]
+    if kind == "sum":
+        return vals.sum()
+    if kind == "min":
+        return vals.min()
+    if kind == "max":
+        return vals.max()
+    if kind == "count":
+        return len(vals)
+    if kind == "sum_count":
+        return (vals.sum(), len(vals))
+
+
+@ray_tpu.remote
+def _write_block(block: Block, path: str, idx: int, fmt: str) -> str:
+    import os
+
+    f = os.path.join(path, f"part-{idx:05d}.{fmt}")
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(block, f)
+    elif fmt == "csv":
+        import pyarrow.csv as pacsv
+
+        pacsv.write_csv(block, f)
+    elif fmt == "json":
+        block.to_pandas().to_json(f, orient="records", lines=True)
+    return f
+
+
+# ----------------------------------------------------------------------
+# read API (module-level; re-exported by ray_tpu.data.__init__)
+# ----------------------------------------------------------------------
+def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:
+    if parallelism <= 0:
+        parallelism = 8
+    return Dataset(ds.get_read_tasks(parallelism))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    from ray_tpu.data.datasource import RangeDatasource
+
+    return read_datasource(RangeDatasource(n), parallelism=parallelism)
+
+
+def from_items(items: list, *, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.datasource import ItemsDatasource
+
+    return read_datasource(ItemsDatasource(items), parallelism=parallelism)
+
+
+def from_numpy(arr, column: str = "data") -> Dataset:
+    from ray_tpu.data.datasource import BlocksDatasource
+
+    return read_datasource(BlocksDatasource([{column: np.asarray(arr)}]), parallelism=1)
+
+
+def from_pandas(df) -> Dataset:
+    from ray_tpu.data.datasource import BlocksDatasource
+
+    return read_datasource(BlocksDatasource([df]), parallelism=1)
+
+
+def from_arrow(table) -> Dataset:
+    from ray_tpu.data.datasource import BlocksDatasource
+
+    return read_datasource(BlocksDatasource([table]), parallelism=1)
+
+
+def _file_reader(cls):
+    def reader(paths, *, parallelism: int = -1, **kw) -> Dataset:
+        return read_datasource(cls(paths, **kw), parallelism=parallelism)
+
+    return reader
+
+
+from ray_tpu.data.datasource import (  # noqa: E402
+    BinaryDatasource,
+    CSVDatasource,
+    ImageDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+)
+
+read_parquet = _file_reader(ParquetDatasource)
+read_csv = _file_reader(CSVDatasource)
+read_json = _file_reader(JSONDatasource)
+read_numpy = _file_reader(NumpyDatasource)
+read_binary_files = _file_reader(BinaryDatasource)
+
+
+def read_images(paths, *, size=None, mode=None, parallelism: int = -1) -> Dataset:
+    return read_datasource(ImageDatasource(paths, size=size, mode=mode), parallelism=parallelism)

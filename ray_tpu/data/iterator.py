@@ -1,0 +1,200 @@
+"""DataIterator: rebatching consumption of a block-ref stream.
+
+Reference parity: python/ray/data/iterator.py (iter_batches /
+iter_torch_batches / to_tf) + _internal/block_batching. The train
+integration hands each worker a DataIterator (get_dataset_shard).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+def _rebatch(blocks: Iterator[Block], batch_size: int | None, batch_format: str, drop_last: bool):
+    if batch_size is None:
+        for b in blocks:
+            if b.num_rows:
+                yield BlockAccessor(b).to_batch(batch_format)
+        return
+    buf: list[Block] = []
+    buffered = 0
+    for b in blocks:
+        if not b.num_rows:
+            continue
+        buf.append(b)
+        buffered += b.num_rows
+        while buffered >= batch_size:
+            merged = BlockAccessor.concat(buf)
+            out = BlockAccessor(merged).slice(0, batch_size)
+            rest = BlockAccessor(merged).slice(batch_size, merged.num_rows)
+            yield BlockAccessor(out).to_batch(batch_format)
+            buf = [rest] if rest.num_rows else []
+            buffered = rest.num_rows
+    if buffered and not drop_last:
+        yield BlockAccessor(BlockAccessor.concat(buf)).to_batch(batch_format)
+
+
+class DataIterator:
+    """Iterates a (re-runnable) stream of block refs."""
+
+    def __init__(self, ref_stream_factory):
+        self._factory = ref_stream_factory
+
+    def _blocks(self, prefetch: int) -> Iterator[Block]:
+        refs = self._factory()
+        window: collections.deque = collections.deque()
+        for ref in refs:
+            window.append(ref)
+            if len(window) > prefetch:
+                yield ray_tpu.get(window.popleft())
+        while window:
+            yield ray_tpu.get(window.popleft())
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: int | None = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+        prefetch_batches: int = 2,
+        local_shuffle_buffer_size: int | None = None,
+        local_shuffle_seed: int | None = None,
+    ):
+        blocks = self._blocks(prefetch=max(prefetch_batches, 1))
+        if local_shuffle_buffer_size:
+            blocks = _shuffle_blocks(blocks, local_shuffle_buffer_size, local_shuffle_seed)
+        yield from _rebatch(blocks, batch_size, batch_format, drop_last)
+
+    def iter_rows(self):
+        for b in self._blocks(prefetch=2):
+            yield from BlockAccessor(b).iter_rows()
+
+    def iter_torch_batches(self, *, batch_size: int | None = 256, drop_last: bool = False, **kw):
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size, batch_format="numpy", drop_last=drop_last, **kw):
+            yield {k: torch.as_tensor(v) for k, v in batch.items()}
+
+    def materialize(self):
+        from ray_tpu.data.dataset import MaterializedDataset
+
+        return MaterializedDataset(list(self._factory()))
+
+
+def _shuffle_blocks(blocks: Iterator[Block], buffer_rows: int, seed):
+    rng = np.random.default_rng(seed)
+    buf: list[Block] = []
+    size = 0
+    for b in blocks:
+        buf.append(b)
+        size += b.num_rows
+        if size >= buffer_rows:
+            merged = BlockAccessor.concat(buf)
+            yield BlockAccessor(merged).take_indices(rng.permutation(merged.num_rows))
+            buf, size = [], 0
+    if buf:
+        merged = BlockAccessor.concat(buf)
+        yield BlockAccessor(merged).take_indices(rng.permutation(merged.num_rows))
+
+
+@ray_tpu.remote(max_concurrency=16)
+class SplitCoordinator:
+    """Serves one executing stream to n consumers (reference:
+    _internal/execution/operators/output_splitter.py + streaming_split
+    coordinator actor): each consumer pulls its next block ref; assignment
+    is round-robin at pull time, so faster consumers do not starve.
+
+    equal=True re-chunks the stream into fixed-row chunks dealt round-robin
+    and splits the tail evenly (dropping up to n-1 remainder rows), so every
+    consumer sees exactly the same row count — required for synchronized
+    SPMD training loops (reference: output_splitter equal splitting)."""
+
+    EQUAL_CHUNK_ROWS = 256
+
+    def __init__(self, dataset, n: int, equal: bool):
+        self.n = n
+        self.equal = equal
+        self.queues = [collections.deque() for _ in range(n)]
+        self._stream = dataset._ref_stream()
+        self._exhausted = False
+        self._next = 0
+        self._carry = None  # equal mode: residual rows awaiting a full chunk
+        import threading
+
+        self._lock = threading.Lock()
+
+    def _pump_equal(self):
+        """Pull source blocks until one full round of n chunks is queued, or
+        the stream ends (then deal the tail evenly, dropping < n rows)."""
+        chunk = self.EQUAL_CHUNK_ROWS
+        while not self._exhausted:
+            rows = self._carry.num_rows if self._carry is not None else 0
+            if rows >= chunk * self.n:
+                break
+            try:
+                block = ray_tpu.get(next(self._stream))
+            except StopIteration:
+                self._exhausted = True
+                break
+            self._carry = block if self._carry is None else BlockAccessor.concat([self._carry, block])
+        buf = self._carry
+        if buf is None:
+            return
+        acc = BlockAccessor(buf)
+        if not self._exhausted:
+            for i in range(self.n):
+                self.queues[i].append(ray_tpu.put(acc.slice(i * chunk, (i + 1) * chunk)))
+            self._carry = acc.slice(chunk * self.n, buf.num_rows)
+        else:
+            per = buf.num_rows // self.n
+            if per:
+                for i in range(self.n):
+                    self.queues[i].append(ray_tpu.put(acc.slice(i * per, (i + 1) * per)))
+            self._carry = None
+
+    def next_ref(self, split: int):
+        """Returns an ObjectRef or None when the stream is exhausted."""
+        with self._lock:
+            if self.queues[split]:
+                return self.queues[split].popleft()
+            if self.equal:
+                while not self.queues[split]:
+                    had_carry = self._carry is not None
+                    self._pump_equal()
+                    if self._exhausted and not self.queues[split] and not had_carry:
+                        return None
+                    if self._exhausted and not self.queues[split]:
+                        return None
+                return self.queues[split].popleft()
+            while not self._exhausted:
+                try:
+                    ref = next(self._stream)
+                except StopIteration:
+                    self._exhausted = True
+                    break
+                target = self._next % self.n
+                self._next += 1
+                if target == split:
+                    return ref
+                self.queues[target].append(ref)
+            return self.queues[split].popleft() if self.queues[split] else None
+
+
+class SplitIterator(DataIterator):
+    def __init__(self, coordinator, split: int):
+        self._coord = coordinator
+        self._split = split
+        super().__init__(self._pull_refs)
+
+    def _pull_refs(self):
+        while True:
+            ref = ray_tpu.get(self._coord.next_ref.remote(self._split))
+            if ref is None:
+                return
+            yield ref

@@ -1,0 +1,182 @@
+"""Data layer tests (reference pattern: python/ray/data/tests/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+def test_range_count_take(rt_start):
+    ds = rd.range(100)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_map_batches_numpy(rt_start):
+    ds = rd.range(64).map_batches(lambda b: {"id": b["id"] * 2})
+    assert ds.sum("id") == 2 * sum(range(64))
+
+
+def test_map_filter_flat_map(rt_start):
+    ds = rd.from_items([{"x": i} for i in range(10)])
+    out = ds.map(lambda r: {"x": r["x"] + 1}).filter(lambda r: r["x"] % 2 == 0)
+    assert sorted(r["x"] for r in out.take_all()) == [2, 4, 6, 8, 10]
+    fm = rd.from_items([{"x": 1}, {"x": 2}]).flat_map(lambda r: [{"y": r["x"]}, {"y": -r["x"]}])
+    assert sorted(r["y"] for r in fm.take_all()) == [-2, -1, 1, 2]
+
+
+def test_actor_pool_map(rt_start):
+    class AddConst:
+        def __init__(self, c=100):
+            self.c = c
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.c}
+
+    ds = rd.range(32).map_batches(AddConst, concurrency=2, fn_constructor_kwargs={"c": 100})
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == list(range(100, 132))
+
+
+def test_iter_batches_rebatching(rt_start):
+    ds = rd.range(50, parallelism=4)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=16)]
+    assert sum(sizes) == 50
+    assert all(s == 16 for s in sizes[:-1])
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=16, drop_last=True)]
+    assert all(s == 16 for s in sizes)
+
+
+def test_limit_and_schema(rt_start):
+    ds = rd.range(1000).limit(7)
+    assert ds.count() == 7
+    assert rd.range(3).columns() == ["id"]
+
+
+def test_sort_and_shuffle(rt_start):
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(200)
+    ds = rd.from_items([{"v": int(v)} for v in vals]).sort("v")
+    out = [r["v"] for r in ds.take_all()]
+    assert out == sorted(out)
+    sh = rd.range(100).random_shuffle(seed=42)
+    ids = [r["id"] for r in sh.take_all()]
+    assert sorted(ids) == list(range(100)) and ids != list(range(100))
+
+
+def test_repartition(rt_start):
+    ds = rd.range(100, parallelism=10).repartition(3)
+    mat = ds.materialize()
+    assert mat.num_blocks() == 3
+    assert mat.count() == 100
+
+
+def test_groupby(rt_start):
+    ds = rd.from_items([{"k": i % 3, "v": i} for i in range(30)])
+    counts = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 10, 1: 10, 2: 10}
+    sums = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums[0] == sum(i for i in range(30) if i % 3 == 0)
+
+
+def test_aggregations(rt_start):
+    ds = rd.range(10)
+    assert ds.sum("id") == 45
+    assert ds.min("id") == 0
+    assert ds.max("id") == 9
+    assert ds.mean("id") == 4.5
+
+
+def test_parquet_roundtrip(rt_start, tmp_path):
+    ds = rd.range(40)
+    ds.write_parquet(str(tmp_path / "pq"))
+    back = rd.read_parquet(str(tmp_path / "pq"))
+    assert back.count() == 40
+    assert back.sum("id") == sum(range(40))
+
+
+def test_csv_json_roundtrip(rt_start, tmp_path):
+    ds = rd.from_items([{"a": i, "b": float(i)} for i in range(10)])
+    ds.write_csv(str(tmp_path / "csv"))
+    assert rd.read_csv(str(tmp_path / "csv")).count() == 10
+    ds.write_json(str(tmp_path / "json"))
+    back = rd.read_json(str(tmp_path / "json"))
+    assert back.sum("a") == 45
+
+
+def test_split_and_streaming_split(rt_start):
+    ds = rd.range(60, parallelism=6)
+    shards = ds.split(3)
+    assert sum(s.count() for s in shards) == 60
+    its = ds.streaming_split(2)
+    seen = []
+    for it in its:
+        for b in it.iter_batches(batch_size=None):
+            seen.extend(b["id"].tolist())
+    assert sorted(seen) == list(range(60))
+
+
+def test_streaming_split_equal(rt_start):
+    # 55 rows over 2 splits: equal=True gives both exactly 27 (1 dropped)
+    ds = rd.range(55, parallelism=5)
+    its = ds.streaming_split(2, equal=True)
+    counts = []
+    for it in its:
+        c = 0
+        for b in it.iter_batches(batch_size=None):
+            c += len(b["id"])
+        counts.append(c)
+    assert counts[0] == counts[1] == 27
+
+
+def test_empty_block_pipeline(rt_start):
+    # filter-to-empty then map_batches must not call fn on empty blocks
+    ds = rd.range(10).filter(lambda r: False).map_batches(lambda b: {"y": [b["id"][0]]})
+    assert ds.count() == 0
+    # sort with mostly-empty blocks must not crash on boundary sampling
+    s = rd.range(40, parallelism=4).filter(lambda r: r["id"] == 3).sort("id")
+    assert [r["id"] for r in s.take_all()] == [3]
+
+
+def test_zip_union(rt_start):
+    a = rd.from_items([{"x": i} for i in range(5)])
+    b = rd.from_items([{"y": i * 10} for i in range(5)])
+    z = a.zip(b)
+    rows = z.take_all()
+    assert rows[2]["x"] == 2 and rows[2]["y"] == 20
+    u = a.union(b)
+    assert u.count() == 10
+
+
+def test_train_integration_dataset_shard(rt_start, tmp_path):
+    from ray_tpu import train
+    from ray_tpu.train import DataParallelTrainer, RunConfig, ScalingConfig
+
+    def loop(config):
+        it = train.get_dataset_shard("train")
+        total = 0
+        for batch in it.iter_batches(batch_size=8):
+            total += int(batch["id"].sum())
+        train.report({"total": total, "rank": train.get_context().get_world_rank()})
+
+    ds = rd.range(40)
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="d", storage_path=str(tmp_path)),
+        datasets={"train": ds},
+    ).fit()
+    assert result.error is None
+
+
+def test_tensor_columns(rt_start):
+    arr = np.arange(24, dtype=np.float32).reshape(6, 4)
+    ds = rd.from_numpy(arr)
+    b = ds.take_batch(6)
+    assert b["data"].shape == (6, 4)
+    out = ds.map_batches(lambda x: {"data": x["data"] * 2}).take_batch(6)
+    np.testing.assert_allclose(out["data"], arr * 2)
