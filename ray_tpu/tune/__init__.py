@@ -1,0 +1,61 @@
+"""ray_tpu.tune: hyperparameter optimization on trial actors.
+
+Reference parity: python/ray/tune (35 KLoC, SURVEY.md §2.4) — Tuner.fit
+over a TuneController managing trial actors, searchers (grid/random,
+Optuna), schedulers (ASHA, PBT, median stopping), experiment checkpoints,
+Train integration (Tuner(trainer)).
+"""
+
+from ray_tpu.train.session import report  # shared session API  # noqa: F401
+from ray_tpu.train.session import get_checkpoint  # noqa: F401
+from ray_tpu.train._checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter, OptunaSearch, Searcher
+from ray_tpu.tune.search_space import (
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.tuner import TuneConfig, Tuner, run, with_parameters
+
+__all__ = [
+    "ASHAScheduler",
+    "BasicVariantGenerator",
+    "Checkpoint",
+    "ConcurrencyLimiter",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "OptunaSearch",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "Searcher",
+    "TrialScheduler",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "grid_search",
+    "lograndint",
+    "loguniform",
+    "quniform",
+    "randint",
+    "randn",
+    "report",
+    "run",
+    "sample_from",
+    "uniform",
+    "with_parameters",
+]

@@ -1,0 +1,190 @@
+"""Trial schedulers: FIFO, ASHA, median stopping, PBT.
+
+Reference parity: python/ray/tune/schedulers/ — hyperband.py (ASHA rungs,
+successive halving with eta), median_stopping_rule.py, pbt.py (truncation
+exploit + perturb explore). Decisions are returned to the controller per
+reported result.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+PAUSE = "PAUSE"
+
+
+class TrialScheduler:
+    def on_trial_result(self, controller, trial, result: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, controller, trial):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous successive halving (reference: schedulers/hyperband.py
+    / async_hyperband): rungs at grace_period * reduction_factor^k; a trial
+    reaching a rung stops unless in the top 1/reduction_factor of metric
+    values recorded at that rung."""
+
+    def __init__(
+        self,
+        metric: str = None,
+        mode: str = "max",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+        time_attr: str = "training_iteration",
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.eta = reduction_factor
+        self.time_attr = time_attr
+        self.rungs: dict[int, dict[str, float]] = {}  # rung -> trial -> value
+        r = grace_period
+        while r < max_t:
+            self.rungs[r] = {}
+            r *= reduction_factor
+
+    def _sign(self, v):
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, controller, trial, result):
+        t = result.get(self.time_attr, trial.iteration)
+        metric = result.get(self.metric)
+        if metric is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for rung in sorted(self.rungs, reverse=True):
+            if t >= rung:
+                # record on the FIRST result at-or-past the milestone, so
+                # time_attrs that skip exact rung values still participate
+                if trial.trial_id not in self.rungs[rung]:
+                    self.rungs[rung][trial.trial_id] = self._sign(metric)
+                # re-evaluate the trial's recorded value at its latest rung
+                # every report: a trial that passed a rung early (before
+                # peers arrived) still stops once the cutoff moves above it
+                vals = self.rungs[rung]
+                if trial.trial_id not in vals or len(vals) < self.eta:
+                    return CONTINUE
+                cutoff = np.percentile(list(vals.values()), (1 - 1 / self.eta) * 100)
+                return CONTINUE if vals[trial.trial_id] >= cutoff else STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-mean metric is worse than the median of
+    other trials' running means at the same step (reference:
+    schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric=None, mode="max", grace_period=1, min_samples_required=3, time_attr="training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self.time_attr = time_attr
+        self.histories: dict[str, list[float]] = {}
+
+    def on_trial_result(self, controller, trial, result):
+        metric = result.get(self.metric)
+        t = result.get(self.time_attr, trial.iteration)
+        if metric is None:
+            return CONTINUE
+        h = self.histories.setdefault(trial.trial_id, [])
+        h.append(float(metric))
+        if t <= self.grace:
+            return CONTINUE
+        # other trials' running means so far (clipped to this trial's step
+        # when they are ahead; used as-is when behind — poll order must not
+        # decide whether a comparison happens)
+        means = [
+            float(np.mean(v[: len(h)]))
+            for k, v in self.histories.items()
+            if k != trial.trial_id and len(v) > self.grace
+        ]
+        if len(means) < self.min_samples:
+            return CONTINUE
+        med = float(np.median(means))
+        mine = float(np.mean(h))
+        worse = mine < med if self.mode == "max" else mine > med
+        return STOP if worse else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: schedulers/pbt.py): every perturbation_interval, the
+    bottom-quantile trial clones the checkpoint + config of a top-quantile
+    trial (exploit), then perturbs mutation hyperparams (explore: x1.2 /
+    x0.8, or resample)."""
+
+    def __init__(
+        self,
+        metric=None,
+        mode="max",
+        perturbation_interval: int = 5,
+        hyperparam_mutations: dict | None = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        time_attr: str = "training_iteration",
+        seed: int | None = None,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.time_attr = time_attr
+        self.rng = np.random.default_rng(seed)
+        self.last_perturb: dict[str, int] = {}
+
+    def _score(self, trial):
+        v = trial.metric_at(self.metric)
+        if v is None:
+            return None
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, controller, trial, result):
+        t = result.get(self.time_attr, trial.iteration)
+        if t - self.last_perturb.get(trial.trial_id, 0) < self.interval:
+            return CONTINUE
+        self.last_perturb[trial.trial_id] = t
+        trials = [tr for tr in controller.trials if self._score(tr) is not None]
+        if len(trials) < 2:
+            return CONTINUE
+        ranked = sorted(trials, key=self._score)
+        k = max(1, int(len(ranked) * self.quantile))
+        bottom = ranked[:k]
+        top = ranked[-k:]
+        if trial in bottom and trial not in top:
+            donor = top[int(self.rng.integers(0, len(top)))]
+            if donor.checkpoint_path is None:
+                return CONTINUE  # nothing to exploit yet; keep training
+            new_config = self._explore(dict(donor.config))
+            controller.request_exploit(trial, donor, new_config)
+            return PAUSE  # controller restarts the trial with the new state
+        return CONTINUE
+
+    def _explore(self, config: dict) -> dict:
+        for k, spec in self.mutations.items():
+            if self.rng.random() < self.resample_p or k not in config:
+                if isinstance(spec, list):
+                    config[k] = spec[int(self.rng.integers(0, len(spec)))]
+                elif callable(spec):
+                    config[k] = spec()
+                else:
+                    config[k] = spec.sample(self.rng)
+            elif isinstance(config[k], (int, float)) and not isinstance(config[k], bool):
+                factor = 1.2 if self.rng.random() > 0.5 else 0.8
+                config[k] = type(config[k])(config[k] * factor)
+            elif isinstance(spec, list):
+                config[k] = spec[int(self.rng.integers(0, len(spec)))]
+        return config

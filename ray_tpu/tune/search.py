@@ -1,0 +1,126 @@
+"""Searchers: basic variants (grid x random), Optuna adapter, limiter.
+
+Reference parity: python/ray/tune/search/ — basic_variant.py,
+optuna/optuna_search.py, concurrency_limiter.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.tune.search_space import expand_grid, resolve
+
+
+class Searcher:
+    def set_search_properties(self, metric, mode, space):
+        self.metric, self.mode, self.space = metric, mode, space
+
+    def suggest(self, trial_id: str) -> dict | None:
+        """None = search exhausted."""
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: dict | None = None, error: bool = False):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid expansion x num_samples random sampling (reference:
+    search/basic_variant.py)."""
+
+    def __init__(self, num_samples: int = 1, seed: int | None = None):
+        self.num_samples = num_samples
+        self.rng = np.random.default_rng(seed)
+        self._queue: list[dict] | None = None
+
+    def set_search_properties(self, metric, mode, space):
+        super().set_search_properties(metric, mode, space)
+        self._queue = []
+        for _ in range(self.num_samples):
+            for variant in expand_grid(space):
+                self._queue.append(variant)
+
+    def suggest(self, trial_id):
+        if not self._queue:
+            return None
+        variant = self._queue.pop(0)
+        return resolve(variant, self.rng)
+
+
+class OptunaSearch(Searcher):
+    """Optuna TPE adapter (reference: search/optuna/optuna_search.py).
+    Requires `optuna` (not baked into this image — gated import)."""
+
+    def __init__(self, metric=None, mode=None, seed=None, num_samples: int = 64):
+        try:
+            import optuna
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires the 'optuna' package, which is not "
+                "installed in this environment"
+            ) from e
+        self._optuna = optuna
+        self.metric = metric
+        self.mode = mode
+        self.seed = seed
+        self.remaining = num_samples
+        self._trials: dict[str, object] = {}
+
+    def set_search_properties(self, metric, mode, space):
+        # the searcher's own explicit settings win over TuneConfig fallbacks
+        super().set_search_properties(self.metric or metric, self.mode or mode or "max", space)
+        sampler = self._optuna.samplers.TPESampler(seed=self.seed)
+        direction = "maximize" if self.mode == "max" else "minimize"
+        self._study = self._optuna.create_study(sampler=sampler, direction=direction)
+
+    def suggest(self, trial_id):
+        if self.remaining <= 0:
+            return None
+        self.remaining -= 1
+        from ray_tpu.tune.search_space import Categorical, Float, Integer
+
+        ot = self._study.ask()
+        self._trials[trial_id] = ot
+        config = {}
+        for k, v in self.space.items():
+            if isinstance(v, Categorical):
+                config[k] = ot.suggest_categorical(k, v.categories)
+            elif isinstance(v, Float):
+                config[k] = ot.suggest_float(k, v.lower, v.upper, log=v.log)
+            elif isinstance(v, Integer):
+                config[k] = ot.suggest_int(k, v.lower, v.upper - 1, log=v.log)
+            else:
+                config[k] = v
+        return config
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        ot = self._trials.pop(trial_id, None)
+        if ot is None:
+            return
+        if error or result is None or self.metric not in result:
+            self._study.tell(ot, state=self._optuna.trial.TrialState.FAIL)
+        else:
+            self._study.tell(ot, result[self.metric])
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggests (reference: search/concurrency_limiter.py)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set[str] = set()
+
+    def set_search_properties(self, metric, mode, space):
+        self.searcher.set_search_properties(metric, mode, space)
+
+    def suggest(self, trial_id):
+        if len(self._live) >= self.max_concurrent:
+            return "__WAIT__"
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None and cfg != "__WAIT__":
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result=result, error=error)

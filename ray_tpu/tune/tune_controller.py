@@ -1,0 +1,260 @@
+"""TuneController: the trial-driving loop.
+
+Reference parity: python/ray/tune/execution/tune_controller.py — launch
+trial actors under resource limits, consume reported results, route them
+through scheduler (stop/pause) and searcher (adaptive suggestion), commit
+checkpoints, restart exploited (PBT) trials from donor checkpoints.
+Trials run on the AIR actor-manager pattern (air/execution/_internal/
+actor_manager.py) — here directly on ray_tpu actors.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import threading
+import traceback
+import uuid
+
+import ray_tpu
+from ray_tpu.train import context as _train_ctx
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.tune import schedulers as sched
+from ray_tpu.tune.trial import ERROR, PAUSED, PENDING, RUNNING, TERMINATED, Trial
+
+POLL_INTERVAL_S = float(os.environ.get("RT_TUNE_POLL_INTERVAL_S", "0.05"))
+
+
+@ray_tpu.remote(max_concurrency=4)
+class TrialActor:
+    """Runs one trial's function in a thread; reports stream out via poll
+    (same topology as train's TrainWorker)."""
+
+    def __init__(self, trial_id: str, experiment_name: str):
+        self.trial_id = trial_id
+        self.experiment_name = experiment_name
+        self._reports: queue.Queue = queue.Queue()
+        self._status = "idle"
+
+    def run(self, fn, config: dict, latest_checkpoint_path: str | None):
+        ckpt = Checkpoint(latest_checkpoint_path) if latest_checkpoint_path else None
+        ctx = _train_ctx.TrainContext(
+            world_size=1,
+            world_rank=0,
+            local_rank=0,
+            local_world_size=1,
+            node_rank=0,
+            experiment_name=self.experiment_name,
+            trial_name=self.trial_id,
+            trial_id=self.trial_id,
+            report_fn=self._on_report,
+            latest_checkpoint=ckpt,
+        )
+        _train_ctx.set_context(ctx)
+        self._status = "running"
+        try:
+            fn(config)
+            self._status = "finished"
+        except BaseException:  # noqa: BLE001
+            self._status = "error"
+            raise RuntimeError(f"trial {self.trial_id} failed:\n{traceback.format_exc()}")
+        return self.trial_id
+
+    def _on_report(self, seq, metrics, checkpoint, checkpoint_dir_name):
+        self._reports.put(
+            {
+                "seq": seq,
+                "metrics": metrics,
+                "checkpoint_path": checkpoint.path if checkpoint else None,
+            }
+        )
+
+    def poll(self):
+        out = []
+        while True:
+            try:
+                out.append(self._reports.get_nowait())
+            except queue.Empty:
+                break
+        return {"status": self._status, "reports": out}
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable,
+        *,
+        searcher,
+        scheduler=None,
+        metric: str | None = None,
+        mode: str = "max",
+        max_concurrent: int | None = None,
+        run_dir: str,
+        experiment_name: str,
+        resources_per_trial: dict | None = None,
+        max_failures_per_trial: int = 0,
+    ):
+        self.trainable = trainable
+        self.searcher = searcher
+        self.scheduler = scheduler or sched.FIFOScheduler()
+        self.metric = metric
+        self.mode = mode
+        self.max_concurrent = max_concurrent or 4
+        self.run_dir = run_dir
+        self.experiment_name = experiment_name
+        self.resources = resources_per_trial or {"CPU": 1}
+        self.max_failures = max_failures_per_trial
+        self.trials: list[Trial] = []
+        self._actors: dict[str, object] = {}
+        self._run_refs: dict[str, object] = {}
+        self._failures: dict[str, int] = {}
+        self._pending: dict[str, list] = {}  # undelivered reports per trial
+        self._exhausted = False
+        os.makedirs(run_dir, exist_ok=True)
+
+    # ---------------- PBT hook ----------------
+    def request_exploit(self, trial: Trial, donor: Trial, new_config: dict):
+        trial.restore_config = new_config
+        trial.checkpoint_path = donor.checkpoint_path
+
+    # ---------------- main loop ----------------
+    def run(self) -> list[Trial]:
+        while True:
+            # paused trials (PBT exploits, failure retries) get freed slots
+            # BEFORE new suggestions — the population keeps training
+            self._resume_paused()
+            self._maybe_launch()
+            running = [t for t in self.trials if t.status == RUNNING]
+            paused = [t for t in self.trials if t.status == PAUSED]
+            if not running and not paused and self._exhausted:
+                break
+            if not running and not paused and not self._exhausted and not self._maybe_launch():
+                break
+            self._poll_running()
+        return self.trials
+
+    def _maybe_launch(self) -> bool:
+        launched = False
+        while sum(t.status == RUNNING for t in self.trials) < self.max_concurrent and not self._exhausted:
+            tid = uuid.uuid4().hex[:8]
+            cfg = self.searcher.suggest(tid)
+            if cfg == "__WAIT__":
+                break
+            if cfg is None:
+                self._exhausted = True
+                break
+            trial = Trial(config=cfg, trial_id=tid)
+            self.trials.append(trial)
+            self._start_trial(trial)
+            launched = True
+        return launched
+
+    def _start_trial(self, trial: Trial):
+        opts = {"num_cpus": self.resources.get("CPU", 1)}
+        if self.resources.get("TPU"):
+            opts["num_tpus"] = self.resources["TPU"]
+        actor = TrialActor.options(**opts).remote(trial.trial_id, self.experiment_name)
+        config = trial.restore_config if trial.restore_config else trial.config
+        trial.config = config
+        trial.restore_config = None
+        ref = actor.run.remote(self.trainable, config, trial.checkpoint_path)
+        self._actors[trial.trial_id] = actor
+        self._run_refs[trial.trial_id] = ref
+        trial.status = RUNNING
+
+    def _stop_trial(self, trial: Trial, status: str):
+        actor = self._actors.pop(trial.trial_id, None)
+        self._run_refs.pop(trial.trial_id, None)
+        self._pending.pop(trial.trial_id, None)  # stale reports die with the run
+        if actor is not None:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+        trial.status = status
+        if trial.is_finished:
+            self.searcher.on_trial_complete(trial.trial_id, result=trial.last_result, error=status == ERROR)
+            self.scheduler.on_trial_complete(self, trial)
+
+    def _resume_paused(self):
+        for trial in self.trials:
+            if trial.status == PAUSED and sum(t.status == RUNNING for t in self.trials) < self.max_concurrent:
+                self._start_trial(trial)
+
+    def _poll_running(self):
+        """One scheduler decision per trial per tick: trials advance in
+        lockstep even when a fast trial's reports all arrived at once, so
+        comparative schedulers (ASHA/median/PBT) see contemporaneous
+        snapshots (the reference delivers results one at a time too)."""
+        running = [t for t in self.trials if t.status == RUNNING]
+        if not running:
+            return
+        refs = [self._run_refs[t.trial_id] for t in running]
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=POLL_INTERVAL_S)
+        for trial in running:
+            actor = self._actors.get(trial.trial_id)
+            if actor is None:
+                continue
+            pending = self._pending.setdefault(trial.trial_id, [])
+            try:
+                p = ray_tpu.get(actor.poll.remote())
+                pending.extend(p["reports"])
+            except Exception:
+                trial.error = "actor died"
+                self._finish_or_retry(trial)
+                continue
+            decision = sched.CONTINUE
+            if pending:
+                decision = self._process_report(trial, pending.pop(0))
+            if decision == sched.STOP:
+                self._stop_trial(trial, TERMINATED)
+                continue
+            if decision == sched.PAUSE:
+                self._stop_trial(trial, PAUSED)
+                continue
+            # completion check: only once every report has been consumed
+            ref = self._run_refs.get(trial.trial_id)
+            if not pending and ref is not None:
+                ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+                if ready:
+                    # the run may have finished (and enqueued reports)
+                    # between our poll above and this check — drain again
+                    try:
+                        pending.extend(ray_tpu.get(actor.poll.remote())["reports"])
+                    except Exception:
+                        pass
+                    if pending:
+                        continue  # process them on subsequent ticks
+                    try:
+                        ray_tpu.get(ref)
+                        self._stop_trial(trial, TERMINATED)
+                    except Exception as e:
+                        trial.error = str(e)
+                        self._finish_or_retry(trial)
+
+    def _process_report(self, trial: Trial, rep: dict) -> str:
+        trial.iteration += 1
+        metrics = dict(rep["metrics"])
+        metrics.setdefault("training_iteration", trial.iteration)
+        metrics["trial_id"] = trial.trial_id
+        if rep["checkpoint_path"]:
+            trial.checkpoint_path = self._commit_checkpoint(trial, rep["checkpoint_path"])
+        trial.last_result = metrics
+        trial.metrics_history.append(metrics)
+        return self.scheduler.on_trial_result(self, trial, metrics)
+
+    def _finish_or_retry(self, trial: Trial):
+        n = self._failures.get(trial.trial_id, 0)
+        if n < self.max_failures:
+            self._failures[trial.trial_id] = n + 1
+            self._stop_trial(trial, PAUSED)  # requeue from last checkpoint
+        else:
+            self._stop_trial(trial, ERROR)
+
+    def _commit_checkpoint(self, trial: Trial, src: str) -> str:
+        dest = os.path.join(self.run_dir, trial.trial_id, f"checkpoint_{trial.iteration:06d}")
+        os.makedirs(dest, exist_ok=True)
+        if os.path.isdir(src):
+            shutil.copytree(src, dest, dirs_exist_ok=True)
+        return dest

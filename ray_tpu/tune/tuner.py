@@ -1,0 +1,134 @@
+"""Tuner: the public entry (reference: python/ray/tune/tuner.py +
+tune/tune.py:1161 run()).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.tune_controller import TuneController
+
+
+@dataclass
+class TuneConfig:
+    metric: str | None = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int | None = None
+    search_alg: Searcher | None = None
+    scheduler: object = None
+    seed: int | None = None
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable,
+        *,
+        param_space: dict | None = None,
+        tune_config: TuneConfig | None = None,
+        run_config=None,
+    ):
+        from ray_tpu.train.config import RunConfig
+
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        tc = self.tune_config
+        searcher = tc.search_alg or BasicVariantGenerator(num_samples=tc.num_samples, seed=tc.seed)
+        searcher.set_search_properties(tc.metric, tc.mode, self.param_space)
+        scheduler = tc.scheduler
+        if scheduler is not None:
+            # schedulers built without an explicit metric inherit TuneConfig's
+            # (otherwise result.get(None) silently degrades them to FIFO)
+            if getattr(scheduler, "metric", "absent") is None:
+                scheduler.metric = tc.metric
+            if getattr(scheduler, "mode", None) is None:
+                scheduler.mode = tc.mode
+
+        trainable, resources = _normalize_trainable(self.trainable)
+        run_dir = os.path.join(self.run_config.storage_path, self.run_config.name)
+        controller = TuneController(
+            trainable,
+            searcher=searcher,
+            scheduler=scheduler,
+            metric=tc.metric,
+            mode=tc.mode,
+            max_concurrent=tc.max_concurrent_trials,
+            run_dir=run_dir,
+            experiment_name=self.run_config.name,
+            resources_per_trial=resources,
+            max_failures_per_trial=self.run_config.failure_config.max_failures,
+        )
+        trials = controller.run()
+        return ResultGrid(trials, run_dir)
+
+
+def _normalize_trainable(trainable):
+    """Function trainables run as-is; a DataParallelTrainer instance becomes
+    a function that re-fits with the trial's config merged into
+    train_loop_config (reference: Tuner(trainer) integration)."""
+    from ray_tpu.train.trainer import DataParallelTrainer
+
+    if isinstance(trainable, DataParallelTrainer):
+        base = trainable
+
+        def fit_trainer(config):
+            from ray_tpu.train import context as _ctx
+            from ray_tpu.train import report
+
+            merged = dict(base.train_loop_config or {})
+            merged.update(config)
+            trainer = type(base)(
+                base.train_loop_per_worker,
+                train_loop_config=merged,
+                scaling_config=base.scaling_config,
+                run_config=base.run_config,
+                backend_config=base.backend_config,
+                datasets=base.datasets,
+            )
+            outer_ctx = _ctx.get_context()
+            result = trainer.fit(raise_on_error=False)
+            _ctx.set_context(outer_ctx)  # trainer.fit clears worker ctx driver-side
+            if result.error is not None:
+                raise result.error
+            for m in result.metrics_history:
+                report(dict(m))
+
+        return fit_trainer, {"CPU": 0.5}  # controller-only actor; workers hold resources
+    if callable(trainable):
+        return trainable, {"CPU": 1}
+    raise TypeError(f"unsupported trainable: {type(trainable)}")
+
+
+def with_parameters(fn, **params):
+    """Bind large objects to a trainable (reference: tune.with_parameters)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(config):
+        return fn(config, **params)
+
+    return wrapped
+
+
+def run(trainable, *, config=None, num_samples=1, metric=None, mode="max", scheduler=None, search_alg=None, **kw):
+    """Legacy API (reference: tune.run, tune/tune.py:1161)."""
+    t = Tuner(
+        trainable,
+        param_space=config,
+        tune_config=TuneConfig(
+            metric=metric, mode=mode, num_samples=num_samples, scheduler=scheduler, search_alg=search_alg
+        ),
+    )
+    return t.fit()

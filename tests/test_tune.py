@@ -1,0 +1,198 @@
+"""Tune layer tests (reference pattern: python/ray/tune/tests/)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+
+
+def _run_cfg(tmp_path, **kw):
+    return RunConfig(name="exp", storage_path=str(tmp_path), **kw)
+
+
+def test_grid_and_random_search(rt_start, tmp_path):
+    def trainable(config):
+        tune.report({"score": config["a"] * 10 + config["b"]})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"a": tune.grid_search([1, 2, 3]), "b": tune.uniform(0, 1)},
+        tune_config=tune.TuneConfig(metric="score", mode="max", num_samples=2, seed=7),
+        run_config=_run_cfg(tmp_path),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 6  # 3 grid values x 2 samples
+    best = grid.get_best_result("score", "max")
+    assert best.metrics["score"] > 30  # a=3 variant wins
+    df = grid.get_dataframe()
+    assert set(df["config/a"]) == {1, 2, 3}
+
+
+def test_choice_randint(rt_start, tmp_path):
+    def trainable(config):
+        tune.report({"v": config["c"] + config["i"]})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"c": tune.choice([100, 200]), "i": tune.randint(0, 10)},
+        tune_config=tune.TuneConfig(metric="v", mode="max", num_samples=4, seed=0),
+        run_config=_run_cfg(tmp_path),
+    ).fit()
+    for r in grid:
+        assert r.metrics["v"] >= 100
+
+
+def test_asha_stops_bad_trials(rt_start, tmp_path):
+    def trainable(config):
+        for step in range(20):
+            tune.report({"acc": config["q"] * (step + 1)})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([0.01, 0.02, 1.0, 2.0])},
+        tune_config=tune.TuneConfig(
+            metric="acc",
+            mode="max",
+            scheduler=tune.ASHAScheduler(metric="acc", mode="max", max_t=20, grace_period=2, reduction_factor=2),
+        ),
+        run_config=_run_cfg(tmp_path),
+    ).fit()
+    best = grid.get_best_result("acc", "max")
+    assert best.metrics["acc"] == 40.0  # q=2.0 survives to max_t
+    iters = {r.metrics["trial_id"]: r.metrics["training_iteration"] for r in grid}
+    assert min(iters.values()) < 20  # at least one trial stopped early
+
+
+def test_median_stopping(rt_start, tmp_path):
+    def trainable(config):
+        for step in range(10):
+            tune.report({"m": config["g"]})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"g": tune.grid_search([1.0, 1.0, 1.0, -5.0])},
+        tune_config=tune.TuneConfig(
+            metric="m",
+            mode="max",
+            scheduler=tune.MedianStoppingRule(metric="m", mode="max", grace_period=2, min_samples_required=2),
+        ),
+        run_config=_run_cfg(tmp_path),
+    ).fit()
+    assert len(grid) == 4
+    worst = [r for r in grid if r.metrics["m"] == -5.0][0]
+    assert worst.metrics["training_iteration"] < 10
+
+
+def test_pbt_exploit(rt_start, tmp_path):
+    def trainable(config):
+        import json
+        import tempfile
+
+        ckpt = tune.get_checkpoint()
+        step, w = 0, 0.0
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "s.json")) as f:
+                st = json.load(f)
+            step, w = st["step"], st["w"]
+        while step < 12:
+            w += config["lr"]
+            step += 1
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "s.json"), "w") as f:
+                json.dump({"step": step, "w": w}, f)
+            tune.report({"w": w}, checkpoint=tune.Checkpoint.from_directory(d))
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.001, 1.0])},
+        tune_config=tune.TuneConfig(
+            metric="w",
+            mode="max",
+            scheduler=tune.PopulationBasedTraining(
+                metric="w",
+                mode="max",
+                perturbation_interval=3,
+                hyperparam_mutations={"lr": [0.001, 1.0, 2.0]},
+                quantile_fraction=0.5,
+                seed=0,
+            ),
+        ),
+        run_config=_run_cfg(tmp_path),
+    ).fit()
+    assert len(grid) == 2
+    # the weak trial must have been exploited onto the strong config path
+    best = grid.get_best_result("w", "max")
+    assert best.metrics["w"] > 1.0
+    configs = {r.metrics["trial_id"]: r for r in grid}
+    assert all(r.metrics["w"] > 0.2 for r in grid), [r.metrics for r in grid]
+
+
+def test_concurrency_limiter(rt_start, tmp_path):
+    def trainable(config):
+        tune.report({"x": config["v"]})
+
+    searcher = tune.ConcurrencyLimiter(tune.BasicVariantGenerator(num_samples=4, seed=1), max_concurrent=1)
+    grid = tune.Tuner(
+        trainable,
+        param_space={"v": tune.uniform(0, 1)},
+        tune_config=tune.TuneConfig(metric="x", mode="max", search_alg=searcher),
+        run_config=_run_cfg(tmp_path),
+    ).fit()
+    assert len(grid) == 4
+
+
+def test_with_parameters_and_run(rt_start, tmp_path):
+    big = list(range(1000))
+
+    def trainable(config, data=None):
+        tune.report({"n": len(data) + config["k"]})
+
+    grid = tune.run(
+        tune.with_parameters(trainable, data=big),
+        config={"k": tune.grid_search([1, 2])},
+        metric="n",
+        mode="max",
+    )
+    assert sorted(r.metrics["n"] for r in grid) == [1001, 1002]
+
+
+def test_tuner_over_trainer(rt_start, tmp_path):
+    from ray_tpu.train import DataParallelTrainer, ScalingConfig
+
+    def loop(config):
+        from ray_tpu import train
+
+        train.report({"loss": 100.0 / config["lr"]})
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=_run_cfg(tmp_path / "inner"),
+    )
+    grid = tune.Tuner(
+        trainer,
+        param_space={"lr": tune.grid_search([1.0, 10.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=_run_cfg(tmp_path),
+    ).fit()
+    best = grid.get_best_result("loss", "min")
+    assert best.metrics["loss"] == 10.0
+
+
+def test_trial_failure_isolated(rt_start, tmp_path):
+    def trainable(config):
+        if config["v"] == 2:
+            raise ValueError("boom")
+        tune.report({"v": config["v"]})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"v": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(metric="v", mode="max"),
+        run_config=_run_cfg(tmp_path),
+    ).fit()
+    assert grid.num_errors == 1
+    assert grid.get_best_result("v", "max").metrics["v"] == 3
