@@ -79,20 +79,27 @@ def main():
     }
     sb = shard_batch(data, mesh)
 
-    # warmup/compile
+    # warmup/compile. NOTE: on the axon PJRT platform block_until_ready
+    # returns without synchronizing, so every sync below is a *host fetch*
+    # of a scalar — the only reliable execution barrier here. A scalar
+    # fetch costs ~nothing; fetching big arrays would hide compute behind
+    # tunnel transfer time (the round-1 failure mode, in both directions).
     for _ in range(2):
         state, metrics = step(state, sb)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])  # drain the dispatch queue before timing
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, sb)
-    jax.block_until_ready(metrics["loss"])
+    # the final loss depends on every prior step's state; fetching it to
+    # host forces the whole timed chain to actually execute
+    loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
 
     tokens_per_s = batch * seq * steps / dt
     achieved = flops_per_token(cfg, seq) * tokens_per_s
     mfu = achieved / (peak_flops(dev) * len(jax.devices()))
+    assert 0.0 < mfu <= 1.0, f"MFU {mfu} is not physically possible; harness is lying"
 
     print(
         json.dumps(
@@ -108,7 +115,7 @@ def main():
                     "n_devices": len(jax.devices()),
                     "batch": batch,
                     "seq": seq,
-                    "loss": round(float(metrics["loss"]), 4),
+                    "loss": round(loss, 4),
                 },
             }
         )

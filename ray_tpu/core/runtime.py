@@ -10,6 +10,7 @@ serves client RPCs from worker processes over their pipes.
 
 from __future__ import annotations
 
+import collections
 import logging
 import os
 import threading
@@ -39,17 +40,23 @@ from ray_tpu.exceptions import (
 logger = logging.getLogger(__name__)
 
 
+#: placeholder for a stream index whose item has not arrived (out-of-order
+#: replay gap). Distinct from None, which means end-of-stream to consumers.
+_STREAM_HOLE = object()
+
+
 class GenState:
     """Streaming-generator bookkeeping (reference: streaming returns in
     task_manager.h + _raylet.pyx:1067)."""
 
-    __slots__ = ("items", "finished", "error", "error_ref_made")
+    __slots__ = ("items", "finished", "error", "error_ref_made", "total_items")
 
     def __init__(self):
         self.items: list[ObjectID] = []
         self.finished = False
         self.error: BaseException | None = None
         self.error_ref_made = False
+        self.total_items = -1  # set when the items list is cleared on exhaustion
 
 
 class ActorState:
@@ -111,6 +118,7 @@ class Runtime:
         self.actors: dict[ActorID, ActorState] = {}
         self.placement_groups: dict[PlacementGroupID, PlacementGroupState] = {}
         self.generators: dict[ObjectID, GenState] = {}
+        self._gen_tombstones: collections.deque[ObjectID] = collections.deque()
         self._gen_cond = threading.Condition()
         self._functions: dict[str, Serialized] = {}
         self._local_fn_cache: dict[str, object] = {}
@@ -479,7 +487,13 @@ class Runtime:
             return  # dependency error already sealed
         worker.running_tasks[spec.task_id] = (spec, None)
         self.task_manager.mark_running(spec.task_id, node.node_id, worker.worker_id)
-        worker.send(msg)
+        try:
+            worker.send(msg)
+        except (OSError, ValueError):
+            # pipe closed between alive() check and send: route through the
+            # normal worker-death path (restart machinery + retry policy)
+            # instead of raising to the submit_actor_task caller
+            self._on_worker_death(node, worker, "send failed")
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         astate = self.actors.get(actor_id)
@@ -614,17 +628,26 @@ class Runtime:
                     gen.finished = True
                     gen.error = entry.error
                 if gen is not None:
-                    if index < len(gen.items):
+                    if index < len(gen.items) and gen.items[index] is not _STREAM_HOLE:
                         return gen.items[index]
-                    if gen.finished:
+                    if gen.finished and (index >= len(gen.items) or gen.total_items >= 0):
                         if gen.error is not None and not gen.error_ref_made:
                             gen.error_ref_made = True
                             err_id = ObjectID.for_task_return(gen_id.task_id(), len(gen.items) + 1)
                             self.store.put_error(err_id, gen.error)
                             gen.items.append(err_id)
                             return err_id
-                        if index >= len(gen.items):
-                            self.generators.pop(gen_id, None)  # exhausted: reclaim
+                        # exhausted: drop the item list (the obj ids live in the
+                        # store; consumers past this point only need StopIteration)
+                        # but keep the GenState as a bounded tombstone so a late
+                        # or repeat consumer terminates instead of blocking forever
+                        if gen.total_items < 0:
+                            gen.total_items = len(gen.items)
+                            gen.items = []
+                            self._gen_tombstones.append(gen_id)
+                            while len(self._gen_tombstones) > 4096:
+                                old = self._gen_tombstones.popleft()
+                                self.generators.pop(old, None)
                         return None
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
@@ -923,11 +946,25 @@ class Runtime:
     def _on_stream_item(self, msg: dict):
         task_id = msg["task_id"]
         obj_id = msg["obj_id"]
+        index = msg.get("index", None)
         self.put_payload(obj_id, msg["payload"])
         gen_id = ObjectID.for_task_return(task_id, 0)
         with self._gen_cond:
             gen = self.generators.setdefault(gen_id, GenState())
-            gen.items.append(obj_id)
+            # Place idempotently by the worker-assigned index so a retried
+            # attempt replaying its prefix never duplicates items consumers
+            # already saw (reference keys streamed returns by index).
+            if index is None:
+                gen.items.append(obj_id)
+            elif index < len(gen.items):
+                gen.items[index] = obj_id
+            else:
+                if index > len(gen.items):
+                    # protocol violation over in-order pipes; holes make the
+                    # reader wait (not truncate) until the item is replayed
+                    logger.error("stream gap for %s: got index %d at length %d", gen_id, index, len(gen.items))
+                    gen.items.extend([_STREAM_HOLE] * (index - len(gen.items)))
+                gen.items.append(obj_id)
             self._gen_cond.notify_all()
 
     def _finish_retirement(self, node: Node, w: WorkerHandle):

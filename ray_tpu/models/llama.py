@@ -40,6 +40,10 @@ class LlamaConfig:
     rms_eps: float = 1e-5
     dtype: str = "bfloat16"
     remat: bool = True
+    # jax.checkpoint_policies name: "nothing_saveable" recomputes the whole
+    # layer in backward (min HBM); "dots_with_no_batch_dims_saveable" keeps
+    # matmul outputs (fewer recompute FLOPs when HBM allows)
+    remat_policy: str = "nothing_saveable"
     scan_layers: bool = True
     attention_impl: str = "auto"  # auto | pallas | xla
     tie_embeddings: bool = False
@@ -180,7 +184,8 @@ def forward(params: dict, tokens, config: LlamaConfig, positions=None, mesh=None
 
     layer_fn = partial(_layer_fn, config=config, cos=cos, sin=sin, positions=positions, mesh=mesh)
     if config.remat:
-        layer_fn = jax.checkpoint(layer_fn, policy=jax.checkpoint_policies.nothing_saveable)
+        policy = getattr(jax.checkpoint_policies, config.remat_policy)
+        layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
     if config.scan_layers:
         def scan_body(carry, layer):

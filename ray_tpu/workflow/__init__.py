@@ -1,0 +1,7 @@
+"""Workflows are removed (parity with reference python/ray/workflow/__init__.py:1-4,
+which raises a deprecation error on import)."""
+
+raise ImportError(
+    "ray_tpu.workflow has been removed, matching the reference's deprecation "
+    "of Ray Workflows. Use ray_tpu tasks/actors or ray_tpu.dag instead."
+)
