@@ -102,7 +102,10 @@ def cleanup_orphan_segments():
 
 def write_to_shm(obj_id: ObjectID, s: Serialized) -> ShmDescriptor:
     total = s.total_size()
-    name = f"rt{_session_tag()}_" + obj_id.hex()[:24]
+    # full 40-hex object id: actor task ids share their first 12 bytes
+    # (actor_id prefix + seq), so any truncation collides across returns
+    # of one actor and concurrent writes would clobber each other
+    name = f"rt{_session_tag()}_" + obj_id.hex()
     try:
         seg = shared_memory.SharedMemory(name=name, create=True, size=max(total, 1))
     except FileExistsError:

@@ -1,0 +1,19 @@
+"""ray_tpu.rllib: reinforcement-learning library, TPU-native.
+
+Same topology as the reference RLlib (rllib/algorithms/algorithm.py:212 —
+Algorithm over an EnvRunnerGroup of rollout actors and a LearnerGroup of
+update actors) with the torch/DDP learner stack replaced by pure-JAX
+functional modules and jitted optax updates; multi-learner gradient sync
+rides ray_tpu.collective (host allreduce) or a GSPMD mesh instead of NCCL.
+
+Public surface:
+  - AlgorithmConfig builder (`PPOConfig`, `IMPALAConfig`)
+  - `config.build()` -> Algorithm; `algo.train()` -> result dict
+  - RLModule: functional JAX policy/value modules
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm  # noqa: F401
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.impala.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.core.rl_module import MLPModule, RLModule, RLModuleSpec  # noqa: F401

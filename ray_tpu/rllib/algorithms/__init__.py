@@ -1,0 +1,2 @@
+from ray_tpu.rllib.algorithms.algorithm import Algorithm  # noqa: F401
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig  # noqa: F401

@@ -1,0 +1,104 @@
+"""Algorithm: the RL training driver (reference:
+rllib/algorithms/algorithm.py:212 — step() :1189 delegating to per-algo
+training_step() :2273; EnvRunnerGroup + LearnerGroup topology).
+
+Holds the sampling/learning topology; per-algo subclasses implement
+`training_step()` and declare their Learner class. Checkpointable via
+save/restore of learner state (reference Checkpointable mixin,
+rllib/utils/checkpoints.py).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from pathlib import Path
+
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import MLPModule, RLModuleSpec
+from ray_tpu.rllib.env.env_runner import EnvRunnerGroup
+
+
+class Algorithm:
+    learner_cls: type = None  # set by subclasses
+
+    def __init__(self, config):
+        self.config = config
+        self.iteration = 0
+        self._total_env_steps = 0
+        self.setup()
+
+    # -- topology --
+    def setup(self):
+        cfg = self.config
+        assert cfg.env is not None, "config.environment(env=...) is required"
+        probe_spec = RLModuleSpec(cfg.module_class or MLPModule, None, None, cfg.model)
+        # spaces come from a throwaway env (cheap for gym registry ids)
+        import gymnasium as gym
+
+        probe = gym.make(cfg.env, **cfg.env_config)
+        obs_space, act_space = probe.observation_space, probe.action_space
+        probe.close()
+        self.module_spec = RLModuleSpec(probe_spec.module_class, obs_space, act_space, cfg.model)
+
+        self.env_runner_group = EnvRunnerGroup(
+            self.module_spec,
+            cfg.env,
+            cfg.env_config,
+            num_env_runners=cfg.num_env_runners,
+            num_envs_per_env_runner=cfg.num_envs_per_env_runner,
+            seed=cfg.seed,
+        )
+        from ray_tpu.rllib.core.learner import LearnerGroup
+
+        self.learner_group = LearnerGroup(type(self).learner_cls, self.module_spec, cfg, num_learners=cfg.num_learners)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    # -- public API --
+    def train(self) -> dict:
+        t0 = time.perf_counter()
+        self.iteration += 1
+        result = self.training_step()
+        result.setdefault("training_iteration", self.iteration)
+        result.setdefault("time_this_iter_s", time.perf_counter() - t0)
+        result.setdefault("num_env_steps_sampled_lifetime", self._total_env_steps)
+        return result
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    def stop(self):
+        self.env_runner_group.stop()
+        self.learner_group.stop()
+
+    # -- checkpointing --
+    def save_to_path(self, path: str) -> str:
+        p = Path(path)
+        p.mkdir(parents=True, exist_ok=True)
+        state = {
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+            "learner": self.learner_group.get_state(),
+        }
+        with open(p / "algorithm_state.pkl", "wb") as f:
+            pickle.dump(state, f)
+        return str(p)
+
+    def restore_from_path(self, path: str):
+        with open(Path(path) / "algorithm_state.pkl", "rb") as f:
+            state = pickle.load(f)
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+        self.learner_group.set_state(state["learner"])
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    # -- shared helpers --
+    def _merge_runner_metrics(self, metrics: list[dict]) -> dict:
+        returns = [m["episode_return_mean"] for m in metrics if np.isfinite(m.get("episode_return_mean", float("nan")))]
+        return {
+            "env_runners": {
+                "episode_return_mean": float(np.mean(returns)) if returns else float("nan"),
+                "num_episodes": int(sum(m.get("num_episodes", 0) for m in metrics)),
+            }
+        }

@@ -1,0 +1,85 @@
+"""AlgorithmConfig builder (reference: rllib/algorithms/algorithm_config.py —
+fluent .environment()/.env_runners()/.training()/.learners() chaining that
+`build_algo()`s into an Algorithm)."""
+
+from __future__ import annotations
+
+import copy
+
+
+class AlgorithmConfig:
+    algo_class: type | None = None
+
+    def __init__(self):
+        # environment
+        self.env: str | None = None
+        self.env_config: dict = {}
+        # env runners
+        self.num_env_runners: int = 0
+        self.num_envs_per_env_runner: int = 1
+        self.rollout_fragment_length: int = 200
+        # learners
+        self.num_learners: int = 0
+        # training
+        self.lr: float = 5e-4
+        self.gamma: float = 0.99
+        self.train_batch_size: int = 4000
+        self.minibatch_size: int | None = None
+        self.num_epochs: int = 1
+        self.grad_clip: float | None = None
+        self.model: dict = {}
+        # rl module
+        self.module_class: type | None = None
+        # debugging
+        self.seed: int = 0
+
+    # -- fluent sections (reference algorithm_config.py API shape) --
+    def environment(self, env: str | None = None, *, env_config: dict | None = None):
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def env_runners(self, *, num_env_runners: int | None = None, num_envs_per_env_runner: int | None = None, rollout_fragment_length: int | None = None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def learners(self, *, num_learners: int | None = None):
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def rl_module(self, *, module_class: type | None = None, model_config: dict | None = None):
+        if module_class is not None:
+            self.module_class = module_class
+        if model_config is not None:
+            self.model = dict(model_config)
+        return self
+
+    def debugging(self, *, seed: int | None = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build_algo(self):
+        assert self.algo_class is not None, "use a concrete config (PPOConfig, IMPALAConfig)"
+        return self.algo_class(self.copy())
+
+    # reference spelling kept as an alias
+    build = build_algo

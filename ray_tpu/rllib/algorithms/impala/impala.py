@@ -1,0 +1,141 @@
+"""IMPALA (reference: rllib/algorithms/impala/impala.py — async off-policy
+actor-learner with V-trace correction; multi-learner via DDP there, via
+ray_tpu.collective grad-allreduce here).
+
+The learner consumes fixed-length [N, T] trajectory sequences; V-trace
+targets (Espeholt et al. 2018) are computed *inside* the jitted loss with
+a reversed lax.scan, so the whole update stays one XLA program. Sampling
+overlaps learning one iteration deep (in-flight sample refs), the
+synchronous-queue shape of the reference's aggregator-less small config.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.rho_clip = 1.0
+        self.c_clip = 1.0
+        self.rollout_fragment_length = 50
+        self.train_batch_size = 500
+        self.grad_clip = 40.0
+        self.num_epochs = 1
+        self.minibatch_size = None
+
+    @property
+    def algo_class(self):
+        return IMPALA
+
+
+def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value, mask, gamma, rho_clip, c_clip):
+    """V-trace targets + policy-gradient advantages over [N, T] sequences.
+
+    values: [N, T] current value estimates; bootstrap_value: [N].
+    Returns (vs [N,T], pg_advantages [N,T]); padded steps (mask==0) pass
+    through their value estimate.
+    """
+    rho = jnp.exp(target_logp - behavior_logp)
+    rho_bar = jnp.minimum(rho_clip, rho) * mask
+    c_bar = jnp.minimum(c_clip, rho) * mask
+    v_next = jnp.concatenate([values[:, 1:], bootstrap_value[:, None]], axis=1)
+    delta = rho_bar * (rewards + gamma * v_next - values)
+
+    def body(carry, xs):
+        d_t, c_t, vnext_t, v_t = xs
+        # carry = vs_{t+1} - V(x_{t+1})
+        vs_minus_v = d_t + gamma * c_t * carry
+        return vs_minus_v, vs_minus_v
+
+    xs = (delta.T, c_bar.T, v_next.T, values.T)  # scan over time, reversed
+    _, out = jax.lax.scan(body, jnp.zeros(values.shape[0]), xs, reverse=True)
+    vs = values + out.T
+    vs_next = jnp.concatenate([vs[:, 1:], bootstrap_value[:, None]], axis=1)
+    pg_adv = rho_bar * (rewards + gamma * vs_next - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class IMPALALearner(Learner):
+    def compute_losses(self, params, batch):
+        cfg = self.config
+        N, T = batch["rewards"].shape
+        obs_flat = batch["obs"].reshape((N * (T + 1),) + batch["obs"].shape[2:])
+        out = self.module.forward(params, obs_flat)
+        dist = self.module.action_dist_cls
+        inputs = out["action_dist_inputs"].reshape(N, T + 1, -1)[:, :-1]
+        values_all = out["vf"].reshape(N, T + 1)
+        values, bootstrap = values_all[:, :-1], values_all[:, -1]
+        bootstrap = jnp.where(batch["terminated"], 0.0, bootstrap)
+
+        target_logp = dist.logp(inputs, batch["actions"])
+        mask = batch["mask"]
+        vs, pg_adv = vtrace(
+            batch["logp"], target_logp, batch["rewards"], values, bootstrap, mask, cfg.gamma, cfg.rho_clip, cfg.c_clip
+        )
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        policy_loss = -jnp.sum(target_logp * pg_adv * mask) / denom
+        vf_loss = 0.5 * jnp.sum(((vs - values) ** 2) * mask) / denom
+        entropy = jnp.sum(dist.entropy(inputs) * mask) / denom
+        total = policy_loss + cfg.vf_loss_coeff * vf_loss - cfg.entropy_coeff * entropy
+        return total, {"total_loss": total, "policy_loss": policy_loss, "vf_loss": vf_loss, "entropy": entropy}
+
+
+class IMPALA(Algorithm):
+    learner_cls = IMPALALearner
+
+    def setup(self):
+        super().setup()
+        self._inflight = None  # one-iteration-deep sample pipeline
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        if self._inflight is not None:
+            segments, runner_metrics = self.env_runner_group.collect(self._inflight)
+            self._inflight = None
+        else:
+            segments, runner_metrics = self.env_runner_group.sample(cfg.train_batch_size)
+        if cfg.num_env_runners > 0:
+            # off-policy: next iteration's sample runs on current (soon
+            # stale) weights while the learners update — V-trace corrects
+            self._inflight = self.env_runner_group.sample_async(cfg.train_batch_size)
+        self._total_env_steps += sum(len(s["actions"]) for s in segments)
+        batch = self._build_sequences(segments)
+        learner_metrics = self.learner_group.update(batch, num_epochs=cfg.num_epochs, shuffle=False)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        result = self._merge_runner_metrics(runner_metrics)
+        result["learners"] = {k: float(np.mean([m[k] for m in learner_metrics])) for k in learner_metrics[0]}
+        return result
+
+    def _build_sequences(self, segments: list[dict]) -> dict:
+        """Pad each segment to rollout_fragment_length -> [N, T(+1)]."""
+        T = self.config.rollout_fragment_length
+        obs_shape = segments[0]["obs"].shape[1:]
+        N = len(segments)
+        obs = np.zeros((N, T + 1) + obs_shape, np.float32)
+        actions = np.zeros((N, T) + segments[0]["actions"].shape[1:], segments[0]["actions"].dtype)
+        rewards = np.zeros((N, T), np.float32)
+        logp = np.zeros((N, T), np.float32)
+        mask = np.zeros((N, T), np.float32)
+        terminated = np.zeros((N,), bool)
+        for i, s in enumerate(segments):
+            t = min(len(s["actions"]), T)
+            obs[i, : t + 1] = s["obs"][: t + 1]
+            obs[i, t + 1 :] = s["obs"][t]  # repeat last obs into padding
+            actions[i, :t] = s["actions"][:t]
+            rewards[i, :t] = s["rewards"][:t]
+            logp[i, :t] = s["logp"][:t]
+            mask[i, :t] = 1.0
+            terminated[i] = bool(s["terminated"]) if t == len(s["actions"]) else False
+        return {"obs": obs, "actions": actions, "rewards": rewards, "logp": logp, "mask": mask, "terminated": terminated}

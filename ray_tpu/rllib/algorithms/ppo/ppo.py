@@ -1,0 +1,126 @@
+"""PPO (reference: rllib/algorithms/ppo/ppo.py:364 PPOConfig, :390
+training_step; loss in rllib/algorithms/ppo/torch/ppo_torch_learner.py).
+
+Clipped-surrogate PPO with GAE. The learner update is one jitted
+grad+apply per minibatch; sampling stays on CPU env runners. Advantages
+are standardized over the train batch (reference's
+standardize_fields=["advantages"])."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.lambda_ = 0.95
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.num_epochs = 10
+        self.minibatch_size = 128
+        self.train_batch_size = 2000
+        self.grad_clip = 0.5
+
+    @property
+    def algo_class(self):
+        return PPO
+
+
+class PPOLearner(Learner):
+    def compute_losses(self, params, batch):
+        cfg = self.config
+        out = self.module.forward_train(params, batch)
+        dist = self.module.action_dist_cls
+        inputs = out["action_dist_inputs"]
+        logp = dist.logp(inputs, batch["actions"])
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["advantages"]
+        surrogate = jnp.minimum(ratio * adv, jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * adv)
+        policy_loss = -jnp.mean(surrogate)
+
+        vf = out["vf"]
+        vf_err = (vf - batch["value_targets"]) ** 2
+        vf_clipped = batch["vf_preds"] + jnp.clip(vf - batch["vf_preds"], -cfg.vf_clip_param, cfg.vf_clip_param)
+        vf_err_clipped = (vf_clipped - batch["value_targets"]) ** 2
+        vf_loss = 0.5 * jnp.mean(jnp.maximum(vf_err, vf_err_clipped))
+
+        entropy = jnp.mean(dist.entropy(inputs))
+        total = policy_loss + cfg.vf_loss_coeff * vf_loss - cfg.entropy_coeff * entropy
+        return total, {
+            "total_loss": total,
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_kl": jnp.mean(batch["logp"] - logp),
+        }
+
+
+class PPO(Algorithm):
+    learner_cls = PPOLearner
+
+    def setup(self):
+        super().setup()
+        module = self.module_spec.build()
+        self._vf_fwd = jax.jit(lambda p, o: module.forward(p, o)["vf"])
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        segments, runner_metrics = self.env_runner_group.sample(cfg.train_batch_size)
+        self._total_env_steps += sum(len(s["actions"]) for s in segments)
+
+        params = self.learner_group.get_weights()
+        batch = self._build_train_batch(segments, params)
+        learner_metrics = self.learner_group.update(
+            batch, minibatch_size=cfg.minibatch_size, num_epochs=cfg.num_epochs, seed=cfg.seed + self.iteration
+        )
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+        result = self._merge_runner_metrics(runner_metrics)
+        result["learners"] = {k: float(np.mean([m[k] for m in learner_metrics])) for k in learner_metrics[0]}
+        return result
+
+    def _build_train_batch(self, segments: list[dict], params) -> dict:
+        """GAE (generalized advantage estimation) per segment, then a flat
+        row-batch. Bootstrap value for cut/truncated segments comes from a
+        forward pass on each segment's final obs."""
+        cfg = self.config
+        boot_obs = np.stack([s["obs"][-1] for s in segments])
+        boot_vals = np.asarray(self._vf_fwd(params, jnp.asarray(boot_obs)))
+        obs, actions, logp, advs, targets, vf_preds = [], [], [], [], [], []
+        for s, bv in zip(segments, boot_vals):
+            T = len(s["actions"])
+            v = s["vf_preds"]
+            # final v_next is 0 past a terminal, else the bootstrap value
+            v_next = np.append(v[1:], 0.0 if s["terminated"] else bv)
+            delta = s["rewards"] + cfg.gamma * v_next - v
+            adv = np.zeros(T, dtype=np.float32)
+            acc = 0.0
+            for t in range(T - 1, -1, -1):
+                acc = delta[t] + cfg.gamma * cfg.lambda_ * acc
+                adv[t] = acc
+            obs.append(s["obs"][:-1])
+            actions.append(s["actions"])
+            logp.append(s["logp"])
+            vf_preds.append(v)
+            advs.append(adv)
+            targets.append(adv + v)
+        adv_all = np.concatenate(advs)
+        adv_all = (adv_all - adv_all.mean()) / (adv_all.std() + 1e-8)
+        return {
+            "obs": np.concatenate(obs),
+            "actions": np.concatenate(actions),
+            "logp": np.concatenate(logp),
+            "advantages": adv_all.astype(np.float32),
+            "value_targets": np.concatenate(targets).astype(np.float32),
+            "vf_preds": np.concatenate(vf_preds).astype(np.float32),
+        }

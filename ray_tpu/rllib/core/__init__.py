@@ -1,0 +1,3 @@
+from ray_tpu.rllib.core.distributions import Categorical, DiagGaussian  # noqa: F401
+from ray_tpu.rllib.core.learner import Learner, LearnerGroup  # noqa: F401
+from ray_tpu.rllib.core.rl_module import MLPModule, RLModule, RLModuleSpec  # noqa: F401

@@ -1,0 +1,77 @@
+"""Action distributions as pure functions over distribution inputs.
+
+Reference parity: rllib/models/distributions.py + torch distribution
+wrappers (rllib/models/torch/torch_distributions.py). Here a distribution
+is a namespace of pure jnp functions keyed on the module's output tensor
+("logits" / mean+logstd), so they compose with jit/grad with no objects on
+the trace.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Categorical:
+    """Discrete actions from unnormalized logits [..., n_actions]."""
+
+    @staticmethod
+    def sample(key, logits):
+        return jax.random.categorical(key, logits, axis=-1)
+
+    @staticmethod
+    def logp(logits, actions):
+        logp_all = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.take_along_axis(logp_all, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    @staticmethod
+    def entropy(logits):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    @staticmethod
+    def kl(logits_p, logits_q):
+        logp = jax.nn.log_softmax(logits_p, axis=-1)
+        logq = jax.nn.log_softmax(logits_q, axis=-1)
+        return jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)
+
+    @staticmethod
+    def deterministic(logits):
+        return jnp.argmax(logits, axis=-1)
+
+
+class DiagGaussian:
+    """Continuous actions; inputs [..., 2*dim] = concat(mean, log_std)."""
+
+    @staticmethod
+    def _split(inputs):
+        mean, log_std = jnp.split(inputs, 2, axis=-1)
+        return mean, jnp.clip(log_std, -20.0, 2.0)
+
+    @staticmethod
+    def sample(key, inputs):
+        mean, log_std = DiagGaussian._split(inputs)
+        return mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
+
+    @staticmethod
+    def logp(inputs, actions):
+        mean, log_std = DiagGaussian._split(inputs)
+        var = jnp.exp(2 * log_std)
+        return jnp.sum(-0.5 * ((actions - mean) ** 2 / var + 2 * log_std + jnp.log(2 * jnp.pi)), axis=-1)
+
+    @staticmethod
+    def entropy(inputs):
+        _, log_std = DiagGaussian._split(inputs)
+        return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+    @staticmethod
+    def kl(inputs_p, inputs_q):
+        mp, lp = DiagGaussian._split(inputs_p)
+        mq, lq = DiagGaussian._split(inputs_q)
+        return jnp.sum(lq - lp + (jnp.exp(2 * lp) + (mp - mq) ** 2) / (2 * jnp.exp(2 * lq)) - 0.5, axis=-1)
+
+    @staticmethod
+    def deterministic(inputs):
+        mean, _ = DiagGaussian._split(inputs)
+        return mean

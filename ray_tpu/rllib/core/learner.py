@@ -1,0 +1,178 @@
+"""Learner / LearnerGroup (reference: rllib/core/learner/learner.py:112,
+learner_group.py:101,256).
+
+TPU-native shape: a Learner owns a functional RLModule's params + optax
+state and a *jitted* minibatch step; `compute_losses` is the per-algorithm
+override point (reference learner.py:929). Multi-learner data parallelism
+replaces torch DDP with an explicit grads-allreduce through
+ray_tpu.collective between the jitted grad and apply steps (the host/DCN
+path; single-process multi-device learners instead jit over a mesh).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu import collective
+
+
+class Learner:
+    def __init__(self, module_spec, config):
+        self.config = config
+        self.module = module_spec.build()
+        self.params = None
+        self.opt_state = None
+        self._step = None
+        self._metrics: dict = {}
+
+    # -- construction --
+    def build(self, seed: int = 0):
+        self.params = self.module.init(jax.random.PRNGKey(seed))
+        self.optimizer = self._make_optimizer()
+        self.opt_state = self.optimizer.init(self.params)
+        self._grad_fn = jax.jit(jax.grad(self._loss_for_grad, has_aux=True))
+        self._apply_fn = jax.jit(self._apply)
+
+    def _make_optimizer(self):
+        clip = getattr(self.config, "grad_clip", None)
+        tx = optax.adam(self.config.lr)
+        if clip:
+            tx = optax.chain(optax.clip_by_global_norm(clip), tx)
+        return tx
+
+    def _loss_for_grad(self, params, batch):
+        loss, aux = self.compute_losses(params, batch)
+        return loss, aux
+
+    def _apply(self, params, opt_state, grads):
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    # -- per-algorithm override --
+    def compute_losses(self, params, batch) -> tuple[jax.Array, dict]:
+        raise NotImplementedError
+
+    # -- gradient sync seam (overridden in multi-learner actors) --
+    def _sync_grads(self, grads):
+        return grads
+
+    # -- update loop --
+    def update(self, batch: dict, minibatch_size: int | None = None, num_epochs: int = 1, shuffle: bool = True, seed: int = 0) -> dict:
+        """Minibatch-SGD over `batch` (row-major dict of arrays);
+        returns averaged loss metrics."""
+        n = len(batch["obs"])
+        minibatch_size = minibatch_size or n
+        rng = np.random.default_rng(seed)
+        metrics_acc: dict[str, list] = {}
+        for _ in range(num_epochs):
+            idx = rng.permutation(n) if shuffle else np.arange(n)
+            for start in range(0, n, minibatch_size):
+                rows = idx[start : start + minibatch_size]
+                if len(rows) < max(2, minibatch_size // 2) and start > 0:
+                    continue  # drop tiny trailing minibatch
+                mb = {k: jnp.asarray(v[rows]) for k, v in batch.items() if hasattr(v, "__getitem__")}
+                grads, aux = self._grad_fn(self.params, mb)
+                grads = self._sync_grads(grads)
+                self.params, self.opt_state = self._apply_fn(self.params, self.opt_state, grads)
+                for k, v in aux.items():
+                    metrics_acc.setdefault(k, []).append(float(v))
+        self._metrics = {k: float(np.mean(v)) for k, v in metrics_acc.items()}
+        return self._metrics
+
+    # -- state / weights --
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, params):
+        self.params = jax.tree.map(jnp.asarray, params)
+
+    def get_state(self) -> dict:
+        return {
+            "params": jax.tree.map(np.asarray, self.params),
+            "opt_state": jax.tree.map(lambda x: np.asarray(x) if hasattr(x, "shape") else x, self.opt_state),
+        }
+
+    def set_state(self, state: dict):
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.opt_state = jax.tree.map(
+            lambda cur, new: jnp.asarray(new) if hasattr(cur, "shape") else new, self.opt_state, state["opt_state"]
+        )
+
+
+class _LearnerActorMixin:
+    """Gradient allreduce over the learner collective group."""
+
+    def setup_collective(self, world_size: int, rank: int, group_name: str):
+        self._group_name = group_name
+        collective.init_collective_group(world_size, rank, group_name=group_name)
+
+    def _sync_grads(self, grads):
+        if getattr(self, "_group_name", None) is None:
+            return grads
+        flat, treedef = jax.tree.flatten(grads)
+        sizes = [int(np.prod(x.shape)) for x in flat]
+        buf = np.concatenate([np.asarray(x, dtype=np.float32).ravel() for x in flat])
+        out = collective.allreduce(buf, group_name=self._group_name)
+        out = out / collective.get_world_size(self._group_name)
+        parts = np.split(out, np.cumsum(sizes)[:-1])
+        return jax.tree.unflatten(treedef, [jnp.asarray(p.reshape(x.shape)) for p, x in zip(parts, flat)])
+
+
+class LearnerGroup:
+    """0 remote learners -> one in-process Learner; N >= 1 -> N learner
+    actors, per-update batch rows sharded across them, grads allreduced
+    (reference learner_group.py:256 update)."""
+
+    def __init__(self, learner_cls, module_spec, config, num_learners: int = 0):
+        self.num_learners = num_learners
+        if num_learners == 0:
+            self._local = learner_cls(module_spec, config)
+            self._local.build(seed=config.seed)
+            self._actors = []
+        else:
+            self._local = None
+            actor_cls = ray_tpu.remote(type(f"_{learner_cls.__name__}Actor", (_LearnerActorMixin, learner_cls), {}))
+            self._actors = [actor_cls.remote(module_spec, config) for _ in range(num_learners)]
+            ray_tpu.get([a.build.remote(seed=config.seed) for a in self._actors])
+            group = f"rllib_learners_{id(self)}"
+            ray_tpu.get([a.setup_collective.remote(num_learners, i, group) for i, a in enumerate(self._actors)])
+            # identical init on every learner (same seed) = synced start
+
+    def update(self, batch: dict, **kw) -> list[dict]:
+        if self._local is not None:
+            return [self._local.update(batch, **kw)]
+        n = len(batch["obs"])
+        shard = max(1, n // len(self._actors))
+        refs = []
+        for i, a in enumerate(self._actors):
+            rows = slice(i * shard, n if i == len(self._actors) - 1 else (i + 1) * shard)
+            sub = {k: v[rows] for k, v in batch.items()}
+            refs.append(a.update.remote(sub, **kw))
+        return ray_tpu.get(refs)
+
+    def get_weights(self):
+        if self._local is not None:
+            return self._local.get_weights()
+        return ray_tpu.get(self._actors[0].get_weights.remote())
+
+    def get_state(self) -> dict:
+        if self._local is not None:
+            return self._local.get_state()
+        return ray_tpu.get(self._actors[0].get_state.remote())
+
+    def set_state(self, state: dict):
+        if self._local is not None:
+            self._local.set_state(state)
+        else:
+            ray_tpu.get([a.set_state.remote(state) for a in self._actors])
+
+    def stop(self):
+        for a in self._actors:
+            ray_tpu.kill(a)
+        self._actors = []

@@ -1,0 +1,119 @@
+"""RLModule: the model abstraction (reference: rllib/core/rl_module/rl_module.py).
+
+TPU-native shape: a module is *stateless* — `init` returns a param pytree
+and `forward_*` are pure functions of (params, batch), so the same module
+object can be jitted on a learner mesh, vmapped in an env runner, and
+serialized by spec (class + config) without touching torch Modules.
+
+forward_inference / forward_exploration / forward_train mirror the
+reference's three passes (rl_module.py forward_inference/_exploration/_train).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.core.distributions import Categorical, DiagGaussian
+
+
+def _space_size(space) -> int:
+    if hasattr(space, "n"):  # Discrete
+        return int(space.n)
+    return int(np.prod(space.shape))
+
+
+@dataclass
+class RLModuleSpec:
+    """Serializable recipe for constructing a module on any worker
+    (reference: rllib/core/rl_module/rl_module.py RLModuleSpec)."""
+
+    module_class: type | None = None
+    observation_space: Any = None
+    action_space: Any = None
+    model_config: dict = field(default_factory=dict)
+
+    def build(self) -> "RLModule":
+        cls = self.module_class or MLPModule
+        return cls(self.observation_space, self.action_space, self.model_config)
+
+
+class RLModule:
+    """Base: subclasses define init(key) -> params and forward(params, obs)
+    -> {"action_dist_inputs", "vf"}; distribution cls picked from the
+    action space."""
+
+    def __init__(self, observation_space, action_space, model_config: dict | None = None):
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.model_config = dict(model_config or {})
+        self.action_dist_cls = Categorical if hasattr(action_space, "n") else DiagGaussian
+
+    # -- to implement --
+    def init(self, key) -> Any:
+        raise NotImplementedError
+
+    def forward(self, params, obs) -> dict:
+        raise NotImplementedError
+
+    # -- shared passes (reference rl_module.py forward_* split) --
+    def forward_inference(self, params, obs) -> dict:
+        return self.forward(params, obs)
+
+    def forward_exploration(self, params, obs) -> dict:
+        return self.forward(params, obs)
+
+    def forward_train(self, params, batch) -> dict:
+        return self.forward(params, batch["obs"])
+
+    def spec(self) -> RLModuleSpec:
+        return RLModuleSpec(type(self), self.observation_space, self.action_space, self.model_config)
+
+
+class MLPModule(RLModule):
+    """Separate policy and value MLP towers with tanh activations — the
+    default fcnet of the reference (rllib catalog fcnet_hiddens=[256,256])
+    as a functional pytree."""
+
+    def __init__(self, observation_space, action_space, model_config=None):
+        super().__init__(observation_space, action_space, model_config)
+        self.hiddens = tuple(self.model_config.get("fcnet_hiddens", (256, 256)))
+        self.obs_dim = _space_size(observation_space)
+        if hasattr(action_space, "n"):
+            self.out_dim = int(action_space.n)
+        else:
+            self.out_dim = 2 * int(np.prod(action_space.shape))
+
+    def _mlp_init(self, key, sizes, final_scale=0.01):
+        params = []
+        for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+            key, k = jax.random.split(key)
+            scale = final_scale if i == len(sizes) - 2 else 1.0
+            w = jax.random.orthogonal(k, max(fan_in, fan_out))[:fan_in, :fan_out] * scale
+            params.append({"w": w.astype(jnp.float32), "b": jnp.zeros((fan_out,), jnp.float32)})
+        return params
+
+    def init(self, key):
+        kp, kv = jax.random.split(key)
+        return {
+            "pi": self._mlp_init(kp, (self.obs_dim, *self.hiddens, self.out_dim), final_scale=0.01),
+            "vf": self._mlp_init(kv, (self.obs_dim, *self.hiddens, 1), final_scale=1.0),
+        }
+
+    @staticmethod
+    def _mlp_apply(layers, x):
+        for layer in layers[:-1]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        last = layers[-1]
+        return x @ last["w"] + last["b"]
+
+    def forward(self, params, obs):
+        obs = obs.reshape(obs.shape[0], -1).astype(jnp.float32)
+        return {
+            "action_dist_inputs": self._mlp_apply(params["pi"], obs),
+            "vf": self._mlp_apply(params["vf"], obs)[..., 0],
+        }

@@ -1,0 +1,2 @@
+from ray_tpu.rllib.env.env_runner import EnvRunnerGroup, SingleAgentEnvRunner  # noqa: F401
+from ray_tpu.rllib.env.episode import Episode  # noqa: F401

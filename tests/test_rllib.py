@@ -1,0 +1,165 @@
+"""RLlib-equivalent tests (reference strategy: rllib tuned_examples as
+"learning tests" asserting reward thresholds + unit tests of loss math)."""
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------- units
+def test_categorical_distribution():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core.distributions import Categorical
+
+    logits = jnp.asarray([[2.0, 0.0, -1.0], [0.0, 0.0, 0.0]])
+    lp = Categorical.logp(logits, jnp.asarray([0, 2]))
+    assert lp.shape == (2,)
+    np.testing.assert_allclose(lp[1], np.log(1 / 3), rtol=1e-5)
+    ent = Categorical.entropy(logits)
+    np.testing.assert_allclose(ent[1], np.log(3), rtol=1e-5)
+    assert float(Categorical.kl(logits, logits)[0]) == pytest.approx(0.0, abs=1e-6)
+    samples = Categorical.sample(jax.random.PRNGKey(0), jnp.tile(logits[:1], (2000, 1)))
+    # argmax class dominates
+    assert np.bincount(np.asarray(samples), minlength=3).argmax() == 0
+
+
+def test_vtrace_on_policy_reduces_to_discounted_returns():
+    """With target==behavior (rho=c=1), V-trace targets equal the full
+    discounted return + bootstrap (lambda=1 TD), per the IMPALA paper."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.impala.impala import vtrace
+
+    rng = np.random.default_rng(0)
+    N, T = 3, 10
+    gamma = 0.9
+    rewards = rng.normal(size=(N, T)).astype(np.float32)
+    values = rng.normal(size=(N, T)).astype(np.float32)
+    bootstrap = rng.normal(size=(N,)).astype(np.float32)
+    logp = rng.normal(size=(N, T)).astype(np.float32)
+    mask = np.ones((N, T), np.float32)
+
+    vs, pg_adv = vtrace(
+        jnp.asarray(logp), jnp.asarray(logp), jnp.asarray(rewards), jnp.asarray(values),
+        jnp.asarray(bootstrap), jnp.asarray(mask), gamma, rho_clip=1.0, c_clip=1.0,
+    )
+    expected = np.zeros((N, T))
+    for i in range(N):
+        acc = bootstrap[i]
+        for t in range(T - 1, -1, -1):
+            acc = rewards[i, t] + gamma * acc
+            expected[i, t] = acc
+    np.testing.assert_allclose(np.asarray(vs), expected, rtol=1e-4, atol=1e-4)
+
+
+def test_mlp_module_shapes():
+    import gymnasium as gym
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core.rl_module import MLPModule
+
+    env = gym.make("CartPole-v1")
+    m = MLPModule(env.observation_space, env.action_space, {"fcnet_hiddens": (32, 32)})
+    params = m.init(jax.random.PRNGKey(0))
+    out = m.forward(params, jnp.zeros((5, 4)))
+    assert out["action_dist_inputs"].shape == (5, 2)
+    assert out["vf"].shape == (5,)
+    env.close()
+
+
+# ------------------------------------------------------- learning tests
+def _ppo_config(num_env_runners=0):
+    from ray_tpu.rllib import PPOConfig
+
+    return (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=num_env_runners, num_envs_per_env_runner=8 if num_env_runners == 0 else 4)
+        .training(lr=1e-3, gamma=0.98, lambda_=0.8, train_batch_size=2048, minibatch_size=256, num_epochs=20)
+        .debugging(seed=0)
+    )
+
+
+def test_ppo_cartpole_learns():
+    """BASELINE config #1: PPO CartPole reaches a reward threshold."""
+    algo = _ppo_config().build_algo()
+    best = 0.0
+    for _ in range(15):
+        r = algo.train()
+        best = max(best, r["env_runners"]["episode_return_mean"])
+        if best >= 150:
+            break
+    assert best >= 120, f"PPO failed to learn CartPole: best={best}"
+    algo.stop()
+
+
+def test_ppo_remote_env_runners(rt_start):
+    algo = _ppo_config(num_env_runners=2).build_algo()
+    best = 0.0
+    for _ in range(8):
+        r = algo.train()
+        best = max(best, r["env_runners"]["episode_return_mean"])
+    assert best >= 40, f"best={best}"
+    algo.stop()
+
+
+def test_ppo_checkpoint_roundtrip(tmp_path):
+    algo = _ppo_config().build_algo()
+    algo.train()
+    w0 = algo.learner_group.get_weights()
+    path = algo.save_to_path(str(tmp_path / "ckpt"))
+    algo2 = _ppo_config().build_algo()
+    algo2.restore_from_path(path)
+    assert algo2.iteration == algo.iteration
+    w1 = algo2.learner_group.get_weights()
+    import jax
+
+    jax.tree.map(np.testing.assert_allclose, w0, w1)
+    algo.stop()
+    algo2.stop()
+
+
+def _impala_config(**kw):
+    from ray_tpu.rllib import IMPALAConfig
+
+    cfg = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8)
+        .training(lr=1e-3, train_batch_size=4000, entropy_coeff=0.005, rollout_fragment_length=100, vf_loss_coeff=0.25)
+        .debugging(seed=0)
+    )
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_impala_cartpole_learns():
+    algo = _impala_config().build_algo()
+    best = 0.0
+    for _ in range(22):
+        r = algo.train()
+        best = max(best, r["env_runners"]["episode_return_mean"])
+        if best >= 60:
+            break
+    assert best >= 40, f"IMPALA failed to learn: best={best}"
+    algo.stop()
+
+
+def test_impala_multi_learner(rt_start):
+    """BASELINE config #5 shape: multi-learner group with collective grad
+    allreduce + async sampling pipeline."""
+    cfg = _impala_config()
+    cfg.num_env_runners = 2
+    cfg.num_envs_per_env_runner = 4
+    cfg.num_learners = 2
+    algo = cfg.build_algo()
+    rets = []
+    for _ in range(6):
+        r = algo.train()
+        rets.append(r["env_runners"]["episode_return_mean"])
+    assert np.isfinite(rets[-1])
+    assert rets[-1] > 21, f"returns not improving: {rets}"
+    algo.stop()
