@@ -1,0 +1,12 @@
+"""ray_tpu.llm — TPU-native LLM serving engine.
+
+Replaces the reference's vLLM-wrapping `ray.llm` (python/ray/llm/) with a
+jit-native continuous-batching engine: slot KV cache, bucketed prefill,
+single compiled decode program (see engine.py / model_runner.py /
+kv_cache.py). Serve integration lives in ray_tpu.serve.llm.
+"""
+
+from ray_tpu.llm.engine import LLMEngine, RequestOutput
+from ray_tpu.llm.sampling import SamplingParams
+
+__all__ = ["LLMEngine", "RequestOutput", "SamplingParams"]

@@ -1,0 +1,299 @@
+"""Continuous-batching LLM engine (the module models/llama.py promises).
+
+Architecture (TPU-native replacement for the reference's vLLM wrapping in
+python/ray/llm/_internal/serve/engines/vllm/vllm_engine.py):
+
+- a static slot-based KV cache (kv_cache.py) compiled once;
+- prompt prefill bucketed to powers of two (one compiled program per
+  bucket, not per prompt length);
+- one jitted decode program advances *all* slots one token per step;
+- a host-side scheduler does admission (waiting queue -> free slot),
+  completion (eos / max_tokens / stop ids), and slot recycling between
+  device steps. The device never sees dynamic shapes.
+
+Engine steps are synchronous and cheap to drive from an actor or a Serve
+replica; `generate()` is the batteries-included loop.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ray_tpu.llm.sampling import SamplingParams
+
+
+@dataclass
+class RequestState:
+    request_id: str
+    prompt_token_ids: list
+    params: SamplingParams
+    token_ids: list = field(default_factory=list)
+    logprobs: list = field(default_factory=list)
+    slot: int = -1
+    finished: bool = False
+    finish_reason: str | None = None
+    # streaming consumers read from here
+    out_queue: "queue.SimpleQueue | None" = None
+
+
+@dataclass
+class RequestOutput:
+    request_id: str
+    prompt_token_ids: list
+    token_ids: list
+    new_token_ids: list
+    finished: bool
+    finish_reason: str | None = None
+    logprobs: list | None = None
+
+
+def _bucket(n: int, buckets) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds the largest prefill bucket {buckets[-1]}")
+
+
+class LLMEngine:
+    """Continuous-batching engine over a slot KV cache.
+
+    config: ray_tpu.models.llama.LlamaConfig; params: matching pytree (if
+    None, randomly initialized — useful for tests/benchmarks).
+    """
+
+    def __init__(
+        self,
+        config,
+        params=None,
+        *,
+        max_num_seqs: int = 8,
+        max_seq_len: int | None = None,
+        prefill_buckets: tuple | None = None,
+        seed: int = 0,
+        cache_dtype: str | None = None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.llm import kv_cache as kvc
+        from ray_tpu.llm.model_runner import make_runner_fns
+        from ray_tpu.llm.sampling import sample
+        from ray_tpu.models.llama import init_params
+
+        self.config = config
+        self.max_num_seqs = int(max_num_seqs)
+        self.max_seq_len = int(max_seq_len or config.max_seq_len)
+        if prefill_buckets is None:
+            b, buckets = 64, []
+            while b < self.max_seq_len:
+                buckets.append(b)
+                b *= 2
+            buckets.append(self.max_seq_len)
+            prefill_buckets = tuple(buckets)
+        self.prefill_buckets = tuple(sorted(prefill_buckets))
+        self.params = params if params is not None else init_params(config, jax.random.PRNGKey(seed))
+        self._prefill, self._insert, self._decode = make_runner_fns(config)
+        self._sample = jax.jit(sample)
+
+        self.cache = kvc.alloc(
+            kvc.CacheConfig(
+                num_layers=config.num_layers,
+                num_slots=self.max_num_seqs,
+                max_seq_len=self.max_seq_len,
+                num_kv_heads=config.num_kv_heads,
+                head_dim=config.hd,
+                dtype=cache_dtype or config.dtype,
+            )
+        )
+        B = self.max_num_seqs
+        # per-slot device-side sampling state
+        self._temps = np.zeros((B,), np.float32)
+        self._top_k = np.zeros((B,), np.int32)
+        self._top_p = np.ones((B,), np.float32)
+        self._keys = np.array(
+            jax.vmap(lambda s: jax.random.key_data(jax.random.PRNGKey(s)))(jnp.arange(B, dtype=jnp.uint32))
+        ).astype(np.uint32)
+        self._next_tokens = np.zeros((B,), np.int32)  # input token for next decode per slot
+
+        self._slots: list[RequestState | None] = [None] * B
+        self._waiting: deque[RequestState] = deque()
+        self._requests: dict[str, RequestState] = {}
+        self._lock = threading.Lock()
+        self._auto_id = 0
+
+    # ------------------------------------------------------------- admission
+
+    def add_request(self, prompt_token_ids, params: SamplingParams | None = None, request_id: str | None = None, stream: bool = False) -> str:
+        params = params or SamplingParams()
+        with self._lock:
+            if request_id is None:
+                request_id = f"req-{self._auto_id}"
+                self._auto_id += 1
+            if len(prompt_token_ids) + params.max_tokens > self.max_seq_len:
+                raise ValueError(
+                    f"prompt ({len(prompt_token_ids)}) + max_tokens ({params.max_tokens}) "
+                    f"exceeds max_seq_len ({self.max_seq_len})"
+                )
+            st = RequestState(request_id, list(prompt_token_ids), params)
+            if stream:
+                st.out_queue = queue.SimpleQueue()
+            self._requests[request_id] = st
+            self._waiting.append(st)
+            return request_id
+
+    def abort_request(self, request_id: str) -> bool:
+        with self._lock:
+            st = self._requests.get(request_id)
+            if st is None or st.finished:
+                return False
+            self._finish(st, "aborted")
+            return True
+
+    def has_unfinished(self) -> bool:
+        with self._lock:
+            return bool(self._waiting) or any(s is not None for s in self._slots)
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self._waiting)
+
+    @property
+    def num_running(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    # --------------------------------------------------------------- engine
+
+    def _finish(self, st: RequestState, reason: str):
+        st.finished = True
+        st.finish_reason = reason
+        if st.slot >= 0:
+            self._slots[st.slot] = None
+            st.slot = -1
+        if st.out_queue is not None:
+            st.out_queue.put(None)  # sentinel
+
+    def _admit_one(self, st: RequestState):
+        import jax.numpy as jnp
+
+        slot = self._slots.index(None)
+        n = len(st.prompt_token_ids)
+        T = _bucket(n, self.prefill_buckets)
+        toks = np.zeros((1, T), np.int32)
+        toks[0, :n] = st.prompt_token_ids
+        logits, ks, vs = self._prefill(self.params, jnp.asarray(toks), jnp.asarray([n], np.int32))
+        self.cache = self._insert(self.cache, slot, ks[:, 0], vs[:, 0], n)
+        st.slot = slot
+        self._slots[slot] = st
+        p = st.params
+        self._temps[slot] = p.temperature
+        self._top_k[slot] = p.top_k
+        self._top_p[slot] = p.top_p
+        if p.seed is not None:
+            import jax
+
+            self._keys[slot] = np.asarray(jax.random.key_data(jax.random.PRNGKey(p.seed)))
+        # sample the first generated token from the prefill logits
+        tok, logp, key = self._sample(
+            logits,
+            jnp.asarray(self._keys[slot : slot + 1]),
+            jnp.asarray(self._temps[slot : slot + 1]),
+            jnp.asarray(self._top_k[slot : slot + 1]),
+            jnp.asarray(self._top_p[slot : slot + 1]),
+        )
+        self._keys[slot] = np.asarray(key[0])
+        self._emit(st, int(tok[0]), float(logp[0]))
+
+    def _emit(self, st: RequestState, token: int, logp: float):
+        st.token_ids.append(token)
+        st.logprobs.append(logp)
+        if st.out_queue is not None:
+            st.out_queue.put(token)
+        self._next_tokens[st.slot if st.slot >= 0 else 0] = token
+        if token in st.params.stop_token_ids:
+            self._finish(st, "stop")
+        elif len(st.token_ids) >= st.params.max_tokens:
+            self._finish(st, "length")
+
+    def step(self) -> list[RequestOutput]:
+        """Admit what fits, run one decode step, return per-request deltas."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            while self._waiting and None in self._slots:
+                st = self._waiting.popleft()
+                if st.finished:  # aborted while waiting
+                    continue
+                self._admit_one(st)
+
+            active = [s for s in self._slots if s is not None]
+            outputs: list[RequestOutput] = []
+            if active:
+                logits, self.cache = self._decode(self.params, self.cache, jnp.asarray(self._next_tokens))
+                toks, logps, keys = self._sample(
+                    logits,
+                    jnp.asarray(self._keys),
+                    jnp.asarray(self._temps),
+                    jnp.asarray(self._top_k),
+                    jnp.asarray(self._top_p),
+                )
+                toks = np.asarray(toks)
+                logps = np.asarray(logps)
+                self._keys = np.array(keys)
+                for st in active:
+                    slot = st.slot
+                    self._emit(st, int(toks[slot]), float(logps[slot]))
+
+            # build deltas for everything that changed this step
+            for st in active:
+                outputs.append(
+                    RequestOutput(
+                        request_id=st.request_id,
+                        prompt_token_ids=st.prompt_token_ids,
+                        token_ids=list(st.token_ids),
+                        new_token_ids=st.token_ids[-1:],
+                        finished=st.finished,
+                        finish_reason=st.finish_reason,
+                        logprobs=list(st.logprobs) if st.params.logprobs else None,
+                    )
+                )
+            # also report requests finished during this step's admission
+            done_ids = {o.request_id for o in outputs}
+            for st in list(self._requests.values()):
+                if st.finished and st.request_id not in done_ids and st.request_id in self._requests:
+                    outputs.append(
+                        RequestOutput(
+                            request_id=st.request_id,
+                            prompt_token_ids=st.prompt_token_ids,
+                            token_ids=list(st.token_ids),
+                            new_token_ids=[],
+                            finished=True,
+                            finish_reason=st.finish_reason,
+                            logprobs=list(st.logprobs) if st.params.logprobs else None,
+                        )
+                    )
+                    del self._requests[st.request_id]
+            for o in outputs:
+                if o.finished and o.request_id in self._requests:
+                    del self._requests[o.request_id]
+            return outputs
+
+    def generate(self, prompts, params: SamplingParams | list | None = None) -> list[RequestOutput]:
+        """Blocking batch generation with continuous batching underneath."""
+        single = isinstance(prompts[0], int)
+        if single:
+            prompts = [prompts]
+        if params is None or isinstance(params, SamplingParams):
+            params = [params or SamplingParams()] * len(prompts)
+        ids = [self.add_request(p, sp) for p, sp in zip(prompts, params)]
+        finals: dict[str, RequestOutput] = {}
+        while self.has_unfinished():
+            for out in self.step():
+                if out.finished:
+                    finals[out.request_id] = out
+        results = [finals[i] for i in ids]
+        return results[0] if single else results

@@ -1,0 +1,135 @@
+"""Cache-aware Llama forward passes: prefill and single-token decode.
+
+Both are pure functions over the same parameter pytree as
+ray_tpu.models.llama (training and serving share weights); layers are
+iterated with `lax.scan` so compile time is constant in depth and the KV
+cache rides the scan as stacked per-layer xs/ys.
+
+Prefill runs the causal flash path on one (padded) prompt and returns the
+per-layer K/V to be inserted into a cache slot. Decode advances every slot
+by one token against the full cache with a length mask. This replaces the
+vLLM engine the reference wraps (ref: python/ray/llm/_internal/serve/
+engines/vllm/vllm_engine.py) with a jit-native implementation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.layers import apply_rope, rms_norm, rotary_embedding
+
+
+def _qkv(xn, layer, cfg: LlamaConfig):
+    B, T, _ = xn.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    q = jnp.dot(xn, layer["wq"]).reshape(B, T, nh, hd)
+    k = jnp.dot(xn, layer["wk"]).reshape(B, T, nkv, hd)
+    v = jnp.dot(xn, layer["wv"]).reshape(B, T, nkv, hd)
+    return q, k, v
+
+
+def _mlp(x, layer, cfg: LlamaConfig):
+    xn = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+    g = jnp.dot(xn, layer["w_gate"])
+    u = jnp.dot(xn, layer["w_up"])
+    return x + jnp.dot(jax.nn.silu(g) * u, layer["w_down"])
+
+
+def prefill(params, tokens, length, cfg: LlamaConfig):
+    """Run the prompt through the model, returning last-token logits + K/V.
+
+    tokens: [B, T_pad] int32 (right-padded); length: [B] int32 real lengths.
+    Returns (logits [B, vocab] f32, k [L, B, T_pad, kv, hd], v same).
+    Padded positions produce garbage K/V that later attention masks out.
+    """
+    B, T = tokens.shape
+    positions = jnp.arange(T, dtype=jnp.int32)
+    cos, sin = rotary_embedding(positions, cfg.hd, cfg.rope_theta)
+    x = jnp.take(params["embed"], tokens, axis=0)
+
+    def layer_fn(x, layer):
+        xn = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(xn, layer, cfg)
+        qh = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
+        kh = apply_rope(k.transpose(0, 2, 1, 3), cos, sin)
+        o = flash_attention(qh, kh, v.transpose(0, 2, 1, 3), True, None, cfg.attention_impl)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, cfg.num_heads * cfg.hd)
+        x = x + jnp.dot(o, layer["wo"])
+        x = _mlp(x, layer, cfg)
+        # cache stores rope'd keys (decode appends rope'd keys too)
+        return x, (kh.transpose(0, 2, 1, 3), v)
+
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn, policy=getattr(jax.checkpoint_policies, cfg.remat_policy))
+    x, (ks, vs) = jax.lax.scan(layer_fn, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    # only the last real token's logits matter: gather before the unembed
+    # matmul so prefill does a [B, H] x [H, V] instead of [B*T, H] x [H, V]
+    x_last = jnp.take_along_axis(x, (length - 1)[:, None, None], axis=1)[:, 0]
+    unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
+    logits = jnp.dot(x_last, unembed, preferred_element_type=jnp.float32)
+    return logits, ks, vs
+
+
+def decode_step(params, cache, tokens, cfg: LlamaConfig):
+    """Advance every slot one token.
+
+    tokens: [slots] int32 (next input token per slot, garbage for empty
+    slots); cache: kv_cache pytree. Returns (logits [slots, vocab] f32,
+    new cache). The new token is written at position cache.length[b] and
+    attends to positions 0..length[b] inclusive.
+    """
+    B = tokens.shape[0]
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    rep = nh // nkv
+    lengths = cache["length"]
+    cos, sin = rotary_embedding(lengths[:, None], cfg.hd, cfg.rope_theta)  # [B, 1, hd/2]
+    x = jnp.take(params["embed"], tokens[:, None], axis=0)  # [B, 1, H]
+    S = cache["k"].shape[2]
+    # mask: new token sits at index `length`, may attend to 0..length
+    attn_ok = (jnp.arange(S, dtype=jnp.int32)[None, :] <= lengths[:, None])[:, None, None]  # [B,1,1,S]
+
+    def layer_fn(x, xs):
+        layer, k_cache, v_cache = xs  # k/v_cache: [B, S, nkv, hd]
+        xn = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q, k_t, v_t = _qkv(xn, layer, cfg)  # q: [B,1,nh,hd]
+        qh = apply_rope(q.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)  # [B,1,nh,hd]
+        kh = apply_rope(k_t.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)
+        from ray_tpu.llm.kv_cache import append_token_layer
+
+        write_pos = jnp.minimum(lengths, S - 1)
+        k_cache, v_cache = append_token_layer(k_cache, v_cache, kh[:, 0], v_t[:, 0], write_pos)
+        # GQA attention against the cache: head h uses kv head h // rep
+        qg = qh[:, 0].reshape(B, nkv, rep, hd)
+        kc = k_cache.transpose(0, 2, 1, 3)  # [B,nkv,S,hd]
+        vc = v_cache.transpose(0, 2, 1, 3)
+        scores = jnp.einsum("bgrh,bgsh->bgrs", qg, kc, preferred_element_type=jnp.float32) / jnp.sqrt(hd)
+        scores = jnp.where(attn_ok, scores, -jnp.inf)  # [B,1,1,S] bcast
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bgrs,bgsh->bgrh", probs, vc.astype(jnp.float32)).reshape(B, 1, nh * hd).astype(x.dtype)
+        x = x + jnp.dot(o, layer["wo"])
+        x = _mlp(x, layer, cfg)
+        return x, (k_cache, v_cache)
+
+    x, (ks, vs) = jax.lax.scan(layer_fn, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x[:, 0], params["final_norm"], cfg.rms_eps)
+    unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
+    logits = jnp.dot(x, unembed, preferred_element_type=jnp.float32)
+    new_cache = {"k": ks, "v": vs, "length": lengths + 1}
+    return logits, new_cache
+
+
+def make_runner_fns(cfg: LlamaConfig):
+    """Jitted (prefill, insert, decode) closures for an engine."""
+    from ray_tpu.llm import kv_cache as kvc
+
+    prefill_fn = jax.jit(partial(prefill, cfg=cfg))
+    insert_fn = jax.jit(kvc.insert_sequence, donate_argnums=(0,))
+    decode_fn = jax.jit(partial(decode_step, cfg=cfg), donate_argnums=(1,))
+    return prefill_fn, insert_fn, decode_fn

@@ -1,0 +1,84 @@
+"""Token sampling, jit-compatible with per-slot parameters.
+
+TPU-native replacement for the sampling-params plumbing the reference
+delegates to vLLM (ref: python/ray/llm/_internal/serve/engines/vllm/
+vllm_models.py:215-228 passes SamplingParams through to the engine).
+Everything here is batched and static-shaped: one `sample` call handles a
+whole decode batch with per-slot temperature / top-k / top-p arrays, so
+continuous batching never recompiles as requests come and go.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling configuration (user-facing)."""
+
+    max_tokens: int = 64
+    temperature: float = 0.0  # 0.0 => greedy
+    top_k: int = 0  # 0 => disabled
+    top_p: float = 1.0  # 1.0 => disabled
+    stop_token_ids: tuple = field(default_factory=tuple)
+    seed: int | None = None
+    logprobs: bool = False
+
+    def __post_init__(self):
+        if self.temperature < 0.0:
+            raise ValueError("temperature must be >= 0")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0")
+
+
+def _apply_top_k(logits, top_k):
+    """Mask logits outside the per-row top-k (top_k[b] == 0 disables)."""
+    vocab = logits.shape[-1]
+    # rank of each logit within its row (0 = largest)
+    order = jnp.argsort(logits, axis=-1)[..., ::-1]
+    ranks = jnp.argsort(order, axis=-1)
+    k = jnp.where(top_k <= 0, vocab, top_k)[..., None]
+    return jnp.where(ranks < k, logits, -jnp.inf)
+
+
+def _apply_top_p(logits, top_p):
+    """Nucleus filtering: keep the smallest prefix with cumprob >= top_p."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens while the cumulative mass *before* them is < top_p
+    keep_sorted = (cum - probs) < top_p[..., None]
+    # threshold logit = smallest kept logit per row
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(logits >= thresh, logits, -jnp.inf)
+
+
+def sample(logits, key, temperature, top_k, top_p):
+    """Sample one token per row.
+
+    logits: [B, V] f32; temperature/top_p: [B] f32; top_k: [B] i32;
+    key: [B, 2] u32 per-slot PRNG keys. Returns (tokens [B] i32,
+    logprobs [B] f32, new_keys [B, 2]).
+    """
+    logits = logits.astype(jnp.float32)
+    greedy_tok = jnp.argmax(logits, axis=-1)
+
+    def _one(lg, k, temp, tk, tp):
+        k1, k2 = jax.random.split(jax.random.wrap_key_data(k, impl="threefry2x32"))
+        scaled = lg / jnp.maximum(temp, 1e-6)
+        scaled = _apply_top_k(scaled[None], tk[None])[0]
+        scaled = _apply_top_p(scaled[None], tp[None])[0]
+        tok = jax.random.categorical(k1, scaled)
+        return tok, jax.random.key_data(k2)
+
+    sampled_tok, new_keys = jax.vmap(_one)(logits, key, temperature, top_k, top_p)
+    tokens = jnp.where(temperature == 0.0, greedy_tok, sampled_tok).astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    chosen_logp = jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
+    return tokens, chosen_logp, new_keys

@@ -33,14 +33,13 @@ class Algorithm:
     def setup(self):
         cfg = self.config
         assert cfg.env is not None, "config.environment(env=...) is required"
-        probe_spec = RLModuleSpec(cfg.module_class or MLPModule, None, None, cfg.model)
         # spaces come from a throwaway env (cheap for gym registry ids)
         import gymnasium as gym
 
         probe = gym.make(cfg.env, **cfg.env_config)
         obs_space, act_space = probe.observation_space, probe.action_space
         probe.close()
-        self.module_spec = RLModuleSpec(probe_spec.module_class, obs_space, act_space, cfg.model)
+        self.module_spec = RLModuleSpec(cfg.module_class or MLPModule, obs_space, act_space, cfg.model)
 
         self.env_runner_group = EnvRunnerGroup(
             self.module_spec,

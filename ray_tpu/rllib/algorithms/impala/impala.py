@@ -40,29 +40,31 @@ class IMPALAConfig(AlgorithmConfig):
         return IMPALA
 
 
-def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value, mask, gamma, rho_clip, c_clip):
+def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value, mask, nonterminal, gamma, rho_clip, c_clip):
     """V-trace targets + policy-gradient advantages over [N, T] sequences.
 
-    values: [N, T] current value estimates; bootstrap_value: [N].
-    Returns (vs [N,T], pg_advantages [N,T]); padded steps (mask==0) pass
-    through their value estimate.
+    values: [N, T] current value estimates; bootstrap_value: [N];
+    nonterminal: [N, T] — 0 where the transition at t enters a terminal
+    state (so no value bootstraps across an episode boundary, wherever in
+    the fragment it falls). Returns (vs [N,T], pg_advantages [N,T]);
+    padded steps (mask==0) pass through their value estimate.
     """
     rho = jnp.exp(target_logp - behavior_logp)
     rho_bar = jnp.minimum(rho_clip, rho) * mask
     c_bar = jnp.minimum(c_clip, rho) * mask
-    v_next = jnp.concatenate([values[:, 1:], bootstrap_value[:, None]], axis=1)
+    v_next = jnp.concatenate([values[:, 1:], bootstrap_value[:, None]], axis=1) * nonterminal
     delta = rho_bar * (rewards + gamma * v_next - values)
 
     def body(carry, xs):
-        d_t, c_t, vnext_t, v_t = xs
-        # carry = vs_{t+1} - V(x_{t+1})
-        vs_minus_v = d_t + gamma * c_t * carry
+        d_t, c_t, nt_t = xs
+        # carry = vs_{t+1} - V(x_{t+1}); a terminal at t cuts the recursion
+        vs_minus_v = d_t + gamma * c_t * nt_t * carry
         return vs_minus_v, vs_minus_v
 
-    xs = (delta.T, c_bar.T, v_next.T, values.T)  # scan over time, reversed
+    xs = (delta.T, c_bar.T, nonterminal.T)  # scan over time, reversed
     _, out = jax.lax.scan(body, jnp.zeros(values.shape[0]), xs, reverse=True)
     vs = values + out.T
-    vs_next = jnp.concatenate([vs[:, 1:], bootstrap_value[:, None]], axis=1)
+    vs_next = jnp.concatenate([vs[:, 1:], bootstrap_value[:, None]], axis=1) * nonterminal
     pg_adv = rho_bar * (rewards + gamma * vs_next - values)
     return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
 
@@ -77,12 +79,20 @@ class IMPALALearner(Learner):
         inputs = out["action_dist_inputs"].reshape(N, T + 1, -1)[:, :-1]
         values_all = out["vf"].reshape(N, T + 1)
         values, bootstrap = values_all[:, :-1], values_all[:, -1]
-        bootstrap = jnp.where(batch["terminated"], 0.0, bootstrap)
 
         target_logp = dist.logp(inputs, batch["actions"])
         mask = batch["mask"]
         vs, pg_adv = vtrace(
-            batch["logp"], target_logp, batch["rewards"], values, bootstrap, mask, cfg.gamma, cfg.rho_clip, cfg.c_clip
+            batch["logp"],
+            target_logp,
+            batch["rewards"],
+            values,
+            bootstrap,
+            mask,
+            batch["nonterminal"],
+            cfg.gamma,
+            cfg.rho_clip,
+            cfg.c_clip,
         )
         denom = jnp.maximum(jnp.sum(mask), 1.0)
         policy_loss = -jnp.sum(target_logp * pg_adv * mask) / denom
@@ -119,23 +129,33 @@ class IMPALA(Algorithm):
         return result
 
     def _build_sequences(self, segments: list[dict]) -> dict:
-        """Pad each segment to rollout_fragment_length -> [N, T(+1)]."""
+        """Chunk segments into fragments of rollout_fragment_length and pad
+        -> [N, T(+1)] arrays. Nothing is discarded: a segment longer than T
+        becomes multiple rows, each bootstrapping from its own next obs.
+        `nonterminal[i, t] == 0` marks a transition into a terminal state
+        (only ever the last real step of a fragment)."""
         T = self.config.rollout_fragment_length
+        chunks = []  # (segment, start, length, is_final_chunk)
+        for s in segments:
+            n = len(s["actions"])
+            for t0 in range(0, n, T):
+                t1 = min(t0 + T, n)
+                chunks.append((s, t0, t1 - t0, t1 == n))
         obs_shape = segments[0]["obs"].shape[1:]
-        N = len(segments)
+        N = len(chunks)
         obs = np.zeros((N, T + 1) + obs_shape, np.float32)
         actions = np.zeros((N, T) + segments[0]["actions"].shape[1:], segments[0]["actions"].dtype)
         rewards = np.zeros((N, T), np.float32)
         logp = np.zeros((N, T), np.float32)
         mask = np.zeros((N, T), np.float32)
-        terminated = np.zeros((N,), bool)
-        for i, s in enumerate(segments):
-            t = min(len(s["actions"]), T)
-            obs[i, : t + 1] = s["obs"][: t + 1]
-            obs[i, t + 1 :] = s["obs"][t]  # repeat last obs into padding
-            actions[i, :t] = s["actions"][:t]
-            rewards[i, :t] = s["rewards"][:t]
-            logp[i, :t] = s["logp"][:t]
+        nonterminal = np.ones((N, T), np.float32)
+        for i, (s, t0, t, final) in enumerate(chunks):
+            obs[i, : t + 1] = s["obs"][t0 : t0 + t + 1]
+            obs[i, t + 1 :] = s["obs"][t0 + t]  # repeat last obs into padding
+            actions[i, :t] = s["actions"][t0 : t0 + t]
+            rewards[i, :t] = s["rewards"][t0 : t0 + t]
+            logp[i, :t] = s["logp"][t0 : t0 + t]
             mask[i, :t] = 1.0
-            terminated[i] = bool(s["terminated"]) if t == len(s["actions"]) else False
-        return {"obs": obs, "actions": actions, "rewards": rewards, "logp": logp, "mask": mask, "terminated": terminated}
+            if final and bool(s["terminated"]):
+                nonterminal[i, t - 1] = 0.0
+        return {"obs": obs, "actions": actions, "rewards": rewards, "logp": logp, "mask": mask, "nonterminal": nonterminal}

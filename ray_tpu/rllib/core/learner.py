@@ -148,11 +148,22 @@ class LearnerGroup:
         if self._local is not None:
             return [self._local.update(batch, **kw)]
         n = len(batch["obs"])
-        shard = max(1, n // len(self._actors))
+        k = len(self._actors)
+        if n < k:
+            raise ValueError(f"batch of {n} rows cannot be sharded across {k} learners")
+        # every learner MUST take an identical-size shard: the per-minibatch
+        # grad allreduce is a blocking collective, so unequal shard sizes
+        # (hence unequal step counts) would deadlock the group. Rather than
+        # dropping the n % k remainder, pad with wrap-around rows so every
+        # collected row reaches some learner (a few duplicates, no drops).
+        shard = -(-n // k)  # ceil
+        if shard * k > n:
+            pad = np.arange(shard * k - n) % n
+            batch = {k2: np.concatenate([v, v[pad]], axis=0) for k2, v in batch.items()}
         refs = []
         for i, a in enumerate(self._actors):
-            rows = slice(i * shard, n if i == len(self._actors) - 1 else (i + 1) * shard)
-            sub = {k: v[rows] for k, v in batch.items()}
+            rows = slice(i * shard, (i + 1) * shard)
+            sub = {k2: v[rows] for k2, v in batch.items()}
             refs.append(a.update.remote(sub, **kw))
         return ray_tpu.get(refs)
 

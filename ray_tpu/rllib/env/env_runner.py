@@ -33,7 +33,15 @@ class SingleAgentEnvRunner:
         self.num_envs = num_envs
         self.module = module_spec.build()
         self.params = None
-        self._key = jax.random.PRNGKey(seed + 10_000 * worker_idx)
+        # rollouts are latency-bound host loops: pin them to the CPU
+        # backend when one is registered, even if the process default is a
+        # (possibly remote/tunneled) TPU — per-step eager ops on a remote
+        # device would make each env step a network round trip
+        try:
+            self._device = jax.local_devices(backend="cpu")[0]
+        except Exception:
+            self._device = None
+        self._key = self._put(jax.random.PRNGKey(seed + 10_000 * worker_idx))
         self._fwd = jax.jit(self.module.forward_exploration)
         obs, _ = self.envs.reset(seed=seed + 10_000 * worker_idx)
         self._obs = obs
@@ -45,10 +53,21 @@ class SingleAgentEnvRunner:
         self._pending_reset = np.zeros(num_envs, dtype=bool)
         # true per-env episode return, accumulated across segment cuts
         self._return_acc = np.zeros(num_envs, dtype=np.float64)
-        self._episode_returns: list[float] = []
+        from collections import deque
+
+        self._episode_returns: deque = deque(maxlen=100)
+        self._episodes_this_sample = 0
+
+    def _put(self, x):
+        return jax.device_put(x, self._device) if self._device is not None else jnp.asarray(x)
+
+    def _on_device(self):
+        import contextlib
+
+        return jax.default_device(self._device) if self._device is not None else contextlib.nullcontext()
 
     def set_weights(self, params):
-        self.params = jax.tree.map(jnp.asarray, params)
+        self.params = jax.tree.map(self._put, params)
 
     def get_spaces(self):
         return self.envs.single_observation_space, self.envs.single_action_space
@@ -57,9 +76,14 @@ class SingleAgentEnvRunner:
         """Collect ~num_steps env steps (across vector envs); returns
         (episode segment batches, metrics). Segments end at terminal,
         truncation, or collection cut; each carries a bootstrap obs row."""
+        with self._on_device():
+            return self._sample(num_steps, explore)
+
+    def _sample(self, num_steps: int, explore: bool = True) -> tuple[list[dict], dict]:
         assert self.params is not None, "set_weights before sample"
         segments: list[Episode] = []
         steps_left = num_steps
+        self._episodes_this_sample = 0
         dist = self.module.action_dist_cls
         while steps_left > 0:
             out = self._fwd(self.params, jnp.asarray(self._obs))
@@ -93,6 +117,7 @@ class SingleAgentEnvRunner:
                 if terms[i] or truncs[i]:
                     ep.is_terminated = bool(terms[i])
                     self._episode_returns.append(float(self._return_acc[i]))
+                    self._episodes_this_sample += 1
                     self._return_acc[i] = 0.0
                     segments.append(ep)
                     self._pending_reset[i] = True
@@ -108,10 +133,10 @@ class SingleAgentEnvRunner:
                 fresh = Episode()
                 fresh.obs.append(ep.obs[-1])
                 self._building[i] = fresh
-        returns = self._episode_returns[-100:]
+        returns = list(self._episode_returns)
         metrics = {
             "episode_return_mean": float(np.mean(returns)) if returns else float("nan"),
-            "num_episodes": len(self._episode_returns),
+            "num_episodes": self._episodes_this_sample,
             "num_env_steps": int(num_steps - steps_left),
         }
         return [s.to_batch() for s in segments], metrics

@@ -1,0 +1,41 @@
+"""ray_tpu.serve — model serving on the ray_tpu runtime.
+
+Controller + replica state machine + pow-2 routing + request-metric
+autoscaling + HTTP proxy (reference: python/ray/serve). TPU-native twist:
+replicas pin TPU resources and keep a warm JAX engine (see
+ray_tpu.serve.llm for the LLM deployment builder).
+"""
+
+from ray_tpu.serve.api import (
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions, ReplicaConfig
+from ray_tpu.serve.deployment import Application, Deployment, deployment
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve._proxy import Request
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "HTTPOptions",
+    "ReplicaConfig",
+    "Request",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

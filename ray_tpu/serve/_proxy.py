@@ -1,0 +1,138 @@
+"""HTTP proxy: routes HTTP requests to application ingress deployments.
+
+Reference parity: serve/_private/proxy.py (per-node proxy with route
+table from the controller) + proxy_router.py route matching. Here it is a
+threaded stdlib HTTP server living in the driver (or any) process: routes
+refresh from the controller's application table; each request becomes a
+handle call with a Request object, longest-prefix route match.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import ray_tpu
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+@dataclass
+class Request:
+    """Minimal HTTP request surface passed to ingress __call__ (the shape
+    user code needs from starlette.requests.Request in the reference)."""
+
+    method: str
+    path: str
+    query_params: dict = field(default_factory=dict)
+    headers: dict = field(default_factory=dict)
+    body: bytes = b""
+
+    def json(self):
+        return json.loads(self.body or b"null")
+
+    @property
+    def text(self) -> str:
+        return self.body.decode()
+
+
+class HTTPProxy:
+    def __init__(self, controller, http_options):
+        self._controller = controller
+        self._opts = http_options
+        self._routes: dict[str, DeploymentHandle] = {}
+        self._routes_lock = threading.Lock()
+        self._server: ThreadingHTTPServer | None = None
+        self._stop = threading.Event()
+
+    # -- route table --
+
+    def _refresh_routes(self):
+        apps = ray_tpu.get(self._controller.list_applications.remote())
+        with self._routes_lock:
+            known = set(self._routes)
+            for app_name, info in apps.items():
+                prefix = info.get("route_prefix") or "/"
+                if prefix not in known:
+                    self._routes[prefix] = DeploymentHandle(self._controller, app_name, info["ingress"])
+            for prefix in known - {info.get("route_prefix") or "/" for info in apps.values()}:
+                del self._routes[prefix]
+
+    def _match(self, path: str) -> tuple[DeploymentHandle | None, str]:
+        with self._routes_lock:
+            best = None
+            best_prefix = ""
+            for prefix, handle in self._routes.items():
+                p = prefix.rstrip("/")
+                if (path == p or path.startswith(p + "/") or prefix == "/") and len(prefix) > len(best_prefix):
+                    best, best_prefix = handle, prefix
+            return best, best_prefix
+
+    # -- server --
+
+    def start(self):
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _handle(self):
+                try:
+                    proxy._refresh_routes()
+                    parsed = urlparse(self.path)
+                    handle, prefix = proxy._match(parsed.path)
+                    if handle is None:
+                        self._respond(404, {"error": f"no route for {parsed.path}"})
+                        return
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                    body = self.rfile.read(n) if n else b""
+                    sub_path = parsed.path[len(prefix.rstrip("/")):] or "/"
+                    req = Request(
+                        method=self.command,
+                        path=sub_path,
+                        query_params={k: v[0] for k, v in parse_qs(parsed.query).items()},
+                        headers=dict(self.headers.items()),
+                        body=body,
+                    )
+                    result = handle.remote(req).result(timeout_s=60.0)
+                    self._respond(200, result)
+                except Exception as e:  # noqa: BLE001
+                    self._respond(500, {"error": repr(e)})
+
+            def _respond(self, code: int, payload):
+                if isinstance(payload, (bytes, bytearray)):
+                    data, ctype = bytes(payload), "application/octet-stream"
+                elif isinstance(payload, str):
+                    data, ctype = payload.encode(), "text/plain"
+                else:
+                    data, ctype = json.dumps(payload).encode(), "application/json"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_GET = do_POST = do_PUT = do_DELETE = _handle
+
+        self._server = ThreadingHTTPServer((self._opts.host, self._opts.port), Handler)
+        if self._opts.port == 0:
+            self._opts.port = self._server.server_address[1]
+        t = threading.Thread(target=self._server.serve_forever, name="serve-http-proxy", daemon=True)
+        t.start()
+        return self._opts.port
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    @property
+    def port(self) -> int:
+        return self._opts.port

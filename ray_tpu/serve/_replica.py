@@ -1,0 +1,107 @@
+"""Replica actor: hosts one instance of a deployment's user class.
+
+Reference parity: serve/_private/replica.py (UserCallableWrapper,
+handle_request, health checks, graceful shutdown) — collapsed to a single
+actor class. Concurrency comes from the actor's max_concurrency thread
+pool; the replica tracks its in-flight count, which is both the router's
+load signal (pow-2 choice) and the autoscaler's metric.
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+import time
+
+
+def _resolve_handle_markers(v):
+    """Bound sub-deployments arrive as _HandleMarker; turn them into live
+    DeploymentHandles inside the replica process (model composition)."""
+    from ray_tpu.serve.deployment import _HandleMarker
+
+    if isinstance(v, _HandleMarker):
+        import ray_tpu
+        from ray_tpu.serve._controller import CONTROLLER_NAME
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        return DeploymentHandle(controller, v.app_name, v.deployment)
+    return v
+
+
+class Replica:
+    """Wraps the user callable. Instantiated as a ray_tpu actor by the
+    controller with max_concurrency = max_ongoing_requests + headroom for
+    control calls (health/metrics)."""
+
+    def __init__(self, deployment_name: str, replica_id: str, cls_or_fn, init_args, init_kwargs, user_config=None):
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        self._lock = threading.Lock()
+        self._ongoing = 0
+        self._total = 0
+        self._created_at = time.time()
+        init_args = tuple(_resolve_handle_markers(a) for a in (init_args or ()))
+        init_kwargs = {k: _resolve_handle_markers(v) for k, v in (init_kwargs or {}).items()}
+        if inspect.isfunction(cls_or_fn):
+            self._callable = cls_or_fn
+            self._is_function = True
+        else:
+            self._callable = cls_or_fn(*init_args, **init_kwargs)
+            self._is_function = False
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # -- control plane --
+
+    def check_health(self) -> bool:
+        user_check = getattr(self._callable, "check_health", None)
+        if user_check is not None and not self._is_function:
+            user_check()
+        return True
+
+    def get_metrics(self) -> dict:
+        with self._lock:
+            return {
+                "replica_id": self.replica_id,
+                "ongoing_requests": self._ongoing,
+                "total_requests": self._total,
+                "uptime_s": time.time() - self._created_at,
+            }
+
+    def reconfigure(self, user_config):
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+
+    def prepare_shutdown(self, timeout_s: float = 5.0):
+        """Drain: wait until in-flight requests finish (or timeout)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._lock:
+                if self._ongoing == 0:
+                    break
+            time.sleep(0.02)
+        shutdown = getattr(self._callable, "__del__", None)
+        return True
+
+    # -- data plane --
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self._is_function:
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method_name)
+            result = fn(*args, **(kwargs or {}))
+            if inspect.iscoroutine(result):
+                import asyncio
+
+                result = asyncio.new_event_loop().run_until_complete(result)
+            return result
+        finally:
+            with self._lock:
+                self._ongoing -= 1

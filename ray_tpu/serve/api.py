@@ -1,0 +1,112 @@
+"""serve public API: run / delete / status / handles / shutdown.
+
+Reference parity: serve/api.py (serve.run :578, serve.delete, serve.status)
+and _private/api.py (serve_start / client plumbing, collapsed: the
+controller is one named actor, created on first use).
+"""
+
+from __future__ import annotations
+
+import time
+
+import ray_tpu
+from ray_tpu.serve._controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.config import HTTPOptions
+from ray_tpu.serve.deployment import Application, build_app_spec
+from ray_tpu.serve.handle import DeploymentHandle
+
+_http_proxy = None
+
+
+def _get_or_create_controller(http_options: HTTPOptions | None = None):
+    ray_tpu.api._auto_init()
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        pass
+    return (
+        ray_tpu.remote(ServeController)
+        .options(name=CONTROLLER_NAME, max_concurrency=32, max_restarts=1)
+        .remote(http_options)
+    )
+
+
+def start(http_options: HTTPOptions | None = None, proxy: bool = False):
+    """Start the Serve control plane (idempotent); optionally the HTTP
+    proxy (reference: serve.start(http_options=...))."""
+    controller = _get_or_create_controller(http_options)
+    if proxy:
+        _ensure_proxy(controller, http_options or HTTPOptions())
+    return controller
+
+
+def _ensure_proxy(controller, http_options: HTTPOptions):
+    global _http_proxy
+    if _http_proxy is None:
+        from ray_tpu.serve._proxy import HTTPProxy
+
+        _http_proxy = HTTPProxy(controller, http_options)
+        _http_proxy.start()
+    return _http_proxy
+
+
+def run(app: Application, name: str = "default", route_prefix: str = "/", *, blocking_timeout_s: float = 60.0, _blocking: bool = True):
+    """Deploy an application and wait for it to be RUNNING; returns the
+    ingress DeploymentHandle (reference serve/api.py:578)."""
+    controller = _get_or_create_controller()
+    specs, ingress = build_app_spec(app, name)
+    ray_tpu.get(controller.deploy_application.remote(name, specs, ingress, route_prefix))
+    if _blocking:
+        deadline = time.time() + blocking_timeout_s
+        while time.time() < deadline:
+            st = ray_tpu.get(controller.get_app_status.remote(name))
+            if st["status"] == "RUNNING":
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError(f"application {name!r} did not become RUNNING within {blocking_timeout_s}s: {st}")
+    return DeploymentHandle(controller, name, ingress)
+
+
+def delete(name: str):
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.delete_application.remote(name))
+
+
+def status() -> dict:
+    controller = _get_or_create_controller()
+    apps = ray_tpu.get(controller.list_applications.remote())
+    return {"applications": {a: ray_tpu.get(controller.get_app_status.remote(a)) for a in apps}}
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    controller = _get_or_create_controller()
+    ingress = ray_tpu.get(controller.get_ingress.remote(name))
+    if ingress is None:
+        raise ValueError(f"no application named {name!r}")
+    return DeploymentHandle(controller, name, ingress)
+
+
+def get_deployment_handle(deployment: str, app_name: str = "default") -> DeploymentHandle:
+    controller = _get_or_create_controller()
+    return DeploymentHandle(controller, app_name, deployment)
+
+
+def shutdown():
+    """Tear down all applications, replicas, proxy, and the controller."""
+    global _http_proxy
+    if _http_proxy is not None:
+        _http_proxy.stop()
+        _http_proxy = None
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(controller.graceful_shutdown.remote(), timeout=10)
+    except Exception:
+        pass
+    try:
+        ray_tpu.kill(controller)
+    except Exception:
+        pass

@@ -1,0 +1,73 @@
+"""Serve configuration dataclasses.
+
+Reference parity: python/ray/serve/config.py (AutoscalingConfig,
+HTTPOptions) and _private/config.py (DeploymentConfig, ReplicaConfig) —
+reduced to the knobs that matter on a TPU cluster: replica counts,
+per-replica concurrency, autoscaling window, and the resources a replica
+pins (including "TPU" for warm-engine replicas).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class AutoscalingConfig:
+    """Request-driven replica autoscaling (reference: serve/config.py
+    AutoscalingConfig + _private/autoscaling_state.py decision logic)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_ongoing_requests: float = 2.0
+    # smoothing: how long a scale decision must persist before acting
+    upscale_delay_s: float = 0.0
+    downscale_delay_s: float = 2.0
+    metrics_interval_s: float = 0.2
+    look_back_period_s: float = 2.0
+    upscaling_factor: float = 1.0
+    downscaling_factor: float = 1.0
+
+    def __post_init__(self):
+        if self.min_replicas < 0 or self.max_replicas < max(1, self.min_replicas):
+            raise ValueError("need 0 <= min_replicas <= max_replicas, max >= 1")
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int | None = 1
+    max_ongoing_requests: int = 5
+    autoscaling_config: AutoscalingConfig | None = None
+    health_check_period_s: float = 1.0
+    health_check_timeout_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 5.0
+    user_config: dict | None = None
+
+    def initial_target(self) -> int:
+        if self.autoscaling_config is not None:
+            return max(self.autoscaling_config.min_replicas, 1)
+        return self.num_replicas or 1
+
+
+@dataclass
+class ReplicaConfig:
+    """What each replica actor needs from the scheduler."""
+
+    num_cpus: float = 1.0
+    num_tpus: float = 0.0
+    resources: dict = field(default_factory=dict)
+
+    def to_actor_options(self) -> dict:
+        opts = {"num_cpus": self.num_cpus}
+        res = dict(self.resources)
+        if self.num_tpus:
+            res["TPU"] = self.num_tpus
+        if res:
+            opts["resources"] = res
+        return opts
+
+
+@dataclass
+class HTTPOptions:
+    host: str = "127.0.0.1"
+    port: int = 8000

@@ -1,0 +1,120 @@
+"""@serve.deployment decorator, Deployment, and the bind() application
+graph.
+
+Reference parity: serve/deployment.py (Deployment, deployment decorator,
+Application) and _private/build_app.py (graph -> per-deployment list with
+handle injection). Binding another deployment's node as an init arg
+becomes a DeploymentHandle at replica construction time, which is how
+model-composition apps are built.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, ReplicaConfig
+
+
+@dataclass
+class _HandleMarker:
+    """Placeholder for a bound sub-deployment inside init args; resolved to
+    a DeploymentHandle inside the replica (see _replica_init_resolver)."""
+
+    app_name: str | None
+    deployment: str
+
+
+class Application:
+    """A bound deployment graph node (reference: serve/deployment.py
+    Application = Deployment.bind result)."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+    def _collect(self, out: dict):
+        """DFS the graph, dedup by deployment name."""
+        if self.deployment.name in out:
+            return
+        out[self.deployment.name] = self
+        for a in list(self.args) + list(self.kwargs.values()):
+            if isinstance(a, Application):
+                a._collect(out)
+
+
+@dataclass
+class Deployment:
+    func_or_class: object
+    name: str
+    config: DeploymentConfig = field(default_factory=DeploymentConfig)
+    replica_config: ReplicaConfig = field(default_factory=ReplicaConfig)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def options(self, **kw) -> "Deployment":
+        """Copy-with-overrides (reference Deployment.options)."""
+        cfg_fields = {f for f in DeploymentConfig.__dataclass_fields__}
+        rep_fields = {f for f in ReplicaConfig.__dataclass_fields__}
+        cfg_kw = {k: v for k, v in kw.items() if k in cfg_fields}
+        rep_kw = {k: v for k, v in kw.items() if k in rep_fields}
+        other = {k: v for k, v in kw.items() if k not in cfg_fields and k not in rep_fields and k != "ray_actor_options"}
+        name = other.pop("name", self.name)
+        if "ray_actor_options" in kw:
+            rao = kw["ray_actor_options"] or {}
+            rep_kw.setdefault("num_cpus", rao.get("num_cpus", self.replica_config.num_cpus))
+            rep_kw.setdefault("resources", rao.get("resources", dict(self.replica_config.resources)))
+        if other:
+            raise TypeError(f"unknown deployment options: {sorted(other)}")
+        if isinstance(cfg_kw.get("autoscaling_config"), dict):
+            cfg_kw["autoscaling_config"] = AutoscalingConfig(**cfg_kw["autoscaling_config"])
+        if cfg_kw.get("num_replicas") == "auto":
+            cfg_kw["num_replicas"] = None
+            cfg_kw.setdefault("autoscaling_config", self.config.autoscaling_config or AutoscalingConfig())
+        return Deployment(
+            self.func_or_class,
+            name,
+            replace(self.config, **cfg_kw),
+            replace(self.replica_config, **rep_kw),
+        )
+
+
+def deployment(_func_or_class=None, **kw):
+    """@serve.deployment / @serve.deployment(num_replicas=..., ...)"""
+
+    def make(target):
+        d = Deployment(target, getattr(target, "__name__", "deployment"))
+        return d.options(**kw) if kw else d
+
+    if _func_or_class is not None:
+        return make(_func_or_class)
+    return make
+
+
+def build_app_spec(app: Application, app_name: str) -> tuple[list[dict], str]:
+    """Flatten a bound graph into the controller's deploy payload.
+
+    Returns ([{name, cls_or_fn, init_args, init_kwargs, config,
+    replica_config}], ingress_name). Application-valued args become
+    _HandleMarker(app_name, dep_name).
+    """
+    nodes: dict[str, Application] = {}
+    app._collect(nodes)
+
+    def mark(v):
+        return _HandleMarker(app_name, v.deployment.name) if isinstance(v, Application) else v
+
+    specs = []
+    for name, node in nodes.items():
+        specs.append(
+            {
+                "name": name,
+                "cls_or_fn": node.deployment.func_or_class,
+                "init_args": tuple(mark(a) for a in node.args),
+                "init_kwargs": {k: mark(v) for k, v in node.kwargs.items()},
+                "config": node.deployment.config,
+                "replica_config": node.deployment.replica_config,
+            }
+        )
+    return specs, app.deployment.name

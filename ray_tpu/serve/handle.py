@@ -1,0 +1,217 @@
+"""DeploymentHandle: the client-side router.
+
+Reference parity: serve/handle.py (DeploymentHandle / DeploymentResponse)
++ _private/request_router/pow_2_router.py:52 (power-of-two-choices
+replica selection on queue length) + the handle-side queueing and metric
+push from _private/router.py.
+
+The handle caches the RUNNING replica set (refreshed from the controller
+when its version changes or on a short interval), tracks its own
+in-flight count per replica, and enforces max_ongoing_requests
+client-side: requests beyond capacity queue here — queue depth is the
+autoscaler's upscale signal, pushed via record_handle_metrics.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import uuid
+
+import ray_tpu
+
+_REFRESH_INTERVAL_S = 0.25
+
+
+class DeploymentResponse:
+    """Future for one request (reference: serve/handle.py
+    DeploymentResponse). `result()` blocks; `_to_object_ref()` unwraps for
+    composition with ray_tpu.get/wait."""
+
+    def __init__(self, router, replica_id, ref):
+        self._router = router
+        self._replica_id = replica_id
+        self._ref = ref
+        self._done = False
+
+    def _settle(self):
+        if not self._done:
+            self._done = True
+            self._router._on_done(self._replica_id, self._ref)
+
+    def result(self, timeout_s: float | None = None):
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout_s)
+        finally:
+            self._settle()
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class _Router:
+    """Pow-2 replica choice + client-side admission control."""
+
+    def __init__(self, controller, app_name: str, deployment: str):
+        self._controller = controller
+        self._app = app_name
+        self._deployment = deployment
+        self._handle_id = uuid.uuid4().hex[:8]
+        self._lock = threading.Condition()
+        self._version = -1
+        self._replicas: list = []  # [(replica_id, actor)]
+        self._max_ongoing = 1
+        self._inflight: dict[str, int] = {}
+        self._inflight_refs: dict = {}  # ref-id -> replica_id
+        self._queued = 0
+        self._last_refresh = 0.0
+        self._last_push = 0.0
+
+    # -- controller sync --
+
+    def _refresh(self, force: bool = False):
+        now = time.time()
+        if not force and now - self._last_refresh < _REFRESH_INTERVAL_S:
+            return
+        self._last_refresh = now
+        version, replicas, max_ongoing = ray_tpu.get(
+            self._controller.get_replicas.remote(self._app, self._deployment, self._version)
+        )
+        with self._lock:
+            if version != self._version:
+                self._version = version
+                self._replicas = replicas
+                self._max_ongoing = max(1, max_ongoing)
+                live = {rid for rid, _ in replicas}
+                self._inflight = {rid: self._inflight.get(rid, 0) for rid in live}
+                self._lock.notify_all()
+        self._push_metrics()
+
+    def _push_metrics(self):
+        now = time.time()
+        if now - self._last_push < _REFRESH_INTERVAL_S / 2:
+            return
+        self._last_push = now
+        with self._lock:
+            demand = self._queued + sum(self._inflight.values())
+        try:
+            self._controller.record_handle_metrics.remote(self._app, self._deployment, self._handle_id, demand)
+        except Exception:
+            pass
+
+    # -- bookkeeping --
+
+    def _on_done(self, replica_id, ref):
+        with self._lock:
+            if self._inflight_refs.pop(id(ref), None) is not None and replica_id in self._inflight:
+                self._inflight[replica_id] = max(0, self._inflight[replica_id] - 1)
+                self._lock.notify_all()
+        self._push_metrics()
+
+    def _reap(self):
+        """Settle finished in-flight refs without fetching their values."""
+        with self._lock:
+            pending = list(self._inflight_refs.items())
+        if not pending:
+            return
+        refs = [ref for _, (ref, _) in pending]
+        ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0, fetch_local=False)
+        ready_ids = {id(r) for r in ready}
+        with self._lock:
+            for key, (ref, rid) in pending:
+                if id(ref) in ready_ids and key in self._inflight_refs:
+                    del self._inflight_refs[key]
+                    if rid in self._inflight:
+                        self._inflight[rid] = max(0, self._inflight[rid] - 1)
+            if ready_ids:
+                self._lock.notify_all()
+
+    # -- the router --
+
+    def _pick_replica(self):
+        """Two random choices, take the lower local in-flight count; None
+        if every replica is at max_ongoing_requests."""
+        candidates = [(rid, actor) for rid, actor in self._replicas if self._inflight.get(rid, 0) < self._max_ongoing]
+        if not candidates:
+            return None
+        if len(candidates) <= 2:
+            picks = candidates
+        else:
+            picks = random.sample(candidates, 2)
+        return min(picks, key=lambda c: self._inflight.get(c[0], 0))
+
+    def submit(self, method_name: str, args: tuple, kwargs: dict, timeout_s: float | None = 60.0):
+        deadline = time.time() + timeout_s if timeout_s else None
+        self._refresh(force=not self._replicas)
+        with self._lock:
+            self._queued += 1
+        try:
+            while True:
+                with self._lock:
+                    pick = self._pick_replica() if self._replicas else None
+                    if pick is not None:
+                        rid, actor = pick
+                        self._inflight[rid] = self._inflight.get(rid, 0) + 1
+                        break
+                # no capacity: reap completions, re-sync, wait a beat
+                self._reap()
+                self._refresh(force=True)
+                with self._lock:
+                    if self._pick_replica() is None:
+                        self._lock.wait(timeout=0.05)
+                if deadline and time.time() > deadline:
+                    raise TimeoutError(
+                        f"no replica of {self._app}/{self._deployment} accepted the request within {timeout_s}s"
+                    )
+        finally:
+            with self._lock:
+                self._queued -= 1
+        self._push_metrics()
+        try:
+            ref = actor.handle_request.remote(method_name, args, kwargs)
+        except Exception:
+            with self._lock:
+                if rid in self._inflight:
+                    self._inflight[rid] = max(0, self._inflight[rid] - 1)
+            raise
+        with self._lock:
+            self._inflight_refs[id(ref)] = (ref, rid)
+        return DeploymentResponse(self, rid, ref)
+
+
+class DeploymentHandle:
+    """User-facing handle; `.remote()` routes one request.
+
+    h = serve.get_app_handle("app")
+    ref = h.remote(x) / h.method.remote(x); ref.result()
+    """
+
+    def __init__(self, controller, app_name: str, deployment: str, method_name: str = "__call__"):
+        self._controller = controller
+        self._app = app_name
+        self._deployment = deployment
+        self._method = method_name
+        self._router = _Router(controller, app_name, deployment)
+
+    def options(self, method_name: str | None = None):
+        h = DeploymentHandle(self._controller, self._app, self._deployment, method_name or self._method)
+        h._router = self._router  # share the router: one in-flight view
+        return h
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodProxy(self, name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._router.submit(self._method, args, kwargs)
+
+
+class _MethodProxy:
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._router.submit(self._method, args, kwargs)

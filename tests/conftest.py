@@ -8,7 +8,11 @@ strategy for testing multi-host GSPMD without TPUs; see SURVEY.md §4).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# HARD-set (not setdefault): the container exports JAX_PLATFORMS=axon (the
+# tunneled TPU). Worker processes spawned by the runtime inherit os.environ,
+# and a worker on the axon backend turns every eager jax op into a network
+# round trip — test workers must inherit cpu.
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 

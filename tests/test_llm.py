@@ -1,0 +1,134 @@
+"""LLM engine tests: decode parity with the full forward pass, continuous
+batching admission/eviction under load, streaming, sampling controls.
+
+Reference test strategy modeled on python/ray/llm tests (engine behavior)
+— but parity here is exact: incremental KV-cache decode must reproduce
+full-recompute greedy decoding token for token.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.llm import LLMEngine, SamplingParams  # noqa: E402
+from ray_tpu.models.llama import LlamaConfig, forward, init_params  # noqa: E402
+
+CFG = LlamaConfig.tiny(dtype="float32", remat=False, max_seq_len=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def full_forward_greedy(params, prompt, n_tokens):
+    """Oracle: recompute the whole sequence every step, argmax last logit."""
+    toks = list(prompt)
+    for _ in range(n_tokens):
+        logits = forward(params, jnp.asarray([toks]), CFG)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def test_greedy_decode_matches_full_forward(params):
+    eng = LLMEngine(CFG, params, max_num_seqs=2, max_seq_len=64)
+    prompt = [3, 17, 40, 7, 99]
+    out = eng.generate(prompt, SamplingParams(max_tokens=12, temperature=0.0))
+    oracle = full_forward_greedy(params, prompt, 12)
+    assert out.token_ids == oracle
+    assert out.finished and out.finish_reason == "length"
+
+
+def test_batched_prompts_match_sequential(params):
+    eng = LLMEngine(CFG, params, max_num_seqs=4, max_seq_len=64)
+    prompts = [[1, 2, 3], [10, 20, 30, 40], [5], [7, 8]]
+    outs = eng.generate(prompts, SamplingParams(max_tokens=8))
+    for p, o in zip(prompts, outs):
+        assert o.token_ids == full_forward_greedy(params, p, 8), f"prompt {p}"
+
+
+def test_continuous_batching_under_load(params):
+    """10 requests through 2 slots: all finish, each correct."""
+    eng = LLMEngine(CFG, params, max_num_seqs=2, max_seq_len=64)
+    prompts = [[i + 1, i + 2] for i in range(10)]
+    ids = [eng.add_request(p, SamplingParams(max_tokens=5)) for p in prompts]
+    assert eng.num_waiting == 10
+    finals = {}
+    steps = 0
+    max_running = 0
+    while eng.has_unfinished():
+        for o in eng.step():
+            if o.finished:
+                finals[o.request_id] = o
+        max_running = max(max_running, eng.num_running)
+        steps += 1
+        assert steps < 200
+    assert set(finals) == set(ids)
+    assert max_running <= 2
+    for p, rid in zip(prompts, ids):
+        assert finals[rid].token_ids == full_forward_greedy(params, p, 5)
+
+
+def test_stop_tokens_and_abort(params):
+    eng = LLMEngine(CFG, params, max_num_seqs=2, max_seq_len=64)
+    # discover greedy token stream, then use its 3rd token as a stop id
+    oracle = full_forward_greedy(params, [4, 4], 6)
+    stop = oracle[2]
+    out = eng.generate([4, 4], SamplingParams(max_tokens=6, stop_token_ids=(stop,)))
+    assert out.finish_reason == "stop"
+    assert out.token_ids == oracle[:3]  # stop token is included, then halt
+
+    rid = eng.add_request([1, 2, 3], SamplingParams(max_tokens=50))
+    assert eng.abort_request(rid)
+    while eng.has_unfinished():
+        eng.step()
+    assert not eng.abort_request(rid)  # already gone
+
+
+def test_sampling_seeded_and_temperature(params):
+    eng = LLMEngine(CFG, params, max_num_seqs=2, max_seq_len=64)
+    sp = SamplingParams(max_tokens=10, temperature=1.0, seed=7)
+    a = eng.generate([2, 3], sp).token_ids
+    b = eng.generate([2, 3], sp).token_ids
+    assert a == b  # same seed, same stream
+    c = eng.generate([2, 3], SamplingParams(max_tokens=10, temperature=1.0, seed=8)).token_ids
+    # different seed should (overwhelmingly) differ somewhere
+    assert a != c or len(set(a)) == 1
+
+
+def test_top_k_one_is_greedy(params):
+    eng = LLMEngine(CFG, params, max_num_seqs=1, max_seq_len=64)
+    out = eng.generate([9, 9], SamplingParams(max_tokens=8, temperature=5.0, top_k=1, seed=0))
+    assert out.token_ids == full_forward_greedy(params, [9, 9], 8)
+
+
+def test_streaming(params):
+    eng = LLMEngine(CFG, params, max_num_seqs=1, max_seq_len=64)
+    rid = eng.add_request([5, 6], SamplingParams(max_tokens=4), stream=True)
+    st = eng._requests[rid]
+    got = []
+    while eng.has_unfinished():
+        eng.step()
+    while True:
+        item = st.out_queue.get_nowait()
+        if item is None:
+            break
+        got.append(item)
+    assert got == full_forward_greedy(params, [5, 6], 4)
+
+
+def test_admission_rejects_oversized_prompt(params):
+    eng = LLMEngine(CFG, params, max_num_seqs=1, max_seq_len=32)
+    with pytest.raises(ValueError):
+        eng.add_request(list(range(30)), SamplingParams(max_tokens=10))
+
+
+def test_prefill_bucketing_no_recompile_per_length(params):
+    """Prompts of length 3 and 5 share the 64-bucket prefill program."""
+    eng = LLMEngine(CFG, params, max_num_seqs=2, max_seq_len=64, prefill_buckets=(16, 64))
+    o1 = eng.generate([1, 2, 3], SamplingParams(max_tokens=3))
+    o2 = eng.generate([1, 2, 3, 4, 5], SamplingParams(max_tokens=3))
+    assert o1.token_ids == full_forward_greedy(params, [1, 2, 3], 3)
+    assert o2.token_ids == full_forward_greedy(params, [1, 2, 3, 4, 5], 3)

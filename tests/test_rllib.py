@@ -42,7 +42,8 @@ def test_vtrace_on_policy_reduces_to_discounted_returns():
 
     vs, pg_adv = vtrace(
         jnp.asarray(logp), jnp.asarray(logp), jnp.asarray(rewards), jnp.asarray(values),
-        jnp.asarray(bootstrap), jnp.asarray(mask), gamma, rho_clip=1.0, c_clip=1.0,
+        jnp.asarray(bootstrap), jnp.asarray(mask), jnp.ones((N, T), np.float32),
+        gamma, rho_clip=1.0, c_clip=1.0,
     )
     expected = np.zeros((N, T))
     for i in range(N):

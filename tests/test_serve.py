@@ -1,0 +1,254 @@
+"""Serve tests: deploy/route/scale/compose/HTTP/autoscale/health.
+
+Reference test strategy: python/ray/serve/tests/test_standalone.py and
+test_autoscaling_policy.py shapes, collapsed to the essentials.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_session():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_deploy_and_call(serve_session):
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return ("echo", x)
+
+        def shout(self, x):
+            return str(x).upper()
+
+    h = serve.run(Echo.bind(), name="echo_app")
+    assert h.remote(41).result() == ("echo", 41)
+    assert h.shout.remote("hi").result() == "HI"
+    st = serve.status()
+    assert st["applications"]["echo_app"]["status"] == "RUNNING"
+
+
+def test_function_deployment(serve_session):
+    @serve.deployment
+    def double(x):
+        return 2 * x
+
+    h = serve.run(double.bind(), name="fn_app")
+    assert h.remote(21).result() == 42
+
+
+def test_init_args_and_user_config(serve_session):
+    @serve.deployment
+    class Greeter:
+        def __init__(self, greeting):
+            self.greeting = greeting
+            self.suffix = ""
+
+        def reconfigure(self, cfg):
+            self.suffix = cfg.get("suffix", "")
+
+        def __call__(self, name):
+            return f"{self.greeting}, {name}{self.suffix}"
+
+    d = Greeter.options(user_config={"suffix": "!"})
+    h = serve.run(d.bind("hello"), name="greet")
+    assert h.remote("tpu").result() == "hello, tpu!"
+
+
+def test_multiple_replicas_spread_load(serve_session):
+    @serve.deployment(num_replicas=3, max_ongoing_requests=2)
+    class WhoAmI:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+
+        def __call__(self):
+            time.sleep(0.05)
+            return self.pid
+
+    h = serve.run(WhoAmI.bind(), name="who")
+    refs = [h.remote() for _ in range(12)]
+    pids = {r.result() for r in refs}
+    assert len(pids) >= 2, f"expected load spread across replicas, saw {pids}"
+
+
+def test_composition_handle_injection(serve_session):
+    @serve.deployment
+    class Adder:
+        def __init__(self, amount):
+            self.amount = amount
+
+        def __call__(self, x):
+            return x + self.amount
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, adder):
+            self.adder = adder
+
+        def __call__(self, x):
+            return self.adder.remote(x).result() * 10
+
+    app = Pipeline.bind(Adder.bind(5))
+    h = serve.run(app, name="pipe")
+    assert h.remote(1).result() == 60
+
+
+def test_redeploy_updates_code(serve_session):
+    @serve.deployment
+    class V:
+        def __call__(self):
+            return 1
+
+    serve.run(V.bind(), name="ver")
+
+    @serve.deployment(name="V")
+    class V2:
+        def __call__(self):
+            return 2
+
+    h = serve.run(V2.bind(), name="ver")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if h.remote().result() == 2:
+            break
+        time.sleep(0.1)
+    assert h.remote().result() == 2
+
+
+def test_delete_application(serve_session):
+    @serve.deployment
+    def f():
+        return "ok"
+
+    serve.run(f.bind(), name="delme")
+    serve.delete("delme")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if serve.status()["applications"].get("delme") is None:
+            break
+        time.sleep(0.1)
+    assert "delme" not in serve.status()["applications"]
+
+
+def test_http_proxy_end_to_end(serve_session):
+    import urllib.request
+
+    @serve.deployment
+    class Api:
+        def __call__(self, request):
+            if request.path == "/sum":
+                data = request.json()
+                return {"sum": sum(data["xs"])}
+            return {"path": request.path, "q": request.query_params}
+
+    serve.start(serve.HTTPOptions(port=0), proxy=True)
+    serve.run(Api.bind(), name="api", route_prefix="/api")
+    port = serve.api._http_proxy.port
+
+    import json as _json
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/sum",
+        data=_json.dumps({"xs": [1, 2, 3]}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert _json.loads(resp.read()) == {"sum": 6}
+
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/api/echo?a=1", timeout=30) as resp:
+        out = _json.loads(resp.read())
+    assert out == {"path": "/echo", "q": {"a": "1"}}
+
+
+def test_autoscaling_up_and_down(serve_session):
+    @serve.deployment(
+        max_ongoing_requests=1,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1,
+            max_replicas=4,
+            target_ongoing_requests=1.0,
+            upscale_delay_s=0.0,
+            downscale_delay_s=0.5,
+            metrics_interval_s=0.1,
+            look_back_period_s=0.4,
+        ),
+    )
+    class Slow:
+        def __call__(self):
+            time.sleep(0.4)
+            return "done"
+
+    h = serve.run(Slow.bind(), name="auto")
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+
+    def target():
+        return ray_tpu.get(controller.get_deployment_status.remote("auto", "Slow"))["target_replicas"]
+
+    assert target() == 1
+    # flood: 8 concurrent requests against target_ongoing=1 -> scale up
+    refs = [h.remote() for _ in range(8)]
+    scaled = 1
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        scaled = max(scaled, target())
+        if scaled >= 3:
+            break
+        refs = [r for r in refs if True]
+        time.sleep(0.05)
+    assert scaled >= 3, f"never scaled up past {scaled}"
+    for r in refs:
+        r.result(timeout_s=30)
+    # idle -> back down to min
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if target() == 1:
+            break
+        time.sleep(0.1)
+    assert target() == 1, "did not scale back down to min_replicas"
+
+
+def test_replica_crash_recovery(serve_session):
+    @serve.deployment(num_replicas=1)
+    class Fragile:
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+        def __call__(self):
+            return "alive"
+
+    h = serve.run(Fragile.bind(), name="fragile")
+    pid0 = h.pid.remote().result()
+    try:
+        h.die.remote().result(timeout_s=5)
+    except Exception:
+        pass
+    # controller health checks should replace the dead replica
+    deadline = time.time() + 30
+    ok = False
+    while time.time() < deadline:
+        try:
+            if h.pid.remote().result(timeout_s=5) != pid0:
+                ok = True
+                break
+        except Exception:
+            time.sleep(0.2)
+    assert ok, "replica was not replaced after crash"
