@@ -225,6 +225,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k"))
 def _bwd_pallas(q, k, v, o, lse, g, causal=True, scale=None, block_q=512, block_k=512):
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    return _bwd_pallas_with_delta(q, k, v, g, lse, delta, causal=causal, scale=scale, block_q=block_q, block_k=block_k)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k"))
+def _bwd_pallas_with_delta(q, k, v, g, lse, delta, causal=True, scale=None, block_q=512, block_k=512):
+    """Backward kernels with a caller-supplied delta = sum(dO * O, -1).
+
+    Ring attention computes delta once from the globally-merged output and
+    reuses it for every ring step's local backward (delta: [B, H, T] f32).
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -236,7 +247,7 @@ def _bwd_pallas(q, k, v, o, lse, g, causal=True, scale=None, block_q=512, block_
     block_k = min(block_k, Tk)
     qs, ks, vs, dos = (x.reshape(B * H, x.shape[2], D) for x in (q, k, v, g))
     lse3 = lse.reshape(B * H, 1, T)
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1).reshape(B * H, 1, T)
+    delta = delta.reshape(B * H, 1, T)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k),
@@ -364,6 +375,119 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 # kept for callers/tests that used the older name
 _flash_fwd_pallas = _fwd_pallas
+
+
+# ----------------------------------------------------------------------
+# chunked (blockwise) XLA attention: O(T * chunk) memory, no pallas.
+# The non-pallas path of ring attention (parallel/ring_attention.py) — a
+# lax.scan over kv chunks with an online-softmax carry, so the full
+# [Tq, Tk] score matrix never exists.
+# ----------------------------------------------------------------------
+def _pick_chunk(T: int, target: int) -> int:
+    if T <= target:
+        return T
+    for c in range(target, 0, -1):
+        if T % c == 0:
+            return c
+    return T
+
+
+def chunked_attention_fwd(q, k, v, causal: bool, scale: float, chunk: int = 1024):
+    """Returns (o [B,H,Tq,D] f32, lse [B,H,Tq] f32). kv is consumed in
+    chunks of `chunk`; the first chunk initializes the online-softmax carry
+    (for causal it always contains key 0, so no -inf max to guard)."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    q32 = q.astype(jnp.float32)
+    C = _pick_chunk(Tk, chunk)
+    nk = Tk // C
+
+    def attend_chunk(k_c, v_c, k_off):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_c.astype(jnp.float32), preferred_element_type=jnp.float32) * scale
+        if causal:
+            qp = jax.lax.broadcasted_iota(jnp.int32, (Tq, C), 0)
+            kp = k_off + jax.lax.broadcasted_iota(jnp.int32, (Tq, C), 1)
+            s = jnp.where((kp <= qp)[None, None], s, _NEG_INF)
+        m = jnp.max(s, axis=-1)
+        p = jnp.exp(s - m[..., None])
+        return m, jnp.sum(p, axis=-1), jnp.einsum("bhqk,bhkd->bhqd", p, v_c.astype(jnp.float32))
+
+    m0, l0, acc0 = attend_chunk(k[:, :, :C], v[:, :, :C], 0)
+    if nk > 1:
+        ks = jnp.moveaxis(k[:, :, C:].reshape(B, H, nk - 1, C, D), 2, 0)
+        vs = jnp.moveaxis(v[:, :, C:].reshape(B, H, nk - 1, C, D), 2, 0)
+
+        def body(carry, xs):
+            m, l, acc = carry
+            k_c, v_c, j = xs
+            m_b, l_b, acc_b = attend_chunk(k_c, v_c, (j + 1) * C)
+            m_new = jnp.maximum(m, m_b)
+            alpha = jnp.exp(m - m_new)
+            beta = jnp.exp(m_b - m_new)
+            return (m_new, alpha * l + beta * l_b, acc * alpha[..., None] + acc_b * beta[..., None]), None
+
+        (m0, l0, acc0), _ = jax.lax.scan(body, (m0, l0, acc0), (ks, vs, jnp.arange(nk - 1)))
+    l_safe = jnp.maximum(l0, 1e-30)
+    return acc0 / l_safe[..., None], m0 + jnp.log(l_safe)
+
+
+def chunked_attention_bwd(q, k, v, g, lse, delta, causal: bool, scale: float, chunk: int = 1024):
+    """Chunked backward given the (globally merged, in the ring case) lse
+    and delta = sum(dO*O, -1). Returns (dq, dk, dv) in f32.
+
+    dq scans kv chunks ([Tq, C] live at a time); dk/dv scan q chunks
+    ([Cq, Tk] live at a time) — mirrors the pallas kernel split."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    q32, k32, v32, g32 = (x.astype(jnp.float32) for x in (q, k, v, g))
+
+    Ck = _pick_chunk(Tk, chunk)
+    nk = Tk // Ck
+    ks = jnp.moveaxis(k32.reshape(B, H, nk, Ck, D), 2, 0)
+    vs = jnp.moveaxis(v32.reshape(B, H, nk, Ck, D), 2, 0)
+
+    def dq_body(dq, xs):
+        k_c, v_c, j = xs
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_c, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qp = jax.lax.broadcasted_iota(jnp.int32, (Tq, Ck), 0)
+            kp = j * Ck + jax.lax.broadcasted_iota(jnp.int32, (Tq, Ck), 1)
+            s = jnp.where((kp <= qp)[None, None], s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])
+        dp = jnp.einsum("bhqd,bhkd->bhqk", g32, v_c, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * scale
+        return dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_c), None
+
+    dq, _ = jax.lax.scan(dq_body, jnp.zeros((B, H, Tq, D), jnp.float32), (ks, vs, jnp.arange(nk)))
+
+    Cq = _pick_chunk(Tq, chunk)
+    nq = Tq // Cq
+    qs = jnp.moveaxis(q32.reshape(B, H, nq, Cq, D), 2, 0)
+    gs = jnp.moveaxis(g32.reshape(B, H, nq, Cq, D), 2, 0)
+    lses = jnp.moveaxis(lse.reshape(B, H, nq, Cq), 2, 0)
+    deltas = jnp.moveaxis(delta.reshape(B, H, nq, Cq), 2, 0)
+
+    def dkv_body(carry, xs):
+        dk, dv = carry
+        q_c, g_c, lse_c, delta_c, i = xs
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_c, k32, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qp = i * Cq + jax.lax.broadcasted_iota(jnp.int32, (Cq, Tk), 0)
+            kp = jax.lax.broadcasted_iota(jnp.int32, (Cq, Tk), 1)
+            s = jnp.where((kp <= qp)[None, None], s, _NEG_INF)
+        p = jnp.exp(s - lse_c[..., None])
+        dv_c = jnp.einsum("bhqk,bhqd->bhkd", p, g_c)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", g_c, v32, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_c[..., None]) * scale
+        dk_c = jnp.einsum("bhqk,bhqd->bhkd", ds, q_c)
+        return (dk + dk_c, dv + dv_c), None
+
+    (dk, dv), _ = jax.lax.scan(
+        dkv_body,
+        (jnp.zeros((B, H, Tk, D), jnp.float32), jnp.zeros((B, H, Tk, D), jnp.float32)),
+        (qs, gs, lses, deltas, jnp.arange(nq)),
+    )
+    return dq, dk, dv
 
 
 def _use_pallas(q, impl: str = "auto") -> bool:
