@@ -1,0 +1,209 @@
+"""Mixture-of-Experts Llama variant with expert parallelism over the `ep`
+mesh axis.
+
+The reference has no MoE/expert-parallel implementation of its own (it
+passes engine args through to vLLM — SURVEY.md §2.5); here it is
+first-class and TPU-native, GShard/Switch-style:
+
+- top-k router with capacity-based token dropping, built from one-hot
+  matmuls and cumulative sums — every shape static, everything lowers to
+  MXU einsums (no gather/scatter, no ragged shapes).
+- expert weights carry a leading `E` dim with logical axis "expert" -> ep
+  (parallel/mesh.py ShardingRules), so GSPMD shards experts across chips
+  and inserts the dispatch/return all-to-alls on ICI automatically from
+  the einsum operands' shardings.
+- grouped dispatch: tokens are dispatched per group (dim G below) so the
+  [G, S, E, C] dispatch tensor stays small; groups ride the batch (dp)
+  sharding.
+- aux losses per Switch Transformer: load-balance (fraction-routed x
+  fraction-probability) and router z-loss, both returned from loss_fn.
+
+Layer stack: same GQA attention blocks as models/llama.py; the dense
+SwiGLU MLP is replaced by the MoE block every `moe_every` layers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig, _attention_block
+from ray_tpu.ops.layers import cross_entropy_loss, rms_norm, rotary_embedding
+
+
+@dataclass(frozen=True)
+class MoEConfig(LlamaConfig):
+    num_experts: int = 8
+    experts_per_token: int = 2  # top-k
+    capacity_factor: float = 1.25
+    router_z_coeff: float = 1e-3
+    balance_coeff: float = 1e-2
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(
+            vocab_size=512, hidden_size=128, intermediate_size=256, num_layers=2,
+            num_heads=4, num_kv_heads=2, max_seq_len=256, num_experts=4, experts_per_token=2,
+        )
+        return MoEConfig(**{**base, **kw})
+
+
+def param_logical_axes(config: MoEConfig):
+    return {
+        "embed": ("vocab", "embed"),
+        "unembed": ("embed", "vocab"),
+        "final_norm": (None,),
+        "layers": {
+            "wq": (None, "embed", "heads"),
+            "wk": (None, "embed", "kv_heads"),
+            "wv": (None, "embed", "kv_heads"),
+            "wo": (None, "heads", "embed"),
+            "attn_norm": (None, None),
+            "mlp_norm": (None, None),
+            "w_router": (None, "embed", "expert"),
+            "we_gate": (None, "expert", "embed", "mlp"),
+            "we_up": (None, "expert", "embed", "mlp"),
+            "we_down": (None, "expert", "mlp", "embed"),
+        },
+    }
+
+
+def init_params(config: MoEConfig, key) -> dict:
+    h, hd, dt = config.hidden_size, config.hd, jnp.dtype(config.dtype)
+    L, E, I = config.num_layers, config.num_experts, config.intermediate_size
+    keys = jax.random.split(key, 12)
+
+    def dense(k, *shape, fan_in):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in**-0.5)).astype(dt)
+
+    return {
+        "embed": dense(keys[0], config.vocab_size, h, fan_in=h),
+        "unembed": dense(keys[1], h, config.vocab_size, fan_in=h),
+        "final_norm": jnp.ones((h,), dt),
+        "layers": {
+            "wq": dense(keys[2], L, h, config.num_heads * hd, fan_in=h),
+            "wk": dense(keys[3], L, h, config.num_kv_heads * hd, fan_in=h),
+            "wv": dense(keys[4], L, h, config.num_kv_heads * hd, fan_in=h),
+            "wo": dense(keys[5], L, config.num_heads * hd, h, fan_in=config.num_heads * hd),
+            "attn_norm": jnp.ones((L, h), dt),
+            "mlp_norm": jnp.ones((L, h), dt),
+            # router stays f32: tiny, and routing decisions are precision-
+            # sensitive (Switch Transformer recipe)
+            "w_router": jax.random.normal(keys[6], (L, h, E), jnp.float32) * (h**-0.5),
+            "we_gate": dense(keys[7], L, E, h, I, fan_in=h),
+            "we_up": dense(keys[8], L, E, h, I, fan_in=h),
+            "we_down": dense(keys[9], L, E, I, h, fan_in=I),
+        },
+    }
+
+
+def _top_k_dispatch(probs, k: int, capacity: int):
+    """probs: [G, S, E] router probabilities. Returns (dispatch [G,S,E,C]
+    bool-ish f32, combine [G,S,E,C] f32, aux dict).
+
+    Choices are made greedily (choice 0 = argmax, then masked re-argmax),
+    each choice claims a slot via a token-order cumsum within its expert;
+    tokens past `capacity` are dropped (their combine weight is 0 — the
+    residual connection carries them through unchanged).
+    """
+    G, S, E = probs.shape
+    remaining = probs
+    counts = jnp.zeros((G, 1, E), probs.dtype)  # slots claimed so far per expert
+    dispatch = jnp.zeros((G, S, E, capacity), probs.dtype)
+    combine = jnp.zeros((G, S, E, capacity), probs.dtype)
+    frac_routed = jnp.zeros((G, E), probs.dtype)
+
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)  # [G, S]
+        onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)  # [G, S, E]
+        gate = jnp.sum(probs * onehot, axis=-1)  # [G, S]
+        # position of each token in its chosen expert's queue (token order)
+        pos = jnp.cumsum(onehot, axis=1) - onehot + counts  # [G, S, E]
+        pos_tok = jnp.sum(pos * onehot, axis=-1)  # [G, S]
+        keep = pos_tok < capacity
+        pos_oh = jax.nn.one_hot(pos_tok, capacity, dtype=probs.dtype)  # [G, S, C]
+        slot = onehot[..., None] * pos_oh[:, :, None, :]  # [G, S, E, C]
+        slot = slot * keep[:, :, None, None]
+        dispatch = dispatch + slot
+        combine = combine + slot * gate[:, :, None, None]
+        counts = counts + jnp.sum(onehot * keep[..., None], axis=1, keepdims=True)
+        frac_routed = frac_routed + jnp.mean(onehot, axis=1)
+        remaining = remaining * (1.0 - onehot)  # mask chosen expert for next choice
+
+    # normalize combine gates over the k chosen experts (top-k softmax mass)
+    denom = jnp.sum(combine, axis=(2, 3), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    aux = {"frac_routed": frac_routed / k}
+    return dispatch, combine, aux
+
+
+def moe_block(x, layer, config: MoEConfig):
+    """x: [B, T, H] -> [B, T, H]; returns (out, aux_losses [2])."""
+    B, T, H = x.shape
+    E, k = config.num_experts, config.experts_per_token
+    xn = rms_norm(x, layer["mlp_norm"], config.rms_eps)
+    # groups = batch rows: dispatch tensors stay [B, T, E, C] and ride the
+    # existing dp/fsdp batch sharding
+    logits = jnp.einsum("gsh,he->gse", xn.astype(jnp.float32), layer["w_router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    capacity = max(1, int(config.capacity_factor * k * T / E))
+    dispatch, combine, aux = _top_k_dispatch(probs, k, capacity)
+
+    # dispatch tokens to expert buffers: [G, E, C, H] (ep-sharded on E)
+    xe = jnp.einsum("gsec,gsh->gech", dispatch.astype(xn.dtype), xn)
+    g = jnp.einsum("gech,ehi->geci", xe, layer["we_gate"])
+    u = jnp.einsum("gech,ehi->geci", xe, layer["we_up"])
+    ye = jnp.einsum("geci,eih->gech", jax.nn.silu(g) * u, layer["we_down"])
+    y = jnp.einsum("gsec,gech->gsh", combine.astype(ye.dtype), ye)
+
+    # Switch aux losses: balance = E * sum_e f_e * p_e ; z = mean(lse^2)
+    frac_prob = jnp.mean(probs, axis=1)  # [G, E]
+    balance = E * jnp.mean(jnp.sum(aux["frac_routed"] * frac_prob, axis=-1))
+    z = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    return x + y, jnp.stack([balance, z])
+
+
+def _layer_fn(x, layer, config: MoEConfig, cos, sin, positions, mesh=None):
+    x = _attention_block(x, layer, config, cos, sin, positions, mesh=mesh)
+    x, aux = moe_block(x, layer, config)
+    return x, aux
+
+
+def forward(params, tokens, config: MoEConfig, positions=None, mesh=None):
+    """tokens [B, T] -> (logits [B, T, V], aux_losses [2])."""
+    B, T = tokens.shape
+    if positions is None:
+        positions = jnp.arange(T, dtype=jnp.int32)
+    cos, sin = rotary_embedding(positions, config.hd, config.rope_theta, dtype=jnp.float32)
+    x = jnp.take(params["embed"], tokens, axis=0)
+
+    layer_fn = partial(_layer_fn, config=config, cos=cos, sin=sin, positions=positions, mesh=mesh)
+    if config.remat:
+        policy = getattr(jax.checkpoint_policies, config.remat_policy)
+        layer_fn = jax.checkpoint(layer_fn, policy=policy)
+
+    if config.scan_layers:
+        def body(carry, layer):
+            out, aux = layer_fn(carry, layer)
+            return out, aux
+
+        x, auxs = jax.lax.scan(body, x, params["layers"])
+        aux = jnp.sum(auxs, axis=0)
+    else:
+        aux = jnp.zeros((2,))
+        for i in range(config.num_layers):
+            layer = jax.tree.map(lambda p: p[i], params["layers"])
+            x, a = layer_fn(x, layer)
+            aux = aux + a
+
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    return jnp.dot(x, params["unembed"], preferred_element_type=jnp.float32), aux
+
+
+def loss_fn(params, batch, config: MoEConfig, mesh=None):
+    logits, aux = forward(params, batch["tokens"], config, mesh=mesh)
+    ce = cross_entropy_loss(logits, batch["targets"])
+    return ce + config.balance_coeff * aux[0] + config.router_z_coeff * aux[1]

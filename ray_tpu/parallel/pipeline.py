@@ -1,0 +1,194 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over the `pp`
+mesh axis, inside ONE jitted SPMD program.
+
+TPU-native replacement for the reference's compiled-graph pipelines
+(python/ray/dag/compiled_dag_node.py + experimental/channel/
+torch_tensor_accelerator_channel.py): where the reference wires actor
+stages together with NCCL channels and a compiled schedule, here the
+schedule IS the XLA program — stages are devices along the `pp` mesh
+axis, activations hop stage-to-stage with `lax.ppermute` (a neighbor
+copy on ICI/DCN), and the whole (M + n - 1)-tick loop is a `lax.scan`
+that jax.grad differentiates into the reverse pipeline automatically.
+
+Design:
+- layer-stacked params [L, ...] are reshaped to [n_stages, L/n, ...] and
+  sharded `P('pp')` on the leading dim: each device materializes only its
+  own stage's weights (the pp memory win).
+- the batch is split into M microbatches. At tick t, stage 0 feeds
+  microbatch t (while t < M); every stage applies its L/n layers to its
+  current activation; the result hops to the next stage. After n-1 warmup
+  ticks the pipe is full; total ticks = M + n - 1, bubble fraction
+  (n-1)/(M+n-1).
+- shard_map is manual ONLY over `pp` (`axes` arg) — dp/fsdp/tp stay
+  auto, so XLA still shards batch/params inside each stage exactly as in
+  the non-pp program.
+- embedding/unembedding stay OUTSIDE the pipeline region (auto-sharded;
+  their FLOPs are marginal), which keeps their gradients trivially
+  correct: the transpose of the replicated-in/psum-out shard_map handles
+  the stage-gated activations.
+
+Composition notes: pp × {dp, fsdp, tp} is supported. pp × sp is not —
+ring attention runs its own shard_map over `sp` and JAX does not nest
+manual regions; use Ulysses-style head sharding via tp for long sequences
+in pipelined configs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def to_stage_stacked(layer_params, n_stages: int):
+    """[L, ...]-stacked layer params -> [n_stages, L/n, ...]."""
+
+    def reshape(leaf):
+        L = leaf.shape[0]
+        if L % n_stages:
+            raise ValueError(f"num_layers {L} not divisible by pp={n_stages}")
+        return leaf.reshape(n_stages, L // n_stages, *leaf.shape[1:])
+
+    return jax.tree.map(reshape, layer_params)
+
+
+def from_stage_stacked(layer_params):
+    """[n_stages, L/n, ...] -> [L, ...]."""
+    return jax.tree.map(lambda leaf: leaf.reshape(leaf.shape[0] * leaf.shape[1], *leaf.shape[2:]), layer_params)
+
+
+def pipeline_apply(
+    stage_params,
+    x,
+    *,
+    mesh: Mesh,
+    layer_fn: Callable,
+    num_microbatches: int,
+    axis_name: str = "pp",
+):
+    """Run stage-stacked layers over x with GPipe microbatch pipelining.
+
+    stage_params: pytree with leading [n_stages, L/n, ...] dims, sharded
+      P('pp') on dim 0. layer_fn(x, layer) applies ONE layer.
+    x: [B, ...] activations (NOT sharded over pp).
+    Returns [B, ...] outputs (replicated over pp, identical on every
+    stage after the closing psum).
+    """
+    n = mesh.shape[axis_name]
+    B = x.shape[0]
+    M = num_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by num_microbatches {M}")
+    mb = B // M
+    x_mb = x.reshape(M, mb, *x.shape[1:])
+
+    def local(stage_p, xs):
+        # stage_p: [1, L/n, ...] (this device's stage); xs: [M, mb, ...]
+        my = lax.axis_index(axis_name)
+        stage_p = jax.tree.map(lambda t: t[0], stage_p)
+
+        def apply_stage(act):
+            def body(carry, layer):
+                return layer_fn(carry, layer), None
+
+            out, _ = lax.scan(body, act, stage_p)
+            return out
+
+        shift_perm = [(i, i + 1) for i in range(n - 1)]  # a shift, not a ring
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t (clamped once the feed is done);
+            # later stages consume what the previous stage sent last tick
+            feed = lax.dynamic_index_in_dim(xs, jnp.minimum(t, M - 1), axis=0, keepdims=False)
+            inp = jnp.where(my == 0, feed, state)
+            out = apply_stage(inp)
+            # last stage banks microbatch t-(n-1) once the pipe is primed
+            oidx = jnp.clip(t - (n - 1), 0, M - 1)
+            bank = jnp.logical_and(my == n - 1, t >= n - 1)
+            cur = lax.dynamic_index_in_dim(outputs, oidx, axis=0, keepdims=False)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(bank, out, cur), oidx, axis=0
+            )
+            state = lax.ppermute(out, axis_name, shift_perm) if n > 1 else out
+            return (state, outputs), None
+
+        init = jax.tree.map(
+            lambda t: lax.pvary(t, (axis_name,)),
+            (jnp.zeros_like(xs[0]), jnp.zeros_like(xs)),
+        )
+        (_, outputs), _ = lax.scan(tick, init, jnp.arange(M + n - 1))
+        # only the last stage holds real outputs; psum broadcasts them so
+        # the (auto-sharded) unembed/loss outside sees one consistent value.
+        # f32 for the wire: XLA's bf16 all-reduce promotion pass crashes on
+        # CPU, and f32 costs nothing extra on TPU (promotion does it anyway)
+        gated = jnp.where(my == n - 1, outputs, jnp.zeros_like(outputs)).astype(jnp.float32)
+        return lax.psum(gated, axis_name).astype(outputs.dtype)
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        axis_names={axis_name},
+    )
+    out_mb = fn(stage_params, x_mb)
+    return out_mb.reshape(B, *x.shape[1:])
+
+
+# ----------------------------------------------------------------------
+# Llama integration: pipelined forward/loss drop-ins
+# ----------------------------------------------------------------------
+def pp_param_logical_axes(config, n_stages: int):
+    """param_logical_axes for pp: layer leaves are [n_stages, L/n, *dims],
+    logical axes ('stage', None, *per-layer axes)."""
+    from ray_tpu.models.llama import PARAM_AXES, param_logical_axes
+
+    axes = param_logical_axes(config)
+    axes["layers"] = {
+        k: ("stage", None) + tuple(v[1:]) for k, v in PARAM_AXES["layers"].items()
+    }
+    return axes
+
+
+def pp_init_params(config, key, n_stages: int):
+    """init_params with the layer stack reshaped to [n_stages, L/n, ...]."""
+    from ray_tpu.models.llama import init_params
+
+    params = init_params(config, key)
+    params["layers"] = to_stage_stacked(params["layers"], n_stages)
+    return params
+
+
+def pp_forward(params, tokens, config, mesh: Mesh, num_microbatches: int):
+    """Pipelined llama forward: embed -> pp pipeline over layers -> unembed."""
+    from ray_tpu.models.llama import _layer_fn
+    from ray_tpu.ops.layers import rms_norm, rotary_embedding
+
+    B, T = tokens.shape
+    positions = jnp.arange(T, dtype=jnp.int32)
+    cos, sin = rotary_embedding(positions, config.hd, config.rope_theta, dtype=jnp.float32)
+    x = jnp.take(params["embed"], tokens, axis=0)
+
+    layer_fn = functools.partial(_layer_fn, config=config, cos=cos, sin=sin, positions=positions)
+    if config.remat:
+        policy = getattr(jax.checkpoint_policies, config.remat_policy)
+        layer_fn = jax.checkpoint(layer_fn, policy=policy)
+
+    x = pipeline_apply(
+        params["layers"], x, mesh=mesh, layer_fn=layer_fn, num_microbatches=num_microbatches
+    )
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    unembed = params["embed"].T if config.tie_embeddings else params["unembed"]
+    return jnp.dot(x, unembed, preferred_element_type=jnp.float32)
+
+
+def pp_loss_fn(params, batch, config, mesh: Mesh, num_microbatches: int):
+    from ray_tpu.ops.layers import cross_entropy_loss
+
+    logits = pp_forward(params, batch["tokens"], config, mesh, num_microbatches)
+    return cross_entropy_loss(logits, batch["targets"])
