@@ -21,6 +21,7 @@ from ray_tpu.api import (  # noqa: F401
     get_actor,
     get_runtime_context,
     init,
+    internal_free,
     is_initialized,
     kill,
     method,

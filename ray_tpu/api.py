@@ -290,7 +290,9 @@ class ActorHandle:
         self._method_options = method_options or {}
 
     def __getattr__(self, name):
-        if name.startswith("_"):
+        # __rt_*__ names are runtime-builtin actor methods (collective init,
+        # device-object export) served by worker_main for every actor
+        if name.startswith("_") and not (name.startswith("__rt_") and name.endswith("__")):
             raise AttributeError(name)
         return ActorMethod(self, name, self._method_options.get(name))
 

@@ -3,6 +3,7 @@ from ray_tpu.collective.collective import (  # noqa: F401
     allreduce,
     barrier,
     broadcast,
+    cleanup_group_actor,
     create_collective_group,
     declare_collective_group,
     destroy_collective_group,

@@ -199,11 +199,56 @@ def _g(group_name) -> _GroupInfo:
     return _groups[group_name]
 
 
+# p2p payloads above this ride the shm object store (single producer and
+# consumer per key, so the sender alone can decide the plane)
+_SHM_PLANE_THRESHOLD = 32 * 1024
+
+
 def _roundtrip(g: _GroupInfo, tensor, op, mode, round_key=None):
     key = round_key or f"{mode}:{g.next_round()}"
     payload = None if tensor is None else np.asarray(tensor)
     op_str = op.value if isinstance(op, ReduceOp) else str(op)
+    if mode in ("allreduce", "allgather", "reducescatter", "broadcast"):
+        # data modes ALWAYS take the shm plane so every rank of a round
+        # agrees on the protocol (a per-rank size threshold would let
+        # ranks of one round mix planes and corrupt the exchange)
+        return _shm_plane(g, key, payload, op_str, mode)
     return ray_tpu.get(g.handle.exchange.remote(key, g.rank, payload, op_str, mode))
+
+
+def _shm_plane(g: _GroupInfo, key, payload, op_str, mode):
+    """Data plane over the shm object store: ranks exchange ObjectRefs via
+    the rendezvous actor (tiny control messages), attach each other's
+    segments directly, and reduce locally — the rendezvous heap never holds
+    world_size x tensor bytes (the O(world x bytes) funnel the round-1
+    review flagged). A closing barrier lets each rank free its payload, so
+    rounds leave nothing in the store."""
+    if mode == "broadcast" and int(op_str) != g.rank:
+        my_ref = (None,)  # only the src rank ships bytes
+    else:
+        # 1-tuple wrap: a bare ObjectRef arg would be auto-dereferenced by
+        # the task runtime; nested refs pass through opaque
+        my_ref = (ray_tpu.put(payload),)
+    refs = ray_tpu.get(g.handle.exchange.remote(key, g.rank, my_ref, "sum", "allgather"))
+    try:
+        if mode == "broadcast":
+            src = int(op_str)
+            return payload if src == g.rank else ray_tpu.get(refs[src][0])
+        arrays = [
+            payload if r == g.rank else ray_tpu.get(refs[r][0]) for r in range(g.world_size)
+        ]
+        if mode == "allgather":
+            return arrays
+        red = apply_reduce(ReduceOp(op_str), arrays)
+        if mode == "reducescatter":
+            return np.array_split(red, g.world_size, axis=0)[g.rank]
+        return red
+    finally:
+        # every rank has read what it needs once it reaches this barrier;
+        # then each rank frees its own payload object
+        ray_tpu.get(g.handle.exchange.remote(f"{key}::done", g.rank, None, "sum", "barrier"))
+        if my_ref[0] is not None:
+            ray_tpu.internal_free([my_ref[0]])
 
 
 def _like(result, tensor):
@@ -253,14 +298,25 @@ def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0):
     g = _g(group_name)
     seq = g.next_p2p("send", dst_rank, tag)
     key = f"p2p:{g.rank}->{dst_rank}:{tag}:{seq}"
-    ray_tpu.get(g.handle.p2p_send.remote(key, np.asarray(tensor)))
+    payload = np.asarray(tensor)
+    if payload.nbytes >= _SHM_PLANE_THRESHOLD:
+        # shm data plane; the actor relays a (wrapped, not auto-deref'd) ref
+        payload = (ray_tpu.put(payload),)
+    ray_tpu.get(g.handle.p2p_send.remote(key, payload))
 
 
 def recv(shape_or_tensor, src_rank: int, group_name: str = "default", tag: int = 0):
+    from ray_tpu.core.object_ref import ObjectRef
+
     g = _g(group_name)
     seq = g.next_p2p("recv", src_rank, tag)
     key = f"p2p:{src_rank}->{g.rank}:{tag}:{seq}"
-    return _like(ray_tpu.get(g.handle.p2p_recv.remote(key)), shape_or_tensor)
+    out = ray_tpu.get(g.handle.p2p_recv.remote(key))
+    if isinstance(out, tuple) and len(out) == 1 and isinstance(out[0], ObjectRef):
+        ref = out[0]
+        out = ray_tpu.get(ref)
+        ray_tpu.internal_free([ref])  # single consumer: free after fetch
+    return _like(out, shape_or_tensor)
 
 
 class CollectiveActorMixin:

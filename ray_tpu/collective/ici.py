@@ -1,0 +1,131 @@
+"""ICI collective backend: XLA-compiled collectives over local mesh devices.
+
+The host-side collective API (collective.py) moves tensors through the shm
+object store — the DCN/control plane. When the participating "ranks" are
+the chips of one host (one PJRT client), the right data plane is ICI via a
+single jitted XLA program; these helpers wrap that for driver-held
+per-device arrays. (Inside jit/shard_map, just use lax.psum/all_gather —
+see ray_tpu.parallel; this module is for eager host code that owns one
+array per chip, e.g. a parameter server pushing to device replicas.)
+
+Reference shape: util/collective/collective_group/nccl_collective_group.py
+(a real device backend for the same API) — here the "backend" is XLA +
+GSPMD, no NCCL.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ray_tpu.collective.types import ReduceOp
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda x: x.sum(axis=0),
+    ReduceOp.PRODUCT: lambda x: x.prod(axis=0),
+    ReduceOp.MIN: lambda x: x.min(axis=0),
+    ReduceOp.MAX: lambda x: x.max(axis=0),
+}
+
+
+def _mesh_for(n: int):
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.local_devices()[:n]
+    return Mesh(np.asarray(devices), ("d",))
+
+
+@functools.lru_cache(maxsize=32)
+def _reduce_prog(n: int, op: ReduceOp):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh_for(n)
+    return jax.jit(
+        _REDUCERS[op],
+        in_shardings=NamedSharding(mesh, P("d")),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+
+
+def _stack(per_device):
+    """Per-device arrays -> one [W, ...] array sharded over the 1D mesh
+    without leaving the devices."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = len(per_device)
+    mesh = _mesh_for(n)
+    shape = (n,) + tuple(per_device[0].shape)
+    shards = [a[None] for a in per_device]  # [1, ...] views on each device
+    return jax.make_array_from_single_device_arrays(
+        shape, NamedSharding(mesh, P("d")), shards
+    )
+
+
+def _unstack(replicated, n: int):
+    """Replicated output -> the per-device arrays (no copies)."""
+    shards = sorted(replicated.addressable_shards, key=lambda s: s.device.id)
+    return [s.data for s in shards[:n]]
+
+
+def allreduce(per_device, op: ReduceOp = ReduceOp.SUM):
+    """per_device: list of same-shape jax.Arrays, one per local device.
+    Returns the reduced array materialized on every participating device.
+    One XLA program; the all-reduce rides ICI."""
+    n = len(per_device)
+    out = _reduce_prog(n, op)(_stack(per_device))
+    return _unstack(out, n)
+
+
+@functools.lru_cache(maxsize=32)
+def _gather_prog(n: int):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh_for(n)
+    return jax.jit(
+        lambda x: x,
+        in_shardings=NamedSharding(mesh, P("d")),
+        out_shardings=NamedSharding(mesh, P()),  # resharding = all-gather
+    )
+
+
+def allgather(per_device):
+    """Returns on every device the stacked [W, ...] of all inputs."""
+    n = len(per_device)
+    return _unstack(_gather_prog(n)(_stack(per_device)), n)
+
+
+@functools.lru_cache(maxsize=32)
+def _reducescatter_prog(n: int, op: ReduceOp):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh_for(n)
+    return jax.jit(
+        _REDUCERS[op],
+        in_shardings=NamedSharding(mesh, P("d")),
+        out_shardings=NamedSharding(mesh, P("d")),  # shard rows of the result
+    )
+
+
+def reducescatter(per_device, op: ReduceOp = ReduceOp.SUM):
+    """Reduce then scatter row-shards back: device i gets rows i*k:(i+1)*k
+    of the reduction (inputs' leading dim must divide by world size)."""
+    n = len(per_device)
+    out = _reducescatter_prog(n, op)(_stack(per_device))
+    shards = sorted(out.addressable_shards, key=lambda s: s.device.id)
+    return [s.data for s in shards[:n]]
+
+
+def broadcast(array, n_devices: int):
+    """One array -> materialized on each of the first n local devices."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh_for(n_devices)
+    out = jax.device_put(array, NamedSharding(mesh, P()))
+    return _unstack(out, n_devices)

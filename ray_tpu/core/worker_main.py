@@ -330,6 +330,13 @@ class WorkerClient:
             return self._terminate_actor
         if name == "__ray_ready__":
             return lambda: True
+        if name == "__rt_device_get__":
+            # device-object store export hook: any actor can serve its own
+            # registered jax.Arrays to a remote consumer (experimental/
+            # device_objects.py)
+            from ray_tpu.experimental.device_objects import export_for_transfer
+
+            return export_for_transfer
         fn = getattr(self._actor_instance, name, None)
         if fn is None:
             raise AttributeError(f"actor has no method {name!r}")

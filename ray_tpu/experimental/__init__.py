@@ -1,0 +1,8 @@
+from ray_tpu.experimental.device_objects import (
+    DeviceRef,
+    device_get,
+    device_put_object,
+    free_device_object,
+)
+
+__all__ = ["DeviceRef", "device_get", "device_put_object", "free_device_object"]
