@@ -68,6 +68,9 @@ class Config:
     # Reference: gcs_health_check_manager.h — period + failure threshold.
     health_check_period_s: float = 1.0
     health_check_failure_threshold: int = 5
+    # Session state.json dump period for the out-of-process CLI
+    # (scripts/cli.py); 0 disables.
+    state_dump_interval_s: float = 2.0
     # Actor restart backoff.
     actor_restart_backoff_s: float = 0.1
 

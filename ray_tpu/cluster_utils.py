@@ -1,9 +1,11 @@
-"""Single-machine multi-node cluster simulation for tests.
+"""Single-machine multi-node cluster harness for tests.
 
 Reference parity: python/ray/cluster_utils.py — Cluster (:135) with
-add_node (:202): the standard way distributed behavior (spillback, node
-death, PG atomicity, slice gang scheduling) is tested without a real
-cluster.
+add_node (:202, spawns real raylet processes). add_node here spawns a real
+node-agent daemon process (core/node_agent.py) by default: workers live
+under the agent, frames cross a socket, and health checks/chaos apply —
+the process boundaries distributed behavior tests need (node death, PG
+atomicity, failover, slice gang scheduling).
 """
 
 from __future__ import annotations
@@ -33,12 +35,12 @@ class Cluster:
     def address(self) -> str:
         return "local://" + (self._rt.node_id.hex() if self._rt else "none")
 
-    def add_node(self, *, num_cpus: int = 1, num_tpus: int = 0, resources: dict | None = None, labels: dict | None = None, env: dict | None = None):
+    def add_node(self, *, num_cpus: int = 1, num_tpus: int = 0, resources: dict | None = None, labels: dict | None = None, env: dict | None = None, remote: bool = True):
         res = dict(resources or {})
         res.setdefault("CPU", float(num_cpus))
         if num_tpus:
             res["TPU"] = float(num_tpus)
-        return self._rt.add_node(res, labels=labels, env=env)
+        return self._rt.add_node(res, labels=labels, env=env, remote=remote)
 
     def remove_node(self, node, allow_graceful: bool = True):
         node_id = node.node_id if hasattr(node, "node_id") else node

@@ -1,0 +1,177 @@
+"""Node agent: the per-node manager daemon, a separate OS process.
+
+Reference parity: src/ray/raylet/node_manager.h:133 (per-node raylet
+process) + src/ray/raylet/worker_pool.h:280 (local worker pool). The head
+talks to each agent over a framed AF_UNIX socket (the single-host stand-in
+for the reference's gRPC channel; the protocol is envelope-based so the
+transport can later move to TCP for true multi-host). The agent:
+
+- spawns/kills worker processes on head request (the worker pool lives
+  HERE, not in the head — a dead agent takes exactly its own node down);
+- relays frames between the head socket and its workers' pipes, tagging
+  them with worker ids;
+- detects worker death (pipe EOF / process exit) and reports it;
+- answers pings (the head's gcs_health_check_manager.h:45-style detector
+  declares the node dead after N missed pongs).
+
+Protocol (head -> agent):
+  {"type": "start_worker", "wid": hex}
+  {"type": "to_worker", "wid": hex, "data": frame}
+  {"type": "kill_worker", "wid": hex}
+  {"type": "ping", "seq": n}
+  {"type": "shutdown"}
+Agent -> head:
+  {"type": "agent_ready", "pid": pid}
+  {"type": "from_worker", "wid": hex, "data": frame}
+  {"type": "worker_started", "wid": hex, "pid": pid}
+  {"type": "worker_death", "wid": hex, "reason": str}
+  {"type": "pong", "seq": n}
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from multiprocessing import connection as mp_connection
+
+
+def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_method: str):
+    """Main loop of the node-agent process."""
+    import multiprocessing as mp
+
+    conn = mp_connection.Client(address, authkey=authkey)
+    conn.send({"type": "agent_ready", "pid": os.getpid()})
+
+    if start_method == "forkserver":
+        ctx = mp.get_context("forkserver")
+        ctx.set_forkserver_preload(["ray_tpu.core.worker_main"])
+    else:
+        ctx = mp.get_context(start_method)
+
+    workers: dict[str, tuple] = {}  # wid_hex -> (proc, conn)
+    lock = threading.Lock()
+    send_lock = threading.Lock()
+    shutdown = threading.Event()
+
+    def send_head(msg):
+        with send_lock:
+            try:
+                conn.send(msg)
+            except (OSError, EOFError):
+                shutdown.set()
+
+    def start_worker(wid_hex: str):
+        from ray_tpu.core.node import _suppress_child_main_import
+        from ray_tpu.core.worker_main import worker_entry
+
+        parent_conn, child_conn = ctx.Pipe(duplex=True)
+        proc = ctx.Process(
+            target=worker_entry,
+            args=(child_conn, wid_hex, node_id_hex, env),
+            daemon=True,
+            name=f"rt-worker-{wid_hex[:8]}",
+        )
+        with _suppress_child_main_import():
+            proc.start()
+        child_conn.close()
+        with lock:
+            workers[wid_hex] = (proc, parent_conn)
+        send_head({"type": "worker_started", "wid": wid_hex, "pid": proc.pid})
+
+    def reap_worker(wid_hex: str, reason: str, report: bool = True):
+        with lock:
+            entry = workers.pop(wid_hex, None)
+        if entry is None:
+            return
+        proc, wconn = entry
+        try:
+            wconn.close()
+        except Exception:
+            pass
+        try:
+            if proc.is_alive():
+                proc.terminate()
+        except Exception:
+            pass
+        if report:
+            send_head({"type": "worker_death", "wid": wid_hex, "reason": reason})
+
+    while not shutdown.is_set():
+        with lock:
+            wconn_map = {wc: wid for wid, (_, wc) in workers.items()}
+        waitlist = [conn] + list(wconn_map)
+        try:
+            ready = mp_connection.wait(waitlist, timeout=0.05)
+        except OSError:
+            ready = []
+        for c in ready:
+            if c is conn:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    shutdown.set()
+                    break
+                t = msg.get("type")
+                if t == "start_worker":
+                    # spawn off-loop: the first spawn boots the forkserver
+                    # (several seconds) and the loop must keep answering
+                    # pings or the head's health checker declares us dead
+
+                    def _spawn(wid=msg["wid"]):
+                        try:
+                            start_worker(wid)
+                        except Exception as e:  # noqa: BLE001
+                            send_head({"type": "worker_death", "wid": wid, "reason": f"spawn failed: {e}"})
+
+                    threading.Thread(target=_spawn, daemon=True).start()
+                elif t == "to_worker":
+                    with lock:
+                        entry = workers.get(msg["wid"])
+                    if entry is not None:
+                        try:
+                            entry[1].send(msg["data"])
+                        except (OSError, ValueError, EOFError):
+                            reap_worker(msg["wid"], "pipe closed on send")
+                elif t == "kill_worker":
+                    reap_worker(msg["wid"], "killed by head", report=msg.get("report", True))
+                elif t == "ping":
+                    send_head({"type": "pong", "seq": msg.get("seq", 0), "pid": os.getpid()})
+                elif t == "shutdown":
+                    shutdown.set()
+            else:
+                wid = wconn_map.get(c)
+                if wid is None:
+                    continue
+                try:
+                    data = c.recv()
+                except (EOFError, OSError):
+                    reap_worker(wid, "worker process exited")
+                    continue
+                send_head({"type": "from_worker", "wid": wid, "data": data})
+
+    # drain: kill workers, close head socket
+    with lock:
+        all_workers = list(workers.items())
+        workers.clear()
+    for wid, (proc, wconn) in all_workers:
+        try:
+            wconn.send({"type": "shutdown"})
+        except Exception:
+            pass
+    deadline = time.time() + 1.0
+    for wid, (proc, wconn) in all_workers:
+        try:
+            proc.join(timeout=max(0.0, deadline - time.time()))
+            if proc.is_alive():
+                proc.terminate()
+        except Exception:
+            pass
+        try:
+            wconn.close()
+        except Exception:
+            pass
+    try:
+        conn.close()
+    except Exception:
+        pass

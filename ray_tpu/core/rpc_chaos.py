@@ -1,0 +1,70 @@
+"""RPC chaos injection for the head <-> node-agent transport.
+
+Reference parity: src/ray/rpc/rpc_chaos.h:24 (RpcFailureManager — inject
+delays/failures per RPC method via testing config). Here faults apply at
+the head's transport boundary with node agents: outbound messages
+(dispatch, worker control) and inbound messages (task done, worker death,
+pongs) can be delayed or dropped by message type.
+
+Test usage:
+    from ray_tpu.core import rpc_chaos
+    rpc_chaos.inject("pong", drop_prob=1.0)        # starve health checks
+    rpc_chaos.inject("to_worker", delay_s=0.2)     # slow dispatch
+    rpc_chaos.clear()
+
+Determinism: drop decisions use a dedicated seeded RNG so chaos tests can
+be reproduced (`rpc_chaos.seed(n)`).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class Rule:
+    delay_s: float = 0.0
+    drop_prob: float = 0.0
+    max_hits: int | None = None  # stop applying after this many matches
+    hits: int = 0
+
+
+_rules: dict[str, Rule] = {}
+_lock = threading.Lock()
+_rng = random.Random(0)
+
+
+def inject(msg_type: str, *, delay_s: float = 0.0, drop_prob: float = 0.0, max_hits: int | None = None):
+    with _lock:
+        _rules[msg_type] = Rule(delay_s=delay_s, drop_prob=drop_prob, max_hits=max_hits)
+
+
+def clear():
+    with _lock:
+        _rules.clear()
+
+
+def seed(n: int):
+    global _rng
+    with _lock:
+        _rng = random.Random(n)
+
+
+def apply(msg_type: str) -> bool:
+    """Apply chaos for one message. Returns False if the message must be
+    DROPPED; sleeps inline for delay rules."""
+    with _lock:
+        rule = _rules.get(msg_type)
+        if rule is None:
+            return True
+        if rule.max_hits is not None and rule.hits >= rule.max_hits:
+            return True
+        rule.hits += 1
+        delay = rule.delay_s
+        drop = rule.drop_prob > 0 and _rng.random() < rule.drop_prob
+    if delay > 0:
+        time.sleep(delay)
+    return not drop

@@ -158,6 +158,10 @@ class Runtime:
             self._io_thread.start()
             self._sched_thread = threading.Thread(target=self.scheduler.run_loop, daemon=True, name="rt-sched")
             self._sched_thread.start()
+            self._health_thread = threading.Thread(target=self._health_loop, daemon=True, name="rt-health")
+            self._health_thread.start()
+            if self.cfg.state_dump_interval_s > 0:
+                threading.Thread(target=self._state_dump_loop, daemon=True, name="rt-state-dump").start()
             if self.cfg.prestart_workers:
                 # Warm the pool in the background (reference: worker_pool.h
                 # prestart) — overlaps the one-time forkserver boot with user
@@ -171,8 +175,17 @@ class Runtime:
     # ------------------------------------------------------------------
     # cluster membership
     # ------------------------------------------------------------------
-    def add_node(self, resources: dict, labels: dict | None = None, env: dict | None = None) -> Node:
-        node = Node(None, resources, labels=labels, env=env)
+    def add_node(self, resources: dict, labels: dict | None = None, env: dict | None = None, remote: bool = True) -> Node:
+        """Add a node. remote=True (default) runs the node manager as a
+        separate agent process with a socket transport + health checks —
+        real process separation like the reference's raylet; remote=False
+        keeps the legacy in-process simulation."""
+        if remote and not self.local_mode:
+            from ray_tpu.core.node import RemoteNode
+
+            node = RemoteNode(None, resources, labels=labels, env=env)
+        else:
+            node = Node(None, resources, labels=labels, env=env)
         with self._nodes_lock:
             self.nodes[node.node_id] = node
         self.gcs.events.record("node_added", node_id=node.node_id.hex(), resources=resources)
@@ -187,7 +200,15 @@ class Runtime:
             node = self.nodes.get(node_id)
         if node is None:
             return
-        node.alive = False
+        # tasks with resources reserved but no worker yet go back to the
+        # scheduler (with slow worker spawn — e.g. agent forkserver boot —
+        # a node can die while its dispatch queue is non-empty). alive flips
+        # and the queue drains under node._lock so the scheduler thread's
+        # _dispatch_node can't pop a spec this drain also resubmits.
+        with node._lock:
+            node.alive = False
+            queued = list(node.dispatch_queue)
+            node.dispatch_queue.clear()
         workers = list(node.workers.values())
         for w in workers:
             self._on_worker_death(node, w, "node removed")
@@ -195,6 +216,9 @@ class Runtime:
                 w.proc.terminate()
             except Exception:
                 pass
+        for spec, _alloc, _chips in queued:
+            if spec.is_actor_creation or spec.actor_id is None:
+                self.scheduler.submit(spec)
         node.shutdown()
         with self._nodes_lock:
             self.nodes.pop(node_id, None)
@@ -332,7 +356,7 @@ class Runtime:
             scheduling=_sched_options(opts),
             max_retries=opts.get("max_retries", self.cfg.default_max_retries),
             retry_exceptions=opts.get("retry_exceptions", False),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=self._prepare_runtime_env(opts.get("runtime_env")),
         )
         spec._kwargs = kwargs or {}
         self.task_manager.register(spec)
@@ -343,6 +367,42 @@ class Runtime:
         if streaming:
             return [spec.generator_id()]
         return spec.return_ids()
+
+    def _prepare_runtime_env(self, renv: dict | None) -> dict | None:
+        """Package working_dir/py_modules once (cached by paths) into the
+        object store; archives are pinned so LRU eviction cannot lose them
+        (runtime_env/packaging.py)."""
+        if not renv:
+            return renv
+        if not any(k in renv for k in ("working_dir", "py_modules", "pip", "conda", "uv", "container")):
+            return renv
+        from ray_tpu.runtime_env.packaging import dir_fingerprint, validate_runtime_env
+
+        validate_runtime_env(renv)  # gated kinds error on EVERY submit
+        # cache by content fingerprint, not path alone: edits re-package
+        key = tuple(
+            (p, dir_fingerprint(p))
+            for p in [renv.get("working_dir"), *(renv.get("py_modules") or ())]
+            if p
+        )
+        if not hasattr(self, "_renv_cache"):
+            self._renv_cache = {}
+        cached = self._renv_cache.get(key)
+        if cached is None:
+            from ray_tpu.runtime_env import prepare_runtime_env
+
+            prepared = prepare_runtime_env(renv)
+            for packed in [prepared.get("_packed_working_dir")] + list(prepared.get("_packed_py_modules") or []):
+                if packed:
+                    ref = packed.pop("_ref", None)
+                    if ref is not None:
+                        self.store.pin(ref.id)
+            cached = {k: v for k, v in prepared.items() if k != "env_vars"}
+            self._renv_cache[key] = cached
+        out = dict(cached)
+        if renv.get("env_vars"):
+            out["env_vars"] = renv["env_vars"]
+        return out
 
     def resubmit(self, spec: TaskSpec):
         """Re-run a task (retry or lineage reconstruction)."""
@@ -386,6 +446,7 @@ class Runtime:
             max_restarts=opts.get("max_restarts", 0),
             max_task_retries=opts.get("max_task_retries", 0),
             max_concurrency=opts.get("max_concurrency", 1),
+            runtime_env=self._prepare_runtime_env(opts.get("runtime_env")),
         )
         spec._kwargs = kwargs or {}
         info = ActorInfo(
@@ -685,17 +746,47 @@ class Runtime:
         n_tpu = int(res.get("TPU", 0))
         if n_tpu > 0:
             chips = node.take_tpu_chips(n_tpu)
-        node.dispatch_queue.append((spec, alloc, chips))
+        with node._lock:
+            if not node.alive:
+                # raced node removal: don't strand the spec on a dead queue
+                self._release_alloc(node, alloc, chips)
+                return False
+            node.dispatch_queue.append((spec, alloc, chips))
         return True
 
     def dispatch_all(self):
         for node in self.node_list():
             self._dispatch_node(node)
 
+    @staticmethod
+    def _renv_key(spec: TaskSpec) -> str | None:
+        renv = spec.runtime_env or {}
+        wd = renv.get("_packed_working_dir")
+        mods = renv.get("_packed_py_modules") or []
+        if not wd and not mods:
+            return None
+        return (wd or {}).get("hash", "") + ":" + ",".join(m["hash"] for m in mods)
+
     def _dispatch_node(self, node: Node):
-        while node.dispatch_queue:
-            spec, alloc, chips = node.dispatch_queue[0]
-            idle = [w for w in node.idle_workers() if not w.env_binding]
+        while True:
+            with node._lock:
+                if not node.alive or not node.dispatch_queue:
+                    return
+                spec, alloc, chips = node.dispatch_queue[0]
+            renv_key = self._renv_key(spec)
+            # a worker is reusable iff its sticky env is compatible: no TPU
+            # chip binding, and either the same runtime_env materialization
+            # or none yet (it gets bound on dispatch). Workers bound to a
+            # DIFFERENT runtime_env (or any env, for a plain task) are
+            # excluded — their cwd/sys.path are polluted.
+            idle = []
+            for w in node.idle_workers():
+                if "TPU_VISIBLE_CHIPS" in w.env_binding:
+                    continue
+                wkey = w.env_binding.get("runtime_env")
+                if wkey == renv_key or wkey is None:
+                    idle.append(w)
+            idle.sort(key=lambda w: w.env_binding.get("runtime_env") != renv_key)
             if chips:
                 # chip-isolation env must be set before the worker can ever
                 # import jax: only never-used workers qualify
@@ -706,8 +797,24 @@ class Runtime:
                 limit = int(node.total_resources.get("CPU", 1)) + self._worker_count_limit_extra
                 if (nonactor < limit or chips) and starting < len(node.dispatch_queue):
                     node.start_worker()
+                elif nonactor >= limit and starting == 0:
+                    # pool full of env-incompatible idle workers (different
+                    # runtime_env or chip binding): retire one so a
+                    # compatible worker can spawn — otherwise dispatch
+                    # deadlocks with resources reserved forever
+                    stale = [w for w in node.idle_workers() if w.env_binding]
+                    if stale:
+                        victim = min(stale, key=lambda w: w.last_idle)
+                        victim.state = "retiring"
+                        try:
+                            victim.proc.terminate()
+                        except Exception:
+                            pass
                 return
-            node.dispatch_queue.pop(0)
+            with node._lock:
+                if not node.alive or not node.dispatch_queue or node.dispatch_queue[0][0] is not spec:
+                    continue  # raced remove_node's drain
+                node.dispatch_queue.pop(0)
             self._dispatch_to_worker(node, idle[0], spec, alloc, chips)
 
     def _dispatch_to_worker(self, node: Node, worker: WorkerHandle, spec: TaskSpec, alloc, chips):
@@ -719,6 +826,9 @@ class Runtime:
             worker.env_binding = {"TPU_VISIBLE_CHIPS": env["TPU_VISIBLE_CHIPS"]}
         if spec.runtime_env and spec.runtime_env.get("env_vars"):
             env.update(spec.runtime_env["env_vars"])
+        renv_key = self._renv_key(spec)
+        if renv_key is not None:
+            worker.env_binding["runtime_env"] = renv_key
         resources = dict(alloc[3])
         if chips:
             resources["_tpu_chip_ids"] = chips
@@ -816,6 +926,9 @@ class Runtime:
         while not self._stopped:
             conn_map = {}
             for node in self.node_list():
+                if getattr(node, "remote", False):
+                    conn_map[node.agent_conn] = (node, None)
+                    continue
                 for w in list(node.workers.values()):
                     if w.state != "dead":
                         conn_map[w.conn] = (node, w)
@@ -828,6 +941,17 @@ class Runtime:
                 continue
             for c in ready:
                 node, w = conn_map[c]
+                if w is None:  # node-agent socket
+                    try:
+                        msg = c.recv()
+                    except (EOFError, OSError):
+                        self._on_agent_death(node)
+                        continue
+                    try:
+                        self._handle_agent_msg(node, msg)
+                    except Exception:
+                        logger.exception("error handling agent message %s", msg.get("type"))
+                    continue
                 try:
                     msg = c.recv()
                 except (EOFError, OSError):
@@ -840,6 +964,77 @@ class Runtime:
                     self._handle_worker_msg(node, w, msg)
                 except Exception:
                     logger.exception("error handling worker message %s", msg.get("type"))
+
+    def _handle_agent_msg(self, node: Node, msg: dict):
+        """Demultiplex one envelope from a node-agent socket."""
+        from ray_tpu.core import rpc_chaos
+        from ray_tpu.core.ids import WorkerID
+
+        t = msg.get("type")
+        if not rpc_chaos.apply(t):
+            return  # chaos: inbound message dropped
+        if t == "from_worker":
+            w = node.workers.get(WorkerID.from_hex(msg["wid"]))
+            if w is not None and w.state != "dead":
+                self._handle_worker_msg(node, w, msg["data"])
+        elif t == "worker_death":
+            w = node.workers.get(WorkerID.from_hex(msg["wid"]))
+            if w is not None:
+                w.proc.dead = True
+                self._on_worker_death(node, w, msg.get("reason", "worker died"))
+        elif t == "worker_started":
+            w = node.workers.get(WorkerID.from_hex(msg["wid"]))
+            if w is not None:
+                w.proc.pid = msg.get("pid")
+        elif t == "pong":
+            node.last_pong = time.monotonic()
+
+    def _state_dump_loop(self):
+        """Periodic session state.json for the out-of-process CLI
+        (util/state.py; reference: `ray status` against the state API)."""
+        from ray_tpu.util import state as state_mod
+
+        while not self._stopped:
+            time.sleep(self.cfg.state_dump_interval_s)
+            if self._stopped:
+                return
+            try:
+                state_mod.dump_state(self)
+            except Exception:
+                pass
+
+    def _on_agent_death(self, node: Node):
+        """A node agent went away: the whole node is dead (reference:
+        gcs_health_check_manager.h:45 failure path)."""
+        if not node.alive:
+            return
+        logger.warning("node agent %s died; removing node", node.node_id.hex()[:8])
+        self.remove_node(node.node_id, graceful=False)
+
+    def _health_loop(self):
+        """Ping node agents; declare nodes dead after threshold misses
+        (reference: gcs_health_check_manager.h — period + failure
+        threshold)."""
+        from ray_tpu.core import rpc_chaos
+
+        period = self.cfg.health_check_period_s
+        threshold = self.cfg.health_check_failure_threshold
+        while not self._stopped:
+            time.sleep(period)
+            for node in self.node_list():
+                if not getattr(node, "remote", False) or not node.alive:
+                    continue
+                if time.monotonic() - node.last_pong > period * threshold:
+                    logger.warning(
+                        "node %s failed %d health checks; declaring dead",
+                        node.node_id.hex()[:8],
+                        threshold,
+                    )
+                    self._on_agent_death(node)
+                    continue
+                node.ping_seq += 1
+                if rpc_chaos.apply("ping"):
+                    node.agent_send({"type": "ping", "seq": node.ping_seq})
 
     def _handle_worker_msg(self, node: Node, w: WorkerHandle, msg: dict):
         t = msg["type"]

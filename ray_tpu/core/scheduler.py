@@ -216,3 +216,9 @@ class Scheduler:
     def has_pending(self) -> bool:
         with self._lock:
             return bool(self._ready or self._waiting)
+
+    def pending_demand(self) -> list[dict]:
+        """Resource requests of queued-but-unplaced tasks (autoscaler
+        input; reference: autoscaler/v2 cluster resource demand)."""
+        with self._lock:
+            return [dict(s.scheduling.resources) for s in self._ready]

@@ -216,6 +216,15 @@ class WorkerClient:
         self.assigned_resources = msg.get("resources", {})
         self._apply_env(msg.get("env"))
         try:
+            renv = getattr(spec, "runtime_env", None)
+            if renv and ("_packed_working_dir" in renv or "_packed_py_modules" in renv):
+                # inside the try: a setup failure (bad archive, fetch
+                # timeout, chdir error) must surface as a task error, not
+                # hang the caller
+                from ray_tpu.core.ids import ObjectID as _OID
+                from ray_tpu.runtime_env import apply_runtime_env_in_worker
+
+                apply_runtime_env_in_worker(renv, lambda h: self.get_object(_OID.from_hex(h)))
             if spec.is_actor_creation:
                 self._create_actor_instance(spec, msg)
                 self._send({"type": "done", "task_id": spec.task_id, "returns": [], "error": None})

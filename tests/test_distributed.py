@@ -1,0 +1,159 @@
+"""Distributed control plane tests: process-separated node agents,
+health-check failure detection, RPC chaos.
+
+Reference strategy: python/ray/tests/test_failure* + rpc_chaos-style fault
+injection (src/ray/rpc/rpc_chaos.h:24) against real process boundaries
+(python/ray/cluster_utils.py:202 spawns real raylets; here Cluster.add_node
+spawns real node-agent daemons).
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import context, rpc_chaos
+
+
+@pytest.fixture
+def chaos_clear():
+    yield
+    rpc_chaos.clear()
+
+
+def test_remote_node_is_a_separate_process(rt_start):
+    """Cluster.add_node spawns a real node-agent daemon; its workers are
+    children of the agent, not of the head."""
+    client = context.get_client()
+    node = client.add_node({"CPU": 2, "pin": 1})
+    assert node.remote
+    assert node.agent_proc.pid is not None and node.agent_proc.pid != os.getpid()
+
+    @ray_tpu.remote(resources={"pin": 1}, num_cpus=0)
+    def where():
+        import os
+
+        return os.getpid(), os.getppid()
+
+    wpid, wppid = ray_tpu.get(where.remote(), timeout=60)
+    assert wpid != os.getpid()
+    assert wppid != os.getpid()  # parent is the agent (or its forkserver), not the head
+    client.remove_node(node.node_id)
+
+
+def test_actor_on_remote_node_and_restart(rt_start):
+    """Actor lifecycle (incl. restart machine) works across the agent
+    transport."""
+    client = context.get_client()
+    node = client.add_node({"CPU": 2, "pin": 1})
+
+    @ray_tpu.remote(resources={"pin": 1}, num_cpus=0, max_restarts=1)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    a = Counter.remote()
+    assert ray_tpu.get(a.incr.remote(), timeout=60) == 1
+    pid0 = ray_tpu.get(a.pid.remote())
+    try:
+        ray_tpu.get(a.die.remote(), timeout=10)
+    except Exception:
+        pass
+    deadline = time.time() + 30
+    pid1 = None
+    while time.time() < deadline:
+        try:
+            pid1 = ray_tpu.get(a.pid.remote(), timeout=5)
+            break
+        except Exception:
+            time.sleep(0.2)
+    assert pid1 is not None and pid1 != pid0  # restarted in a fresh process
+    client.remove_node(node.node_id)
+
+
+def test_agent_crash_fails_over(rt_start):
+    """SIGKILLing a node agent is detected (socket EOF) and its tasks are
+    retried on a surviving node."""
+    client = context.get_client()
+    node1 = client.add_node({"CPU": 2, "doomed": 1})
+
+    @ray_tpu.remote(resources={"doomed": 1}, num_cpus=0, max_retries=2)
+    def slow():
+        import time
+
+        time.sleep(2.0)
+        return "done"
+
+    ref = slow.remote()
+    # let it start (first worker spawn can take a few seconds)
+    deadline = time.time() + 30
+    while time.time() < deadline and not any(w.state == "busy" for w in node1.workers.values()):
+        time.sleep(0.1)
+    os.kill(node1.agent_proc.pid, signal.SIGKILL)
+    client.add_node({"CPU": 2, "doomed": 1})
+    assert ray_tpu.get(ref, timeout=60) == "done"
+
+
+def test_chaos_dispatch_delay(rt_start, chaos_clear):
+    """Injected transport delay slows dispatch but nothing breaks."""
+    client = context.get_client()
+    node = client.add_node({"CPU": 2, "pin": 1})
+
+    @ray_tpu.remote(resources={"pin": 1}, num_cpus=0)
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1), timeout=60) == 2  # warm worker first
+    rpc_chaos.inject("to_worker", delay_s=0.3)
+    t0 = time.time()
+    assert ray_tpu.get(f.remote(41), timeout=60) == 42
+    assert time.time() - t0 >= 0.3
+    rpc_chaos.clear()
+    client.remove_node(node.node_id)
+
+
+def test_chaos_pong_starvation_kills_node():
+    """Dropping all pongs makes the health checker declare the node dead
+    (gcs_health_check_manager.h behavior) and tasks fail over."""
+    ray_tpu.shutdown()
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={"health_check_period_s": 0.2, "health_check_failure_threshold": 4},
+    )
+    try:
+        client = context.get_client()
+        node = client.add_node({"CPU": 2, "pin": 1})
+
+        @ray_tpu.remote(resources={"pin": 1}, num_cpus=0, max_retries=2)
+        def f():
+            return "ok"
+
+        assert ray_tpu.get(f.remote(), timeout=60) == "ok"  # node works
+        rpc_chaos.inject("pong", drop_prob=1.0)
+        deadline = time.time() + 20
+        while time.time() < deadline and node.alive:
+            time.sleep(0.1)
+        assert not node.alive, "health checker never declared the starved node dead"
+        rpc_chaos.clear()
+        # tasks needing the lost resource become feasible again on a new node
+        client.add_node({"CPU": 2, "pin": 1})
+        assert ray_tpu.get(f.remote(), timeout=60) == "ok"
+    finally:
+        rpc_chaos.clear()
+        ray_tpu.shutdown()
