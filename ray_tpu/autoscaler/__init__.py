@@ -1,0 +1,8 @@
+from ray_tpu.autoscaler.autoscaler import (
+    Autoscaler,
+    LocalNodeProvider,
+    NodeProvider,
+    NodeTypeConfig,
+)
+
+__all__ = ["Autoscaler", "LocalNodeProvider", "NodeProvider", "NodeTypeConfig"]

@@ -1,0 +1,3 @@
+from ray_tpu.job.job_manager import JobInfo, JobManager, JobStatus, JobSubmissionClient
+
+__all__ = ["JobInfo", "JobManager", "JobStatus", "JobSubmissionClient"]

@@ -1,0 +1,145 @@
+"""runtime_env: per-task/actor execution environments.
+
+Reference parity: python/ray/_private/runtime_env/working_dir.py (zip the
+dir, content-hash URI, cache, unpack + chdir in workers), py_modules.py
+(extra import roots), and the env_vars passthrough the runtime already
+had. pip/conda/container isolation is intentionally gated: this image has
+no package index (zero egress), so `pip` raises a clear error instead of
+silently half-working.
+
+Flow:
+- driver: prepare_runtime_env() zips working_dir / py_modules (content-
+  hashed, size-capped), stores each archive ONCE in the shm object store,
+  and rewrites the runtime_env to carry object ids.
+- worker: apply_runtime_env_in_worker() fetches archives it has not
+  cached, unpacks under /tmp/ray_tpu/runtime_env/<hash>/, inserts
+  py_modules on sys.path, and chdirs into the working_dir copy.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import zipfile
+
+_MAX_ARCHIVE_BYTES = 512 * 1024 * 1024
+_EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules"}
+_CACHE_ROOT = "/tmp/ray_tpu/runtime_env"
+
+
+def _zip_dir(path: str) -> bytes:
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env path {path!r} is not a directory")
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+            for fn in files:
+                full = os.path.join(root, fn)
+                rel = os.path.relpath(full, path)
+                try:
+                    total += os.path.getsize(full)
+                except OSError:
+                    continue
+                if total > _MAX_ARCHIVE_BYTES:
+                    raise ValueError(
+                        f"runtime_env dir {path!r} exceeds {_MAX_ARCHIVE_BYTES >> 20}MB"
+                    )
+                zf.write(full, rel)
+    return buf.getvalue()
+
+
+def validate_runtime_env(runtime_env: dict | None):
+    """Reject env kinds this deployment cannot honor (called on EVERY
+    submit, before any cache shortcut)."""
+    for gated in ("pip", "conda", "uv", "container"):
+        if runtime_env and runtime_env.get(gated):
+            raise ValueError(
+                f"runtime_env[{gated!r}] is not supported in this deployment: "
+                "the environment has no package index (zero egress). Bake "
+                "dependencies into the image or ship code via working_dir/"
+                "py_modules."
+            )
+
+
+def dir_fingerprint(path: str) -> str:
+    """Cheap content fingerprint: (relpath, size, mtime_ns) of every file.
+    Lets the driver-side cache detect edits without re-zipping."""
+    path = os.path.abspath(path)
+    h = hashlib.sha256()
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+        for fn in sorted(files):
+            full = os.path.join(root, fn)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            h.update(f"{os.path.relpath(full, path)}:{st.st_size}:{st.st_mtime_ns};".encode())
+    return h.hexdigest()[:16]
+
+
+def prepare_runtime_env(runtime_env: dict | None) -> dict | None:
+    """Driver-side: package + upload dirs; returns the rewritten env."""
+    if not runtime_env:
+        return runtime_env
+    env = dict(runtime_env)
+    validate_runtime_env(env)
+    import ray_tpu
+
+    def pack(path: str) -> dict:
+        data = _zip_dir(path)
+        digest = hashlib.sha256(data).hexdigest()[:16]
+        ref = ray_tpu.put(data)
+        return {"hash": digest, "ref_hex": ref.id.hex(), "_ref": ref}
+
+    if env.get("working_dir"):
+        env["_packed_working_dir"] = pack(env.pop("working_dir"))
+    if env.get("py_modules"):
+        env["_packed_py_modules"] = [pack(p) for p in env.pop("py_modules")]
+    return env
+
+
+def _materialize(packed: dict, fetch) -> str:
+    """Worker-side: ensure the archive is unpacked; returns its dir."""
+    dest = os.path.join(_CACHE_ROOT, packed["hash"])
+    marker = os.path.join(dest, ".complete")
+    if os.path.exists(marker):
+        return dest
+    data = fetch(packed["ref_hex"])
+    tmp = dest + f".tmp{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(data)) as zf:
+        zf.extractall(tmp)
+    open(os.path.join(tmp, ".complete"), "w").close()
+    try:
+        os.rename(tmp, dest)
+    except OSError:
+        # raced another worker; theirs won
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+def apply_runtime_env_in_worker(runtime_env: dict | None, fetch):
+    """Worker-side: fetch(ref_hex) -> bytes loads an archive from the
+    object store. Applies py_modules to sys.path and chdirs into the
+    working_dir copy (also appended to sys.path, like the reference)."""
+    if not runtime_env:
+        return
+    import sys
+
+    for packed in runtime_env.get("_packed_py_modules") or []:
+        d = _materialize(packed, fetch)
+        if d not in sys.path:
+            sys.path.insert(0, d)
+    packed = runtime_env.get("_packed_working_dir")
+    if packed:
+        d = _materialize(packed, fetch)
+        os.chdir(d)
+        if d not in sys.path:
+            sys.path.insert(0, d)

@@ -1,0 +1,99 @@
+"""`rt` CLI: status / list / summary against the freshest session dump.
+
+Reference parity: python/ray/scripts/scripts.py:682 (`ray status`) and
+`ray list ...` from util/state — collapsed to read the head's periodic
+state.json snapshot (util/state.py), so it works from any shell on the
+machine while a driver runs.
+
+    python -m ray_tpu.scripts.cli status
+    python -m ray_tpu.scripts.cli list nodes|actors|tasks|pgs
+    python -m ray_tpu.scripts.cli summary tasks
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _load():
+    from ray_tpu.util.state import load_latest_state
+
+    snap = load_latest_state()
+    if snap is None:
+        print("no ray_tpu session state found under /tmp/ray_tpu/", file=sys.stderr)
+        sys.exit(1)
+    age = time.time() - snap.get("ts", 0)
+    if age > 30:
+        print(f"warning: snapshot is {age:.0f}s old (driver may have exited)", file=sys.stderr)
+    return snap
+
+
+def _fmt_resources(res: dict) -> str:
+    return ", ".join(f"{k}={v:g}" for k, v in sorted(res.items()))
+
+
+def cmd_status(_args):
+    snap = _load()
+    st = snap["status"]
+    print(f"== ray_tpu status (session pid {snap['pid']}, {time.time() - snap['ts']:.1f}s ago) ==")
+    print(f"Nodes ({len(st['nodes'])}):")
+    for n in st["nodes"]:
+        mark = "" if n["alive"] else " [DEAD]"
+        print(f"  {n['node_id'][:12]}{mark}  workers={n['num_workers']}  "
+              f"avail: {_fmt_resources(n['available'])}  total: {_fmt_resources(n['resources'])}")
+    print(f"Cluster resources: {_fmt_resources(st['cluster_resources'])}")
+    print(f"Available:         {_fmt_resources(st['available_resources'])}")
+    if st.get("pending_demand"):
+        print(f"Pending demand ({len(st['pending_demand'])} requests):")
+        for r in st["pending_demand"][:10]:
+            print(f"  {_fmt_resources(r)}")
+    if st.get("actors"):
+        print(f"Actors by state: {st['actors']}")
+
+
+def cmd_list(args):
+    snap = _load()
+    kind = args.kind
+    if kind == "nodes":
+        rows = snap["status"]["nodes"]
+    elif kind == "actors":
+        rows = snap.get("actors_list") or []
+    elif kind in ("pgs", "placement_groups"):
+        rows = snap.get("placement_groups", [])
+    elif kind == "tasks":
+        print(json.dumps(snap.get("tasks", {}), indent=2))
+        return
+    elif kind == "objects":
+        print(json.dumps(snap.get("objects", {}), indent=2))
+        return
+    else:
+        print(f"unknown kind {kind}", file=sys.stderr)
+        sys.exit(2)
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_summary(args):
+    snap = _load()
+    if args.kind == "tasks":
+        print(json.dumps(snap.get("tasks", {}), indent=2))
+    else:
+        print(json.dumps(snap["status"].get("actors", {}), indent=2))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="rt", description="ray_tpu cluster CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status")
+    lp = sub.add_parser("list")
+    lp.add_argument("kind", choices=["nodes", "actors", "tasks", "objects", "pgs", "placement_groups"])
+    sp = sub.add_parser("summary")
+    sp.add_argument("kind", choices=["tasks", "actors"])
+    args = p.parse_args(argv)
+    {"status": cmd_status, "list": cmd_list, "summary": cmd_summary}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,127 @@
+"""State API: programmatic cluster introspection + session state dumps.
+
+Reference parity: python/ray/util/state (list_tasks/list_actors/
+list_nodes/list_objects/list_placement_groups, summarize_*) backed by the
+head's live registries instead of a state-API server. For out-of-process
+inspection (the CLI), the head periodically dumps a JSON snapshot under
+the session dir (/tmp/ray_tpu/session_<pid>/state.json) — scripts/cli.py
+reads the freshest session.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import time
+
+
+def _client():
+    from ray_tpu.core import context
+
+    return context.get_client()
+
+
+def list_nodes() -> list[dict]:
+    return _client().cluster_info("nodes")
+
+
+def list_actors() -> list[dict]:
+    return _client().cluster_info("actors")
+
+
+def list_tasks() -> list[dict]:
+    return _client().cluster_info("tasks")
+
+
+def list_objects() -> dict:
+    return _client().cluster_info("objects")
+
+
+def list_placement_groups() -> list[dict]:
+    return _client().cluster_info("placement_groups")
+
+
+def summarize_tasks() -> dict:
+    """Counts by (name, state) — reference: `ray summary tasks`."""
+    by_state: dict = collections.defaultdict(lambda: collections.defaultdict(int))
+    for t in list_tasks():
+        by_state[t.get("name", "?")][t.get("state", "?")] += 1
+    return {name: dict(states) for name, states in by_state.items()}
+
+
+def summarize_actors() -> dict:
+    by_state: dict = collections.defaultdict(int)
+    for a in list_actors():
+        by_state[a.get("state", "?")] += 1
+    return dict(by_state)
+
+
+def cluster_status(client=None) -> dict:
+    """`ray status`-shaped summary."""
+    c = client or _client()
+    actors = collections.defaultdict(int)
+    for a in c.cluster_info("actors"):
+        actors[a.get("state", "?")] += 1
+    return {
+        "nodes": c.cluster_info("nodes"),
+        "cluster_resources": c.cluster_info("cluster_resources"),
+        "available_resources": c.cluster_info("available_resources"),
+        "pending_demand": c.scheduler.pending_demand() if hasattr(c, "scheduler") else [],
+        "actors": dict(actors),
+    }
+
+
+# ----------------------------------------------------------------------
+# session state dump (for the out-of-process CLI)
+# ----------------------------------------------------------------------
+def session_dir(pid: int | None = None) -> str:
+    pid = pid or int(os.environ.get("RT_SESSION_PID", os.getpid()))
+    return os.path.join("/tmp", "ray_tpu", f"session_{pid}")
+
+
+def dump_state(client=None) -> str:
+    """Write the current snapshot; returns the path."""
+    c = client or _client()
+    d = session_dir()
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, "state.json")
+    tasks: dict = collections.defaultdict(lambda: collections.defaultdict(int))
+    for t in c.cluster_info("tasks"):
+        tasks[t.get("name", "?")][t.get("state", "?")] += 1
+    snap = {
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "status": cluster_status(c),
+        "tasks": {k: dict(v) for k, v in tasks.items()},
+        "actors_list": c.cluster_info("actors"),
+        "placement_groups": c.cluster_info("placement_groups"),
+        "objects": c.cluster_info("objects"),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(snap, f, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def load_latest_state() -> dict | None:
+    """Newest state.json across sessions (CLI entry)."""
+    root = os.path.join("/tmp", "ray_tpu")
+    best, best_ts = None, -1.0
+    try:
+        sessions = os.listdir(root)
+    except FileNotFoundError:
+        return None
+    for s in sessions:
+        p = os.path.join(root, s, "state.json")
+        try:
+            ts = os.path.getmtime(p)
+        except OSError:
+            continue
+        if ts > best_ts:
+            best, best_ts = p, ts
+    if best is None:
+        return None
+    with open(best) as f:
+        return json.load(f)

@@ -1,0 +1,206 @@
+"""Ops-layer tests: autoscaler, job submission, runtime_env, state API/CLI.
+
+Reference strategy: autoscaler/v2 unit reconcile tests, dashboard job
+manager e2e (submit -> logs -> status), runtime_env working_dir tests.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import context
+
+
+# ---------------------------------------------------------------- autoscaler
+def test_autoscaler_scales_up_for_demand_and_down_when_idle(rt_start):
+    from ray_tpu.autoscaler import Autoscaler, NodeTypeConfig
+
+    client = context.get_client()
+    sc = Autoscaler(
+        client,
+        [NodeTypeConfig("gpuless", {"CPU": 2.0, "bonus": 2.0}, min_workers=0, max_workers=3)],
+        idle_timeout_s=1.0,
+        interval_s=0.1,
+    ).start()
+    try:
+        @ray_tpu.remote(resources={"bonus": 1}, num_cpus=0)
+        def f():
+            return ray_tpu.get_runtime_context().node_id.hex()
+
+        # no node has "bonus": demand must trigger a launch
+        out = ray_tpu.get([f.remote() for _ in range(2)], timeout=90)
+        assert len(out) == 2
+        st = sc.status()
+        assert st["managed_count"] >= 1
+        # idle: the managed node must be terminated after the timeout
+        deadline = time.time() + 30
+        while time.time() < deadline and sc.status()["managed_count"] > 0:
+            time.sleep(0.2)
+        assert sc.status()["managed_count"] == 0, "idle node never scaled down"
+    finally:
+        sc.stop()
+
+
+def test_autoscaler_respects_max_workers(rt_start):
+    from ray_tpu.autoscaler import Autoscaler, NodeTypeConfig
+
+    client = context.get_client()
+    sc = Autoscaler(
+        client,
+        [NodeTypeConfig("small", {"CPU": 1.0, "tag": 1.0}, max_workers=2)],
+        idle_timeout_s=60.0,
+        interval_s=0.1,
+    ).start()
+    try:
+        @ray_tpu.remote(resources={"tag": 1}, num_cpus=0)
+        def hold():
+            time.sleep(3.0)
+            return 1
+
+        refs = [hold.remote() for _ in range(5)]
+        time.sleep(2.0)
+        assert sc.status()["managed_count"] <= 2
+        assert sum(ray_tpu.get(refs, timeout=120)) == 5  # all complete eventually
+    finally:
+        sc.stop()
+
+
+def test_autoscaler_min_workers_floor(rt_start):
+    from ray_tpu.autoscaler import Autoscaler, NodeTypeConfig
+
+    client = context.get_client()
+    sc = Autoscaler(
+        client,
+        [NodeTypeConfig("floor", {"CPU": 1.0}, min_workers=2, max_workers=4)],
+        interval_s=0.1,
+    ).start()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and sc.status()["managed_count"] < 2:
+            time.sleep(0.2)
+        assert sc.status()["managed_count"] >= 2
+    finally:
+        sc.stop()
+
+
+# ---------------------------------------------------------------- jobs
+def test_job_submission_lifecycle(rt_start):
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job'); import os; print(os.environ['GREETING'])\"",
+        runtime_env={"env_vars": {"GREETING": "bonjour"}},
+    )
+    mgr = client._mgr
+    assert mgr.wait_until_finished(job_id, timeout=60) == JobStatus.SUCCEEDED
+    logs = client.get_job_logs(job_id)
+    assert "hello from job" in logs and "bonjour" in logs
+    assert client.get_job_info(job_id).returncode == 0
+
+
+def test_job_stop_and_failure(rt_start):
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    bad = client.submit_job(entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    assert client._mgr.wait_until_finished(bad, timeout=60) == JobStatus.FAILED
+    assert client.get_job_info(bad).returncode == 3
+
+    slow = client.submit_job(entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+    deadline = time.time() + 30
+    while time.time() < deadline and client.get_job_status(slow) == JobStatus.PENDING:
+        time.sleep(0.05)
+    assert client.stop_job(slow)
+    assert client._mgr.wait_until_finished(slow, timeout=30) == JobStatus.STOPPED
+    assert len(client.list_jobs()) >= 2
+
+
+# ---------------------------------------------------------------- runtime_env
+def test_runtime_env_working_dir_and_py_modules(rt_start, tmp_path):
+    wd = tmp_path / "app"
+    wd.mkdir()
+    (wd / "data.txt").write_text("payload-42")
+    mod = tmp_path / "extra_mod"
+    mod.mkdir()
+    (mod / "shiny_helper.py").write_text("VALUE = 1234\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(wd), "py_modules": [str(mod)]})
+    def probe():
+        import os
+
+        import shiny_helper  # from py_modules
+
+        return open("data.txt").read(), shiny_helper.VALUE, os.getcwd()
+
+    data, val, cwd = ray_tpu.get(probe.remote(), timeout=60)
+    assert data == "payload-42"
+    assert val == 1234
+    assert "/tmp/ray_tpu/runtime_env/" in cwd
+
+    # plain tasks must NOT land on the polluted worker
+    @ray_tpu.remote
+    def plain_cwd():
+        import os
+
+        return os.getcwd()
+
+    assert "/tmp/ray_tpu/runtime_env/" not in ray_tpu.get(plain_cwd.remote(), timeout=60)
+
+
+def test_runtime_env_pip_is_gated(rt_start):
+    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    def f():
+        return 1
+
+    with pytest.raises(Exception, match="pip"):
+        ray_tpu.get(f.remote(), timeout=30)
+
+
+def test_runtime_env_actor_env_vars(rt_start):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_FLAVOR": "mint"}})
+    class A:
+        def flavor(self):
+            import os
+
+            return os.environ.get("ACTOR_FLAVOR")
+
+    a = A.remote()
+    assert ray_tpu.get(a.flavor.remote(), timeout=60) == "mint"
+
+
+# ---------------------------------------------------------------- state / CLI
+def test_state_api_and_cli(rt_start):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get([f.remote() for _ in range(3)])
+    nodes = state.list_nodes()
+    assert nodes and all("node_id" in n for n in nodes)
+    assert isinstance(state.summarize_tasks(), dict)
+    st = state.cluster_status()
+    assert st["cluster_resources"].get("CPU", 0) > 0
+
+    path = state.dump_state()
+    assert os.path.exists(path)
+    snap = state.load_latest_state()
+    assert snap is not None and snap["pid"] == os.getpid()
+
+    # CLI renders the snapshot
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "status"],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "ray_tpu status" in out.stdout
+    assert "Cluster resources" in out.stdout
