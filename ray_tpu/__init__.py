@@ -32,6 +32,7 @@ from ray_tpu.api import (  # noqa: F401
     wait,
 )
 from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator  # noqa: F401
+from ray_tpu.util.timeline import timeline  # noqa: F401
 
 __version__ = "0.1.0"
 

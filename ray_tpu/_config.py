@@ -71,6 +71,12 @@ class Config:
     # Session state.json dump period for the out-of-process CLI
     # (scripts/cli.py); 0 disables.
     state_dump_interval_s: float = 2.0
+    # Stream worker log files back to the driver tty (log_monitor.py).
+    log_to_driver: bool = True
+    # --- memory protection (reference: memory_monitor.h,
+    # worker_killing_policy.h) ---
+    memory_monitor_refresh_ms: int = 250  # 0 disables
+    memory_usage_threshold: float = 0.95
     # Actor restart backoff.
     actor_restart_backoff_s: float = 0.1
 

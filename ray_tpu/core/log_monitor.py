@@ -1,0 +1,83 @@
+"""Log monitor: stream worker log files back to the driver tty.
+
+Reference parity: python/ray/_private/log_monitor.py (tail worker
+out/err files, publish lines to the driver which prints them with
+``(pid=...)`` prefixes). Collapsed: one thread in the head tails every
+file under <session>/logs/ and writes prefixed lines to the driver's
+stderr. New files are discovered each sweep; rotated/truncated files
+restart from zero.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+
+class LogMonitor:
+    def __init__(self, session_logs_dir: str, out=None, interval_s: float = 0.25):
+        self.dir = session_logs_dir
+        self.out = out or sys.stderr
+        self.interval_s = interval_s
+        self._offsets: dict[str, int] = {}
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self, clean: bool = True):
+        if clean:
+            # session dirs are keyed by pid: a second init() in the same
+            # process (or pid reuse) must not replay the old session's logs
+            try:
+                for name in os.listdir(self.dir):
+                    if name.endswith(".log"):
+                        os.unlink(os.path.join(self.dir, name))
+            except OSError:
+                pass
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="rt-log-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Stop and join the poll thread (callers may then poll_once() for
+        a final race-free flush)."""
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _loop(self):
+        while not self._stopped.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                pass
+
+    def poll_once(self):
+        try:
+            names = sorted(os.listdir(self.dir))
+        except FileNotFoundError:
+            return
+        for name in names:
+            if not name.endswith(".log"):
+                continue
+            path = os.path.join(self.dir, name)
+            tag = name[len("worker-"):-len(".log")] if name.startswith("worker-") else name
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            pos = self._offsets.get(name, 0)
+            if size < pos:
+                pos = 0  # truncated/rotated
+            if size == pos:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(pos)
+                    chunk = f.read(1 << 20)
+                    self._offsets[name] = f.tell()
+            except OSError:
+                continue
+            text = chunk.decode(errors="replace")
+            for line in text.splitlines():
+                print(f"(worker={tag}) {line}", file=self.out)

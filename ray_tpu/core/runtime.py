@@ -162,6 +162,14 @@ class Runtime:
             self._health_thread.start()
             if self.cfg.state_dump_interval_s > 0:
                 threading.Thread(target=self._state_dump_loop, daemon=True, name="rt-state-dump").start()
+            if self.cfg.log_to_driver:
+                from ray_tpu.core.log_monitor import LogMonitor
+                from ray_tpu.util.state import session_dir
+
+                self._log_monitor = LogMonitor(os.path.join(session_dir(), "logs")).start()
+            from ray_tpu.core.memory_monitor import MemoryMonitor
+
+            self._memory_monitor = MemoryMonitor(self).start()
             if self.cfg.prestart_workers:
                 # Warm the pool in the background (reference: worker_pool.h
                 # prestart) — overlaps the one-time forkserver boot with user
@@ -1515,6 +1523,11 @@ class Runtime:
         if self._stopped:
             return
         self._stopped = True
+        if getattr(self, "_log_monitor", None) is not None:
+            self._log_monitor.stop()  # joins the poll thread
+            self._log_monitor.poll_once()  # final race-free flush
+        if getattr(self, "_memory_monitor", None) is not None:
+            self._memory_monitor.stop()
         self.scheduler.stop()
         for node in list(self.nodes.values()):
             node.shutdown()

@@ -411,10 +411,34 @@ def spec_return_ids(spec):
     return [ObjectID.for_task_return(spec.task_id, i) for i in range(spec.num_returns)]
 
 
+def _redirect_worker_logs(worker_id: str):
+    """Tee this worker's stdout/stderr into a per-worker session log file
+    (reference: worker out/err files + log_monitor.py streaming them to
+    the driver). fd-level dup2 so subprocess/extension prints land too;
+    the head's log monitor tails these files back to the driver tty."""
+    try:
+        from ray_tpu.util.state import session_dir
+
+        d = os.path.join(session_dir(), "logs")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"worker-{worker_id[:12]}.log")
+        f = open(path, "ab", buffering=0)
+        os.dup2(f.fileno(), 1)
+        os.dup2(f.fileno(), 2)
+        import sys
+
+        sys.stdout = os.fdopen(1, "w", buffering=1)
+        sys.stderr = os.fdopen(2, "w", buffering=1)
+    except Exception:
+        pass  # logging must never block a worker from starting
+
+
 def worker_entry(conn, worker_id: str, node_id: str, env: dict | None = None):
     """Process entry point (multiprocessing target)."""
     if env:
         os.environ.update(env)
+    os.environ["RT_WORKER_ID"] = worker_id  # metrics flusher / log capture key
+    _redirect_worker_logs(worker_id)
     # Workers must not inherit a driver-side TPU lock; JAX is imported lazily
     # by user code (reference warns likewise: train/v2/jax/jax_trainer.py:88).
     client = WorkerClient(conn, worker_id, node_id)

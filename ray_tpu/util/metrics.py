@@ -1,0 +1,216 @@
+"""Metrics API: Counter / Gauge / Histogram with cluster aggregation.
+
+Reference parity: python/ray/util/metrics.py (Counter/Gauge/Histogram with
+tag_keys, default tags, .inc/.set/.observe) + the dashboard's Prometheus
+export. Collapsed transport: every process accumulates locally; worker
+processes flush their registry into the head's GCS KV (namespace
+"_metrics") on a background thread, and `get_metrics_snapshot()` /
+`export_prometheus()` merge all processes' series.
+
+    from ray_tpu.util import metrics
+    c = metrics.Counter("requests_total", description="...", tag_keys=("route",))
+    c.inc(1.0, tags={"route": "/api"})
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+import time
+
+_DEFAULT_HIST_BOUNDARIES = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10]
+
+
+class _Registry:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.metrics: dict[str, "Metric"] = {}
+        self._flusher: threading.Thread | None = None
+
+    def register(self, m: "Metric"):
+        with self.lock:
+            existing = self.metrics.get(m.name)
+            if existing is not None:
+                if existing.kind != m.kind or getattr(existing, "boundaries", None) != getattr(m, "boundaries", None):
+                    raise ValueError(
+                        f"metric {m.name!r} already registered as {existing.kind}"
+                        f"{' with different boundaries' if existing.kind == m.kind else ''}"
+                    )
+                return existing
+            self.metrics[m.name] = m
+            self._ensure_flusher()
+            return m
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {name: m._dump() for name, m in self.metrics.items()}
+
+    def _ensure_flusher(self):
+        # only worker processes push; the driver is read locally
+        if self._flusher is not None or os.environ.get("RT_WORKER_ID") is None:
+            return
+        self._flusher = threading.Thread(target=self._flush_loop, daemon=True, name="rt-metrics-flush")
+        self._flusher.start()
+
+    def _flush_loop(self):
+        from ray_tpu.core import context
+
+        wid = os.environ.get("RT_WORKER_ID", str(os.getpid()))
+        while True:
+            time.sleep(1.0)
+            try:
+                client = context.get_client()
+                client.kv("put", key=f"proc::{wid}", value=self.snapshot(), namespace="_metrics")
+            except Exception:
+                pass
+
+
+_registry = _Registry()
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "", tag_keys: tuple = ()):
+        if not name or not name.replace("_", "a").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: dict = {}
+        self._series: dict[tuple, float | list] = {}
+        self._lock = threading.Lock()
+        shared = _registry.register(self)
+        if shared is not self:
+            # same name registered twice in one process: share the series
+            self._series = shared._series
+            self._lock = shared._lock
+
+    def set_default_tags(self, tags: dict):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: dict | None) -> tuple:
+        merged = {**self._default_tags, **(tags or {})}
+        extra = set(merged) - set(self.tag_keys)
+        if extra:
+            raise ValueError(f"tags {extra} not in tag_keys {self.tag_keys}")
+        return tuple(str(merged.get(k, "")) for k in self.tag_keys)
+
+    def _dump(self) -> dict:
+        with self._lock:
+            return {
+                "kind": self.kind,
+                "description": self.description,
+                "tag_keys": self.tag_keys,
+                "series": {",".join(k): v if not isinstance(v, list) else list(v) for k, v in self._series.items()},
+            }
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: dict | None = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = self._key(tags)
+        with self._lock:
+            self._series[k] = float(self._series.get(k, 0.0)) + value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: dict | None = None):
+        with self._lock:
+            self._series[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description: str = "", boundaries=None, tag_keys: tuple = ()):
+        self.boundaries = list(boundaries or _DEFAULT_HIST_BOUNDARIES)
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float, tags: dict | None = None):
+        k = self._key(tags)
+        with self._lock:
+            buckets = self._series.get(k)
+            if not isinstance(buckets, list):
+                # [count, sum, bucket_counts...]
+                buckets = [0.0, 0.0] + [0.0] * (len(self.boundaries) + 1)
+                self._series[k] = buckets
+            buckets[0] += 1
+            buckets[1] += value
+            buckets[2 + bisect.bisect_left(self.boundaries, value)] += 1
+
+    def _dump(self) -> dict:
+        d = super()._dump()
+        d["boundaries"] = self.boundaries
+        return d
+
+
+# ----------------------------------------------------------------------
+# aggregation / export (driver side)
+# ----------------------------------------------------------------------
+def get_metrics_snapshot(client=None) -> dict:
+    """Merged view: local registry + every worker's flushed registry."""
+    from ray_tpu.core import context
+
+    merged: dict = {}
+
+    def fold(proc_snap: dict):
+        for name, m in proc_snap.items():
+            agg = merged.setdefault(
+                name,
+                {"kind": m["kind"], "description": m["description"], "tag_keys": tuple(m["tag_keys"]), "series": {}},
+            )
+            if "boundaries" in m:
+                agg["boundaries"] = m["boundaries"]
+            for key, val in m["series"].items():
+                cur = agg["series"].get(key)
+                if isinstance(val, list):
+                    agg["series"][key] = [a + b for a, b in zip(cur, val)] if cur else list(val)
+                elif m["kind"] == "gauge":
+                    agg["series"][key] = val  # last writer wins
+                else:
+                    agg["series"][key] = (cur or 0.0) + val
+
+    fold(_registry.snapshot())
+    try:
+        c = client or context.get_client()
+        for key in c.kv("keys", prefix="proc::", namespace="_metrics"):
+            snap = c.kv("get", key=key, namespace="_metrics")
+            if snap:
+                fold(snap)
+    except Exception:
+        pass
+    return merged
+
+
+def export_prometheus(client=None) -> str:
+    """Prometheus text exposition of the merged snapshot."""
+    lines = []
+    for name, m in sorted(get_metrics_snapshot(client).items()):
+        lines.append(f"# HELP {name} {m['description']}")
+        lines.append(f"# TYPE {name} {m['kind']}")
+        for key, val in m["series"].items():
+            tags = ""
+            if m["tag_keys"]:
+                vals = key.split(",")
+                tags = "{" + ",".join(f'{k}="{v}"' for k, v in zip(m["tag_keys"], vals)) + "}"
+            if isinstance(val, list):
+                count, total, *buckets = val
+                bounds = m.get("boundaries", _DEFAULT_HIST_BOUNDARIES)
+                cum = 0.0
+                for b, n in zip(list(bounds) + ["+Inf"], buckets):
+                    cum += n
+                    lb = tags[:-1] + "," if tags else "{"
+                    lines.append(f'{name}_bucket{lb}le="{b}"}} {cum:g}')
+                lines.append(f"{name}_count{tags} {count:g}")
+                lines.append(f"{name}_sum{tags} {total:g}")
+            else:
+                lines.append(f"{name}{tags} {val:g}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,62 @@
+"""Profiling helpers: jax.profiler capture + wall-time probes.
+
+Reference parity gap (SURVEY §5.1): the reference ships py-spy/torch
+profiler plumbing; the TPU-native equivalents are XLA's profiler traces
+(TensorBoard-viewable) captured around jitted regions.
+
+    with profile_trace("/tmp/tb"):        # XLA device trace
+        step(state, batch)
+
+    prof = WallProfiler(); ...; prof.report()
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str, host_tracer_level: int = 2):
+    """jax.profiler.trace wrapper; view with tensorboard --logdir."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def start_profiler_server(port: int = 9999):
+    """On-demand capture endpoint (tensorboard 'capture profile')."""
+    import jax
+
+    jax.profiler.start_server(port)
+    return port
+
+
+class WallProfiler:
+    """Named wall-time spans with device sync, for quick perf triage."""
+
+    def __init__(self):
+        self.spans: dict[str, list[float]] = {}
+
+    @contextlib.contextmanager
+    def span(self, name: str, sync_value=None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync_value is not None:
+                import jax
+
+                jax.block_until_ready(sync_value)
+            self.spans.setdefault(name, []).append(time.perf_counter() - t0)
+
+    def report(self) -> dict:
+        return {
+            name: {"count": len(v), "total_s": sum(v), "mean_s": sum(v) / len(v)}
+            for name, v in self.spans.items()
+            if v
+        }

@@ -1,0 +1,46 @@
+"""Task timeline export (chrome://tracing format).
+
+Reference parity: ray.timeline() backed by
+src/ray/core_worker/task_event_buffer.h task events — here the
+TaskManager's per-task (state, timestamp) event lists are rendered into
+trace-event JSON: one complete ("X") event per RUNNING->terminal span,
+rows (tid) = workers, process groups (pid) = nodes. Open the file in
+chrome://tracing or Perfetto.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def timeline(filename: str | None = None, client=None) -> list[dict]:
+    from ray_tpu.core import context
+
+    c = client or context.get_client()
+    events: list[dict] = []
+    tm = c.task_manager
+    with tm._lock:
+        tasks = list(tm._tasks.values())
+    for st in tasks:
+        run_start = None
+        for state, ts in st.events:
+            if state == "RUNNING":
+                run_start = ts
+            elif state in ("FINISHED", "FAILED", "CANCELLED") and run_start is not None:
+                events.append(
+                    {
+                        "name": st.spec.name,
+                        "ph": "X",
+                        "ts": run_start * 1e6,
+                        "dur": max(0.0, (ts - run_start)) * 1e6,
+                        "pid": st.node_id.hex()[:8] if st.node_id else "head",
+                        "tid": st.worker_id.hex()[:8] if st.worker_id else "?",
+                        "cat": "actor_task" if st.spec.actor_id is not None else "task",
+                        "args": {"status": state, "attempts": st.attempts_done},
+                    }
+                )
+                run_start = None
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events
