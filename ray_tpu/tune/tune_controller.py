@@ -62,13 +62,14 @@ class TrialActor:
         return self.trial_id
 
     def _on_report(self, seq, metrics, checkpoint, checkpoint_dir_name):
-        self._reports.put(
-            {
-                "seq": seq,
-                "metrics": metrics,
-                "checkpoint_path": checkpoint.path if checkpoint else None,
-            }
-        )
+        # stage checkpoint content NOW, inside the report call: report()
+        # returns to user code which may delete the source dir (e.g. a
+        # TemporaryDirectory) long before the controller polls
+        staged = None
+        if checkpoint is not None and os.path.isdir(checkpoint.path):
+            staged = os.path.join("/tmp", "ray_tpu", "trial_stage", self.trial_id, f"seq{seq}")
+            shutil.copytree(checkpoint.path, staged, dirs_exist_ok=True)
+        self._reports.put({"seq": seq, "metrics": metrics, "checkpoint_path": staged})
 
     def poll(self):
         out = []
@@ -111,7 +112,67 @@ class TuneController:
         self._failures: dict[str, int] = {}
         self._pending: dict[str, list] = {}  # undelivered reports per trial
         self._exhausted = False
+        self._dirty = False
         os.makedirs(run_dir, exist_ok=True)
+
+    # ---------------- experiment snapshots ----------------
+    # Reference: tune/execution/experiment_state.py — periodic experiment
+    # checkpoints enabling Tuner.restore after a crash/interrupt.
+    SNAPSHOT_NAME = "experiment_state.pkl"
+    SNAPSHOT_MIN_INTERVAL_S = 5.0  # reference throttles periodic snapshots too
+
+    def save_snapshot(self, force: bool = False):
+        import time as _time
+
+        if not force and _time.monotonic() - getattr(self, "_last_snapshot_ts", 0.0) < self.SNAPSHOT_MIN_INTERVAL_S:
+            return
+        self._last_snapshot_ts = _time.monotonic()
+        import cloudpickle
+
+        state = {
+            "trials": self.trials,
+            "searcher": self.searcher,
+            "scheduler": self.scheduler,
+            "exhausted": self._exhausted,
+            "failures": self._failures,
+            "metric": self.metric,
+            "mode": self.mode,
+            "max_concurrent": self.max_concurrent,
+            "max_failures": self.max_failures,
+        }
+        path = os.path.join(self.run_dir, self.SNAPSHOT_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(state, f)
+        os.replace(tmp, path)
+        self._dirty = False
+
+    def load_snapshot(self, state: dict, *, resume_errored: bool = False, restart_errored: bool = False):
+        """Adopt a saved experiment: live trials resume from their last
+        checkpoint; terminal ones keep their results."""
+        self.trials = state["trials"]
+        self.searcher = state["searcher"]
+        if state.get("scheduler") is not None:
+            self.scheduler = state["scheduler"]
+        self._exhausted = state["exhausted"]
+        self._failures = dict(state.get("failures", {}))
+        self.max_concurrent = state.get("max_concurrent", self.max_concurrent)
+        self.max_failures = state.get("max_failures", self.max_failures)
+        for t in self.trials:
+            if t.status == RUNNING:
+                t.status = PAUSED  # was in flight when the snapshot landed
+            elif t.status == ERROR and restart_errored:
+                t.status = PAUSED
+                t.checkpoint_path = None
+                t.iteration = 0
+                t.metrics_history = []
+                t.last_result = None  # stale scores must not feed PBT/grids
+                t.error = None
+                self._failures.pop(t.trial_id, None)
+            elif t.status == ERROR and resume_errored:
+                t.status = PAUSED
+                t.error = None
+                self._failures.pop(t.trial_id, None)
 
     # ---------------- PBT hook ----------------
     def request_exploit(self, trial: Trial, donor: Trial, new_config: dict):
@@ -132,6 +193,9 @@ class TuneController:
             if not running and not paused and not self._exhausted and not self._maybe_launch():
                 break
             self._poll_running()
+            if self._dirty:
+                self.save_snapshot()
+        self.save_snapshot(force=True)
         return self.trials
 
     def _maybe_launch(self) -> bool:
@@ -166,13 +230,22 @@ class TuneController:
     def _stop_trial(self, trial: Trial, status: str):
         actor = self._actors.pop(trial.trial_id, None)
         self._run_refs.pop(trial.trial_id, None)
-        self._pending.pop(trial.trial_id, None)  # stale reports die with the run
+        # stale reports die with the run — including their staged
+        # checkpoint copies (otherwise /tmp accumulates one per dropped
+        # report on STOP/PAUSE decisions)
+        for rep in self._pending.pop(trial.trial_id, []) or []:
+            src = rep.get("checkpoint_path")
+            if src and "/trial_stage/" in src:
+                shutil.rmtree(src, ignore_errors=True)
+        if trial.is_finished or status in (TERMINATED, ERROR):
+            shutil.rmtree(os.path.join("/tmp", "ray_tpu", "trial_stage", trial.trial_id), ignore_errors=True)
         if actor is not None:
             try:
                 ray_tpu.kill(actor)
             except Exception:
                 pass
         trial.status = status
+        self._dirty = True
         if trial.is_finished:
             self.searcher.on_trial_complete(trial.trial_id, result=trial.last_result, error=status == ERROR)
             self.scheduler.on_trial_complete(self, trial)
@@ -242,6 +315,7 @@ class TuneController:
             trial.checkpoint_path = self._commit_checkpoint(trial, rep["checkpoint_path"])
         trial.last_result = metrics
         trial.metrics_history.append(metrics)
+        self._dirty = True
         return self.scheduler.on_trial_result(self, trial, metrics)
 
     def _finish_or_retry(self, trial: Trial):
@@ -257,4 +331,6 @@ class TuneController:
         os.makedirs(dest, exist_ok=True)
         if os.path.isdir(src):
             shutil.copytree(src, dest, dirs_exist_ok=True)
+            if "/trial_stage/" in src:
+                shutil.rmtree(src, ignore_errors=True)  # reap the staging copy
         return dest

@@ -38,6 +38,51 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._restore_state: dict | None = None
+        self._restore_opts: dict = {}
+
+    # ---------------- restore ----------------
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        from ray_tpu.tune.tune_controller import TuneController
+
+        return os.path.exists(os.path.join(path, TuneController.SNAPSHOT_NAME))
+
+    @classmethod
+    def restore(
+        cls,
+        path: str,
+        trainable,
+        *,
+        resume_errored: bool = False,
+        restart_errored: bool = False,
+        param_space: dict | None = None,
+    ) -> "Tuner":
+        """Resume an experiment from its run_dir snapshot (reference:
+        Tuner.restore + tune/execution/experiment_state.py). Live trials
+        continue from their last committed checkpoint; errored trials are
+        resumed/restarted per the flags; finished trials keep results."""
+        import cloudpickle
+
+        from ray_tpu.train.config import RunConfig
+        from ray_tpu.tune.tune_controller import TuneController
+
+        snap_path = os.path.join(path, TuneController.SNAPSHOT_NAME)
+        with open(snap_path, "rb") as f:
+            state = cloudpickle.load(f)
+        run_config = RunConfig(
+            name=os.path.basename(os.path.normpath(path)),
+            storage_path=os.path.dirname(os.path.normpath(path)),
+        )
+        tuner = cls(
+            trainable,
+            param_space=param_space,
+            tune_config=TuneConfig(metric=state.get("metric"), mode=state.get("mode", "max")),
+            run_config=run_config,
+        )
+        tuner._restore_state = state
+        tuner._restore_opts = {"resume_errored": resume_errored, "restart_errored": restart_errored}
+        return tuner
 
     def fit(self) -> ResultGrid:
         import ray_tpu
@@ -70,6 +115,9 @@ class Tuner:
             resources_per_trial=resources,
             max_failures_per_trial=self.run_config.failure_config.max_failures,
         )
+        if self._restore_state is not None:
+            controller.load_snapshot(self._restore_state, **self._restore_opts)
+            self._restore_state = None
         trials = controller.run()
         return ResultGrid(trials, run_dir)
 

@@ -1,0 +1,42 @@
+"""joblib backend over ray_tpu (reference: ray/util/joblib/__init__.py —
+register_ray() so sklearn's n_jobs parallelism fans out to the cluster).
+
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        ...
+"""
+
+from __future__ import annotations
+
+
+def register_ray():
+    from joblib import register_parallel_backend
+    from joblib._parallel_backends import MultiprocessingBackend
+
+    import ray_tpu
+
+    class RayTpuBackend(MultiprocessingBackend):
+        supports_timeout = True
+
+        def configure(self, n_jobs=1, parallel=None, prefer=None, require=None, **kw):
+            n_jobs = self.effective_n_jobs(n_jobs)
+            self.parallel = parallel
+            from ray_tpu.util.multiprocessing import Pool
+
+            self._pool = Pool(processes=n_jobs)
+            return n_jobs
+
+        def effective_n_jobs(self, n_jobs):
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 has no meaning")
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
+            return cpus if n_jobs is None or n_jobs < 0 else n_jobs
+
+        def terminate(self):
+            if getattr(self, "_pool", None) is not None:
+                self._pool.terminate()
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -1,0 +1,173 @@
+"""Ecosystem shim tests: ActorPool, Queue, multiprocessing.Pool, joblib,
+Tuner.restore.
+
+Reference strategy: python/ray/tests/test_actor_pool.py, test_queue.py,
+util/multiprocessing tests, tune restore tests.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_actor_pool_map_ordered_and_unordered(rt_start):
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, x):
+            import time as _t
+
+            _t.sleep(0.01 * (5 - x % 5))
+            return 2 * x
+
+    pool = ActorPool([Doubler.remote() for _ in range(3)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [2 * i for i in range(8)]  # submission order preserved
+    out2 = sorted(pool.map_unordered(lambda a, v: a.double.remote(v), range(8)))
+    assert out2 == sorted(2 * i for i in range(8))
+    assert pool.has_free() and not pool.has_next()
+
+
+def test_queue_blocking_and_batches(rt_start):
+    from ray_tpu.util.queue import Empty, Full, Queue
+
+    q = Queue(maxsize=3)
+    q.put(1)
+    q.put_nowait_batch([2, 3])
+    assert q.qsize() == 3 and q.full()
+    with pytest.raises(Full):
+        q.put_nowait(4)
+    assert q.get() == 1
+    assert q.get_nowait_batch(2) == [2, 3]
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+
+    # blocking get unblocks when a producer task puts
+    @ray_tpu.remote
+    def producer(q):
+        import time as _t
+
+        _t.sleep(0.3)
+        q.put("prod")
+        return True
+
+    ref = producer.remote(q)
+    assert q.get(timeout=10) == "prod"
+    assert ray_tpu.get(ref)
+    q.shutdown()
+
+
+def test_multiprocessing_pool(rt_start):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as p:
+        assert p.map(_sq, range(10)) == [x * x for x in range(10)]
+        assert p.apply(_add, (3, 4)) == 7
+        r = p.map_async(_sq, range(5))
+        assert r.get(timeout=60) == [0, 1, 4, 9, 16]
+        assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+        assert list(p.imap(_sq, range(4))) == [0, 1, 4, 9]
+        assert sorted(p.imap_unordered(_sq, range(4))) == [0, 1, 4, 9]
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_joblib_backend(rt_start):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(6))
+    assert out == [0, 1, 4, 9, 16, 25]
+
+
+# ---------------------------------------------------------------- tune restore
+def _resumable_trainable(config):
+    """Checkpoints every iteration; crashes at iteration 3 unless the
+    'fixed' marker exists. On resume it continues from the checkpoint."""
+    import json
+    import os
+    import tempfile
+
+    from ray_tpu import train
+
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        with open(os.path.join(ckpt.path, "state.json")) as f:
+            start = json.load(f)["iteration"] + 1
+    for it in range(start, 6):
+        if it == 3 and not os.path.exists(config["marker"]):
+            raise RuntimeError("transient failure at iteration 3")
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"iteration": it}, f)
+            train.report({"score": it * config["lr"], "iter_seen": it}, checkpoint=train.Checkpoint(d))
+
+
+def test_tuner_restore_resumes_errored_trials(rt_start, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.train.config import RunConfig
+
+    marker = str(tmp_path / "fixed.marker")
+    run_dir = str(tmp_path / "exp")
+    tuner = tune.Tuner(
+        _resumable_trainable,
+        param_space={"lr": tune.grid_search([1.0, 10.0]), "marker": marker},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="restoreme", storage_path=run_dir),
+    )
+    grid = tuner.fit()
+    assert all(t.status == "ERROR" for t in grid._trials)
+    # each trial crashed at iteration 3, having banked checkpoints 0..2
+    assert all(t.iteration == 3 for t in grid._trials)
+
+    exp_path = f"{run_dir}/restoreme"
+    assert tune.Tuner.can_restore(exp_path)
+    open(marker, "w").close()  # "fix the bug"
+    tuner2 = tune.Tuner.restore(exp_path, _resumable_trainable, resume_errored=True)
+    grid2 = tuner2.fit()
+    assert all(t.status == "TERMINATED" for t in grid2._trials)
+    for t in grid2._trials:
+        iters = [m["iter_seen"] for m in t.metrics_history]
+        assert iters[-1] == 5
+        # resumed from the checkpoint, not from scratch: iteration 3 comes
+        # right after the pre-crash history without repeating 0..2
+        assert iters.count(0) == 1
+    scores = sorted(t.last_result["score"] for t in grid2._trials)
+    assert scores == [5.0, 50.0]
+
+
+def test_tuner_restore_restart_errored(rt_start, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.train.config import RunConfig
+
+    marker = str(tmp_path / "m2.marker")
+    run_dir = str(tmp_path / "exp2")
+    tuner = tune.Tuner(
+        _resumable_trainable,
+        param_space={"lr": 2.0, "marker": marker},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="hard", storage_path=run_dir),
+    )
+    tuner.fit()
+    open(marker, "w").close()
+    tuner2 = tune.Tuner.restore(f"{run_dir}/hard", _resumable_trainable, restart_errored=True)
+    grid = tuner2.fit()
+    (trial,) = grid._trials
+    assert trial.status == "TERMINATED"
+    iters = [m["iter_seen"] for m in trial.metrics_history]
+    assert iters[-1] == 5 and iters.count(0) >= 1  # restarted from scratch
