@@ -175,10 +175,14 @@ class Runtime:
                 # prestart) — overlaps the one-time forkserver boot with user
                 # setup code.
                 n = min(int(head.total_resources.get("CPU", 1)), 4)
-                threading.Thread(
-                    target=lambda: [head.start_worker() for _ in range(n)] if not self._stopped else None,
-                    daemon=True,
-                ).start()
+
+                def _prestart():
+                    for _ in range(n):
+                        if self._stopped:  # re-check: shutdown can race the warmup
+                            return
+                        head.start_worker()
+
+                threading.Thread(target=_prestart, daemon=True).start()
 
     # ------------------------------------------------------------------
     # cluster membership

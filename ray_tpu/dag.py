@@ -41,6 +41,16 @@ class DAGNode:
         memo = {"__inputs__": input_args}
         return self._execute_memo(memo)
 
+    def experimental_compile(self) -> "CompiledDAG":
+        """Compile this DAG for repeated execution (reference:
+        compiled_dag_node.py). Topology is validated and actors are
+        instantiated ONCE at compile time; each execute() then walks a
+        flat pre-ordered schedule. (Accelerator-tensor pipelines — the
+        reference's NCCL-channel use of compiled graphs — are the GSPMD
+        microbatch pipeline in ray_tpu.parallel.pipeline, which compiles
+        the whole schedule into one XLA program.)"""
+        return CompiledDAG(self)
+
     def _execute_impl(self, memo):  # pragma: no cover - abstract
         raise NotImplementedError
 
@@ -131,4 +141,86 @@ class ActorMethodNode(DAGNode):
         return getattr(self._handle, self._method).remote(*args, **kwargs)
 
 
+class CompiledDAG:
+    """Compiled execution over a validated DAG topology.
+
+    Compile time: walk the graph once, detect cycles, record a
+    dependency-ordered schedule, and instantiate every ClassNode's actor
+    (so replays reuse warm actors instead of re-creating them — the
+    driver-side analogue of the reference's one-time channel setup in
+    compiled_dag_node.py). Execute time: fill InputNodes positionally and
+    submit every node along the schedule in one pass; returns the leaf's
+    ObjectRef (or a list of them for MultiOutputNode leaves)."""
+
+    def __init__(self, leaf):
+        self._leaves = list(leaf) if isinstance(leaf, list) else [leaf]
+        self._schedule: list[DAGNode] = []
+        seen: dict[int, int] = {}  # id -> 0 visiting, 1 done
+        input_indices: set[int] = set()
+
+        def visit(node):
+            if not isinstance(node, DAGNode):
+                return
+            st = seen.get(id(node))
+            if st == 1:
+                return
+            if st == 0:
+                raise ValueError("cycle detected in DAG")
+            seen[id(node)] = 0
+            deps = list(node._bound_args) + list(node._bound_kwargs.values())
+            if isinstance(node, (ClassMethodNode,)):
+                deps.append(node._class_node)
+            for d in deps:
+                if isinstance(d, (list, tuple)):
+                    for x in d:
+                        visit(x)
+                elif isinstance(d, dict):
+                    for x in d.values():
+                        visit(x)
+                else:
+                    visit(d)
+            if isinstance(node, InputNode):
+                input_indices.add(node.index)
+            seen[id(node)] = 1
+            self._schedule.append(node)
+
+        for lf in self._leaves:
+            visit(lf)
+        self.num_inputs = (max(input_indices) + 1) if input_indices else 0
+        # hoist actor creation: ClassNodes with static (non-DAG) args are
+        # instantiated now; their handles persist across execute() calls
+        boot_memo: dict = {"__inputs__": ()}
+        for node in self._schedule:
+            if isinstance(node, ClassNode) and not any(
+                isinstance(a, DAGNode) for a in list(node._bound_args) + list(node._bound_kwargs.values())
+            ):
+                node._execute_memo(boot_memo)
+
+    def execute(self, *input_args):
+        if len(input_args) < self.num_inputs:
+            raise ValueError(f"compiled DAG takes {self.num_inputs} inputs, got {len(input_args)}")
+        memo = {"__inputs__": input_args}
+        for node in self._schedule:
+            node._execute_memo(memo)
+        outs = [memo[id(lf)] for lf in self._leaves]
+        return outs if len(outs) > 1 else outs[0]
+
+    def teardown(self):
+        """Kill compile-time actors (reference: CompiledDAG.teardown)."""
+        import ray_tpu
+
+        for node in self._schedule:
+            if isinstance(node, ClassNode) and node._handle is not None:
+                try:
+                    ray_tpu.kill(node._handle)
+                except Exception:
+                    pass
+                node._handle = None
+
+
 MultiOutputNode = list  # reference API alias: wraps several leaf nodes
+
+
+def compile_dag(leaf_or_leaves) -> CompiledDAG:
+    """Compile a DAG leaf (or MultiOutputNode list of leaves)."""
+    return CompiledDAG(leaf_or_leaves)

@@ -1,0 +1,146 @@
+"""Dashboard: HTTP observability endpoint for a running cluster.
+
+Reference parity: python/ray/dashboard/ (aiohttp app serving cluster
+state, jobs, metrics to the UI) — collapsed to a threaded stdlib HTTP
+server over the head's live registries:
+
+  GET /                 tiny auto-refreshing HTML overview
+  GET /api/cluster      `ray status`-shaped summary
+  GET /api/nodes        node table
+  GET /api/actors       actor table
+  GET /api/tasks        task-state summary
+  GET /api/pgs          placement groups
+  GET /api/jobs         submitted jobs
+  GET /api/objects      object store stats
+  GET /metrics          Prometheus text exposition
+
+    from ray_tpu.dashboard import start_dashboard
+    dash = start_dashboard(port=8265)   # 0 = ephemeral port
+    dash.url
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;background:#fafafa;color:#222}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
+ table{border-collapse:collapse;margin-top:.5rem} td,th{border:1px solid #ddd;padding:.3rem .6rem;font-size:.85rem;text-align:left}
+ code{background:#eee;padding:0 .3rem}
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="summary"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<script>
+async function j(p){const r=await fetch(p);return r.json()}
+function esc(v){const d=document.createElement('div');d.textContent=String(v);return d.innerHTML}
+function row(cells,tag){return '<tr>'+cells.map(c=>`<${tag}>${esc(c)}</${tag}>`).join('')+'</tr>'}
+function fill(id, header, rows){
+  document.getElementById(id).innerHTML = row(header,'th') + rows.map(r=>row(r,'td')).join('')
+}
+async function refresh(){
+  const c = await j('/api/cluster');
+  document.getElementById('summary').innerHTML =
+    `<p>Cluster: <code>${esc(JSON.stringify(c.cluster_resources))}</code> ·
+      available <code>${esc(JSON.stringify(c.available_resources))}</code> ·
+      pending demand: ${c.pending_demand.length}</p>`;
+  fill('nodes', ['node','alive','workers','total','available'],
+    c.nodes.map(n=>[n.node_id.slice(0,12), n.alive, n.num_workers,
+                    JSON.stringify(n.resources), JSON.stringify(n.available)]));
+  const a = await j('/api/actors');
+  fill('actors', ['actor','name','state','class','restarts'],
+    a.map(x=>[x.actor_id.slice(0,12), x.name||'', x.state, x['class'], x.num_restarts]));
+  const jobs = await j('/api/jobs');
+  fill('jobs', ['job','status','entrypoint','returncode'],
+    jobs.map(x=>[x.job_id, x.status, x.entrypoint, x.returncode ?? '']));
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class Dashboard:
+    def __init__(self, client=None, host: str = "127.0.0.1", port: int = 8265):
+        from ray_tpu.core import context
+
+        self.client = client or context.get_client()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence request logging
+                pass
+
+            def _send(self, body: bytes, ctype: str, code: int = 200):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, obj, code: int = 200):
+                self._send(json.dumps(obj, default=str).encode(), "application/json", code)
+
+            def do_GET(self):
+                c = outer.client
+                try:
+                    path = self.path.split("?")[0].rstrip("/") or "/"
+                    if path == "/":
+                        self._send(_PAGE.encode(), "text/html")
+                    elif path == "/api/cluster":
+                        from ray_tpu.util.state import cluster_status
+
+                        self._json(cluster_status(c))
+                    elif path == "/api/nodes":
+                        self._json(c.cluster_info("nodes"))
+                    elif path == "/api/actors":
+                        self._json(c.cluster_info("actors"))
+                    elif path == "/api/tasks":
+                        self._json(c.cluster_info("tasks"))
+                    elif path == "/api/pgs":
+                        self._json(c.cluster_info("placement_groups"))
+                    elif path == "/api/objects":
+                        self._json(c.cluster_info("objects"))
+                    elif path == "/api/jobs":
+                        from dataclasses import asdict
+
+                        from ray_tpu.job.job_manager import _default_manager
+
+                        jobs = _default_manager.list_jobs() if _default_manager else []
+                        self._json([asdict(j) for j in jobs])
+                    elif path == "/metrics":
+                        from ray_tpu.util.metrics import export_prometheus
+
+                        self._send(export_prometheus(c).encode(), "text/plain; version=0.0.4")
+                    else:
+                        self._json({"error": "not found"}, 404)
+                except Exception as e:  # noqa: BLE001
+                    self._json({"error": str(e)}, 500)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True, name="rt-dashboard")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def start_dashboard(port: int = 8265, host: str = "127.0.0.1", client=None) -> Dashboard:
+    return Dashboard(client=client, host=host, port=port).start()

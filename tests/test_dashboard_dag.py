@@ -1,0 +1,105 @@
+"""Dashboard HTTP endpoints + compiled DAG execution tests."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+def test_dashboard_endpoints(rt_start):
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    a = Pinger.options(name="dash_actor").remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+
+    from ray_tpu.util import metrics
+
+    metrics.Counter("dash_hits_total").inc(3.0)
+
+    dash = start_dashboard(port=0)
+    try:
+        def get(path):
+            with urllib.request.urlopen(f"{dash.url}{path}", timeout=10) as r:
+                return r.read()
+
+        cluster = json.loads(get("/api/cluster"))
+        assert cluster["cluster_resources"].get("CPU", 0) > 0
+        nodes = json.loads(get("/api/nodes"))
+        assert nodes and nodes[0]["alive"]
+        actors = json.loads(get("/api/actors"))
+        assert any(x["name"] == "dash_actor" for x in actors)
+        page = get("/").decode()
+        assert "ray_tpu dashboard" in page
+        prom = get("/metrics").decode()
+        assert "dash_hits_total 3" in prom
+        assert isinstance(json.loads(get("/api/jobs")), list)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            get("/api/nope")
+        assert exc.value.code == 404
+    finally:
+        dash.stop()
+
+
+def test_compiled_dag_matches_lazy_execution(rt_start):
+    from ray_tpu.dag import InputNode, MultiOutputNode
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    with InputNode() as x:
+        s = add.bind(x, 10)
+        p = mul.bind(s, 2)
+
+    assert ray_tpu.get(p.execute(5)) == 30  # lazy path
+    compiled = p.experimental_compile()
+    assert ray_tpu.get(compiled.execute(5)) == 30
+    assert ray_tpu.get(compiled.execute(7)) == 34  # reusable
+
+
+def test_compiled_dag_actor_reuse_and_teardown(rt_start):
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Stateful:
+        def __init__(self):
+            self.calls = 0
+
+        def bump(self, x):
+            self.calls += 1
+            return self.calls * 100 + x
+
+    with InputNode() as x:
+        node = Stateful.bind()
+        out = node.bump.bind(x)
+
+    compiled = out.experimental_compile()
+    # the SAME actor serves every execute: state accumulates
+    assert ray_tpu.get(compiled.execute(1)) == 101
+    assert ray_tpu.get(compiled.execute(2)) == 202
+    compiled.teardown()
+
+    # multi-output leaves
+    @ray_tpu.remote
+    def neg(v):
+        return -v
+
+    with InputNode() as x:
+        a = neg.bind(x)
+        b = neg.bind(a)
+    from ray_tpu.dag import compile_dag
+
+    refs = compile_dag([a, b]).execute(4)
+    assert [ray_tpu.get(r) for r in refs] == [-4, 4]

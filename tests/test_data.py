@@ -180,3 +180,24 @@ def test_tensor_columns(rt_start):
     assert b["data"].shape == (6, 4)
     out = ds.map_batches(lambda x: {"data": x["data"] * 2}).take_batch(6)
     np.testing.assert_allclose(out["data"], arr * 2)
+
+
+def test_op_budget_resource_aware(rt_start):
+    """Backpressure windows derive from CPUs and observed block sizes —
+    big blocks shrink the in-flight window (reference:
+    streaming_executor_state.py resource limits)."""
+    from ray_tpu.data.executor import OpBudget
+
+    b = OpBudget(num_cpus_per_task=1.0, num_stages=2)
+    w0 = b.window
+    assert OpBudget.MIN_WINDOW <= w0 <= OpBudget.MAX_WINDOW
+    # simulate huge observed blocks: memory constraint must bind
+    b._block_bytes_sum = b._mem_budget * 10
+    b._block_count = 1
+    assert b.window == OpBudget.MIN_WINDOW
+    # explicit user concurrency always wins
+    assert OpBudget(explicit=7).window == 7
+    # cpu-bound: tiny blocks leave the cpu cap in charge
+    b2 = OpBudget(num_cpus_per_task=1.0)
+    b2._block_bytes_sum, b2._block_count = 1024, 1
+    assert b2.window == b2._cpu_cap or b2.window == OpBudget.MAX_WINDOW
