@@ -106,6 +106,43 @@ class Dataset:
             all_tasks += o._materialized_tasks()
         return Dataset(all_tasks)
 
+    def join(self, other: "Dataset", on, how: str = "inner", *, num_partitions: int | None = None) -> "Dataset":
+        """Distributed hash-shuffle join (reference: ray.data Dataset.join
+        backed by hash shuffling). Both sides are partitioned on the key
+        columns with the native row hasher (_native/hashing.cpp — FNV-1a
+        over raw Arrow string buffers, splitmix64 for numerics), aligned
+        buckets are joined with Arrow's join kernel in parallel tasks.
+
+        how: inner | left | right | outer (plus arrow's full names)."""
+        from ray_tpu.data.executor import _hash_partition_block, _join_buckets
+
+        from ray_tpu._native import MAX_PARTITIONS
+
+        on = [on] if isinstance(on, str) else list(on)
+        left_refs = list(self._ref_stream())
+        right_refs = list(other._ref_stream())
+        if not left_refs or not right_refs:
+            # an empty side has no schema to join against: inner joins are
+            # empty by definition; outer joins cannot synthesize the
+            # missing side's columns
+            if how == "inner":
+                return MaterializedDataset([])
+            raise ValueError(
+                f"{how} join with an empty-side dataset is unsupported: the "
+                "empty side has no schema to pad from"
+            )
+        P = min(num_partitions or max(len(left_refs), len(right_refs), 2), MAX_PARTITIONS)
+        lparts = [_hash_partition_block.options(num_returns=P).remote(r, on, P) for r in left_refs]
+        rparts = [_hash_partition_block.options(num_returns=P).remote(r, on, P) for r in right_refs]
+        if P == 1:
+            lparts = [[p] for p in lparts]
+            rparts = [[p] for p in rparts]
+        out = [
+            _join_buckets.remote(how, on, len(lparts), *[lp[i] for lp in lparts], *[rp[i] for rp in rparts])
+            for i in builtins.range(P)
+        ]
+        return MaterializedDataset(out)
+
     def zip(self, other: "Dataset") -> "Dataset":
         left = self.materialize()
         right = other.materialize()

@@ -151,6 +151,44 @@ def _sort_block(block: Block, key: str, descending: bool) -> Block:
 
 
 @ray_tpu.remote
+def _hash_partition_block(block: Block, keys: list, n: int) -> list[Block]:
+    """Split one block into n buckets by key hash (native kernels:
+    _native/hashing.cpp; numpy fallback when no compiler)."""
+    from ray_tpu._native import combine_hashes, hash_column, partition_indices
+
+    acc = BlockAccessor(block)
+    if acc.num_rows() == 0:
+        return [block] * n if n > 1 else block
+    h = hash_column(block.column(keys[0]))
+    for k in keys[1:]:
+        h = combine_hashes(h, hash_column(block.column(k)))
+    idx, counts = partition_indices(h, n)
+    out, start = [], 0
+    for c in counts:
+        out.append(acc.take_indices(idx[start : start + int(c)]))
+        start += int(c)
+    return out if n > 1 else out[0]
+
+
+_ARROW_JOIN_TYPES = {
+    "inner": "inner",
+    "left": "left outer",
+    "right": "right outer",
+    "outer": "full outer",
+    "full": "full outer",
+}
+
+
+@ray_tpu.remote
+def _join_buckets(how: str, keys: list, n_left: int, *blocks: Block) -> Block:
+    """Join one aligned bucket pair: blocks[:n_left] vs blocks[n_left:]."""
+    left = BlockAccessor.concat(list(blocks[:n_left]))
+    right = BlockAccessor.concat(list(blocks[n_left:]))
+    join_type = _ARROW_JOIN_TYPES.get(how, how)
+    return left.join(right, keys=keys, join_type=join_type)
+
+
+@ray_tpu.remote
 def _sample_block(block: Block, key: str, k: int):
     acc = BlockAccessor(block)
     col = acc.to_numpy([key])[key]
