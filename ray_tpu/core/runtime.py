@@ -113,7 +113,9 @@ class Runtime:
         self.gcs = Gcs()
         self.task_manager = TaskManager(self)
         self.scheduler = Scheduler(self)
-        self._nodes_lock = threading.RLock()
+        from ray_tpu.core.lock_sanitizer import make_lock
+
+        self._nodes_lock = make_lock("runtime.nodes")
         self.nodes: dict[NodeID, Node] = {}
         self.actors: dict[ActorID, ActorState] = {}
         self.placement_groups: dict[PlacementGroupID, PlacementGroupState] = {}

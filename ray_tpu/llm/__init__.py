@@ -3,7 +3,8 @@
 Replaces the reference's vLLM-wrapping `ray.llm` (python/ray/llm/) with a
 jit-native continuous-batching engine: slot KV cache, bucketed prefill,
 single compiled decode program (see engine.py / model_runner.py /
-kv_cache.py). Serve integration lives in ray_tpu.serve.llm.
+kv_cache.py). Serve integration (batched LLM deployments with
+autoscaling replicas) lives in ray_tpu.serve.llm.
 """
 
 from ray_tpu.llm.engine import LLMEngine, RequestOutput

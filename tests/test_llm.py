@@ -132,3 +132,30 @@ def test_prefill_bucketing_no_recompile_per_length(params):
     o2 = eng.generate([1, 2, 3, 4, 5], SamplingParams(max_tokens=3))
     assert o1.token_ids == full_forward_greedy(params, [1, 2, 3], 3)
     assert o2.token_ids == full_forward_greedy(params, [1, 2, 3, 4, 5], 3)
+
+
+def test_serve_llm_deployment_batches_concurrent_requests(rt_start):
+    """BASELINE config #4 shape: Serve replicas wrap the engine; concurrent
+    requests interleave in one continuous batch per replica."""
+    from ray_tpu import serve
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.serve.llm import LLMConfig, build_llm_deployment
+
+    app = build_llm_deployment(
+        LLMConfig(
+            model_config=LlamaConfig.tiny(dtype="float32"),
+            engine_kwargs={"max_num_seqs": 4, "max_seq_len": 128},
+            max_ongoing_requests=8,
+        )
+    )
+    h = serve.run(app, name="llm_app")
+    try:
+        refs = [
+            h.generate.remote([1 + i, 2, 3], {"max_tokens": 12, "seed": i}) for i in range(4)
+        ]
+        outs = [r.result(timeout_s=120) for r in refs]
+        assert all(len(o["token_ids"]) == 12 and o["finish_reason"] == "length" for o in outs)
+        stats = h.batch_stats.remote().result()
+        assert stats["running"] == 0 and stats["waiting"] == 0
+    finally:
+        serve.shutdown()
