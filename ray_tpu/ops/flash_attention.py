@@ -20,6 +20,13 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
+def _default_blocks(head_dim: int) -> tuple[int, int]:
+    """Flash tile sizes: 1024x1024 measured fastest on v5e for hd<=128
+    (0.595 vs 0.568 MFU at 512x512 on the bench model); larger head dims
+    fall back to 512 to stay inside VMEM."""
+    return (1024, 1024) if head_dim <= 128 else (512, 512)
+
+
 # ----------------------------------------------------------------------
 # reference / fallback implementation (XLA; used on CPU)
 # ----------------------------------------------------------------------
@@ -95,7 +102,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k"))
-def _fwd_pallas(q, k, v, causal=True, scale=None, block_q=512, block_k=512):
+def _fwd_pallas(q, k, v, causal=True, scale=None, block_q=None, block_k=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -103,8 +110,9 @@ def _fwd_pallas(q, k, v, causal=True, scale=None, block_q=512, block_k=512):
     Tk = k.shape[2]
     if scale is None:
         scale = D**-0.5
-    block_q = min(block_q, T)
-    block_k = min(block_k, Tk)
+    dq, dk = _default_blocks(D)
+    block_q = min(block_q or dq, T)
+    block_k = min(block_k or dk, Tk)
     grid = (B * H, pl.cdiv(T, block_q), pl.cdiv(Tk, block_k))
     qs, ks, vs = (x.reshape(B * H, x.shape[2], D) for x in (q, k, v))
 
@@ -224,13 +232,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k"))
-def _bwd_pallas(q, k, v, o, lse, g, causal=True, scale=None, block_q=512, block_k=512):
+def _bwd_pallas(q, k, v, o, lse, g, causal=True, scale=None, block_q=None, block_k=None):
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     return _bwd_pallas_with_delta(q, k, v, g, lse, delta, causal=causal, scale=scale, block_q=block_q, block_k=block_k)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k"))
-def _bwd_pallas_with_delta(q, k, v, g, lse, delta, causal=True, scale=None, block_q=512, block_k=512):
+def _bwd_pallas_with_delta(q, k, v, g, lse, delta, causal=True, scale=None, block_q=None, block_k=None):
     """Backward kernels with a caller-supplied delta = sum(dO * O, -1).
 
     Ring attention computes delta once from the globally-merged output and
@@ -243,8 +251,9 @@ def _bwd_pallas_with_delta(q, k, v, g, lse, delta, causal=True, scale=None, bloc
     Tk = k.shape[2]
     if scale is None:
         scale = D**-0.5
-    block_q = min(block_q, T)
-    block_k = min(block_k, Tk)
+    dbq, dbk = _default_blocks(D)
+    block_q = min(block_q or dbq, T)
+    block_k = min(block_k or dbk, Tk)
     qs, ks, vs, dos = (x.reshape(B * H, x.shape[2], D) for x in (q, k, v, g))
     lse3 = lse.reshape(B * H, 1, T)
     delta = delta.reshape(B * H, 1, T)
