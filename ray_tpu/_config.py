@@ -73,6 +73,11 @@ class Config:
     state_dump_interval_s: float = 2.0
     # Stream worker log files back to the driver tty (log_monitor.py).
     log_to_driver: bool = True
+    # --- reference counting (reference: reference_counter.h) ---
+    # Free store entries once no process holds a ref and no live task spec
+    # pins them as an argument. RT_OBJECT_REF_COUNTING=0 disables.
+    object_ref_counting: bool = True
+    ref_counting_interval_s: float = 0.2
     # --- memory protection (reference: memory_monitor.h,
     # worker_killing_policy.h) ---
     memory_monitor_refresh_ms: int = 250  # 0 disables

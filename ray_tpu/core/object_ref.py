@@ -6,6 +6,8 @@ ObjectRefGenerator (streaming returns, _raylet.pyx:1067).
 
 from __future__ import annotations
 
+import threading
+
 from ray_tpu.core.ids import ObjectID
 
 
@@ -15,12 +17,89 @@ def _client():
     return get_client()
 
 
+# ----------------------------------------------------------------------
+# per-process reference counting (reference: reference_counter.h — local
+# counts per process; 0->1 / 1->0 transitions flow to the owner/head)
+# ----------------------------------------------------------------------
+_rc_lock = threading.Lock()
+_rc_counts: dict[bytes, int] = {}
+_rc_events: list[tuple[bytes, bool]] = []  # (id, True=register / False=release)
+_rc_enabled = True
+_ref_sink = threading.local()  # active serialization sinks (serialize())
+
+
+def set_ref_counting(enabled: bool):
+    global _rc_enabled
+    _rc_enabled = enabled
+
+
+def push_ref_sink(sink: list):
+    stack = getattr(_ref_sink, "stack", None)
+    if stack is None:
+        stack = _ref_sink.stack = []
+    stack.append(sink)
+    return len(stack) - 1
+
+
+def pop_ref_sink(token: int):
+    stack = getattr(_ref_sink, "stack", None)
+    if stack and len(stack) - 1 == token:
+        stack.pop()
+
+
+def _incref(obj_id: ObjectID):
+    if not _rc_enabled:
+        return
+    try:
+        k = obj_id.binary()
+        with _rc_lock:
+            c = _rc_counts.get(k, 0)
+            _rc_counts[k] = c + 1
+            if c == 0:
+                _rc_events.append((k, True))
+    except Exception:
+        pass
+
+
+def _decref(obj_id: ObjectID):
+    if not _rc_enabled:
+        return
+    try:
+        k = obj_id.binary()
+        with _rc_lock:
+            c = _rc_counts.get(k)
+            if c is None:
+                return
+            if c <= 1:
+                del _rc_counts[k]
+                _rc_events.append((k, False))
+            else:
+                _rc_counts[k] = c - 1
+    except Exception:
+        pass  # interpreter teardown
+
+
+def drain_ref_events() -> list[tuple[bytes, bool]]:
+    with _rc_lock:
+        ev, _rc_events[:] = list(_rc_events), []
+        return ev
+
+
+def local_ref_count(obj_id: ObjectID) -> int:
+    with _rc_lock:
+        return _rc_counts.get(obj_id.binary(), 0)
+
+
 class ObjectRef:
-    __slots__ = ("id", "_owner_hint")
+    __slots__ = ("id", "_owner_hint", "__weakref__")
 
     def __init__(self, obj_id: ObjectID, owner_hint: str | None = None):
         self.id = obj_id
         self._owner_hint = owner_hint
+        _incref(obj_id)
+
+    def __del__(self):
+        _decref(self.id)
 
     def hex(self) -> str:
         return self.id.hex()
@@ -67,9 +146,14 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()[:16]})"
 
     def __reduce__(self):
-        # Refs crossing a process boundary are borrowed; the runtime adds the
-        # borrow when deserializing task args (reference:
+        # Refs crossing a process boundary are borrowed: the receiving
+        # process's __init__ registers its local count, and an active
+        # serialization sink (serialize()) records the ref so the carrying
+        # container/message pins it meanwhile (reference:
         # reference_counter.h borrow protocol).
+        stack = getattr(_ref_sink, "stack", None)
+        if stack:
+            stack[-1].append(self.id)
         return (ObjectRef, (self.id, self._owner_hint))
 
 

@@ -41,12 +41,18 @@ class ShmDescriptor:
 @dataclass
 class StoredObject:
     """An entry in the owner's store: either inline data or an shm locator,
-    or an error to raise at get()."""
+    or an error to raise at get().
+
+    `contained_refs` holds live ObjectRef objects pickled INSIDE this
+    value: the head's local ref count then keeps those inner objects
+    alive for exactly as long as the container entry exists (the store
+    side of the borrow protocol)."""
 
     value: Serialized | None = None
     shm: ShmDescriptor | None = None
     error: BaseException | None = None
     sealed_at: float = field(default_factory=time.monotonic)
+    contained_refs: list = field(default_factory=list)
 
     def size(self) -> int:
         if self.shm is not None:
@@ -186,9 +192,9 @@ class ObjectStore:
         thr = self.cfg.max_direct_call_object_size if inline_threshold is None else inline_threshold
         if s.total_size() > thr:
             desc = write_to_shm(obj_id, s)
-            entry = StoredObject(shm=desc)
+            entry = StoredObject(shm=desc, contained_refs=list(s.contained_refs))
         else:
-            entry = StoredObject(value=s)
+            entry = StoredObject(value=s, contained_refs=list(s.contained_refs))
         self.seal(obj_id, entry)
         return entry
 

@@ -19,13 +19,14 @@ def encode_value(value, obj_id: ObjectID | None = None, threshold: int | None = 
 def encode_serialized(s: Serialized, obj_id: ObjectID | None = None, threshold: int | None = None) -> Payload:
     if threshold is None:
         threshold = get_config().max_direct_call_object_size
+    contained = [r.id for r in s.contained_refs]
     if s.total_size() > threshold:
         if obj_id is None:
             obj_id = ObjectID.from_put()
         desc = write_to_shm(obj_id, s)
-        return Payload(shm=desc)
+        return Payload(shm=desc, contained=contained)
     # Pipe messages are pickled; make buffers picklable bytes.
-    return Payload(inline=Serialized(header=s.header, buffers=[bytes(b) for b in s.buffers]))
+    return Payload(inline=Serialized(header=s.header, buffers=[bytes(b) for b in s.buffers]), contained=contained)
 
 
 def decode_payload(p: Payload, zero_copy: bool = True):

@@ -126,6 +126,17 @@ class Runtime:
         self._local_fn_cache: dict[str, object] = {}
         self._done_callbacks: dict[ObjectID, list] = {}
         self._dc_lock = threading.Lock()
+        # reference counting (reference: reference_counter.h): remote
+        # holders per object + pins from live task specs' args. The head
+        # process's own refs are covered by object_ref's local registry.
+        self._ref_holders: dict[bytes, set[str]] = {}
+        self._arg_pins: dict[bytes, int] = {}
+        self._freed_ids: collections.deque = collections.deque(maxlen=65536)
+        self._freed_set: set = set()
+        self._rc_head_lock = threading.Lock()
+        from ray_tpu.core import object_ref as _oref_mod
+
+        _oref_mod.set_ref_counting(self.cfg.object_ref_counting)
         self._stopped = False
         self._worker_count_limit_extra = 4
         # Large pool: client RPCs like get_object block until the object is
@@ -162,6 +173,8 @@ class Runtime:
             self._sched_thread.start()
             self._health_thread = threading.Thread(target=self._health_loop, daemon=True, name="rt-health")
             self._health_thread.start()
+            if self.cfg.object_ref_counting:
+                threading.Thread(target=self._ref_gc_loop, daemon=True, name="rt-ref-gc").start()
             if self.cfg.state_dump_interval_s > 0:
                 threading.Thread(target=self._state_dump_loop, daemon=True, name="rt-state-dump").start()
             if self.cfg.log_to_driver:
@@ -253,10 +266,13 @@ class Runtime:
         return ObjectRef(obj_id)
 
     def put_payload(self, obj_id: ObjectID, payload: Payload):
+        # wrap contained ids as live refs on the entry: the head's local
+        # ref count then pins inner objects while the container lives
+        contained = [ObjectRef(c) for c in (payload.contained or [])]
         if payload.shm is not None:
-            self.store.seal(obj_id, StoredObject(shm=payload.shm))
+            self.store.seal(obj_id, StoredObject(shm=payload.shm, contained_refs=contained))
         else:
-            self.store.seal(obj_id, StoredObject(value=payload.inline))
+            self.store.seal(obj_id, StoredObject(value=payload.inline, contained_refs=contained))
 
     def get_object(self, obj_id: ObjectID, timeout: float | None = None, _depth: int = 0):
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -277,6 +293,13 @@ class Runtime:
 
     def _get_entry_reconstructing(self, obj_id, deadline):
         while True:
+            if obj_id in getattr(self, "_freed_set", ()):
+                from ray_tpu.exceptions import ObjectLostError
+
+                raise ObjectLostError(
+                    f"object {obj_id.hex()[:16]} was freed: every reference "
+                    "went out of scope (reference counting)"
+                )
             timeout = None if deadline is None else max(0.0, deadline - time.monotonic())
             if self.store.is_evicted(obj_id):
                 self.task_manager.reconstruct(obj_id)
@@ -1017,6 +1040,147 @@ class Runtime:
             except Exception:
                 pass
 
+    # ------------------------------------------------------------------
+    # reference-counted object GC (reference: reference_counter.h)
+    # ------------------------------------------------------------------
+    def _ref_gc_loop(self):
+        """Drain the head process's own 1->0 transitions and re-check any
+        object whose last known holder vanished."""
+        from ray_tpu.core.object_ref import drain_ref_events
+
+        while not self._stopped:
+            time.sleep(self.cfg.ref_counting_interval_s)
+            if self._stopped:
+                return
+            try:
+                for k, registered in drain_ref_events():
+                    if not registered:
+                        self._maybe_free_object(k)
+            except Exception:
+                logger.exception("ref gc loop error")
+
+    def on_ref_events(self, holder: str, events: list):
+        """A worker's batched 0->1 / 1->0 local-count transitions."""
+        to_check = []
+        with self._rc_head_lock:
+            for k, registered in events:
+                if registered:
+                    self._ref_holders.setdefault(k, set()).add(holder)
+                else:
+                    s = self._ref_holders.get(k)
+                    if s is not None:
+                        s.discard(holder)
+                        if not s:
+                            del self._ref_holders[k]
+                            to_check.append(k)
+        for k in to_check:
+            self._maybe_free_object(k)
+
+    def _drop_holder(self, holder: str):
+        """A worker process died: everything it held is released."""
+        to_check = []
+        with self._rc_head_lock:
+            for k, s in list(self._ref_holders.items()):
+                s.discard(holder)
+                if not s:
+                    del self._ref_holders[k]
+                    to_check.append(k)
+        for k in to_check:
+            self._maybe_free_object(k)
+
+    def pin_spec_args(self, spec: TaskSpec):
+        """Pin every object a live spec's args reference (top-level refs +
+        refs pickled inside payloads) — retries/lineage re-resolve them."""
+        if not self.cfg.object_ref_counting:
+            return
+        if getattr(spec, "_pinned_arg_ids", None) is not None:
+            return  # already pinned (actor restarts re-register the spec)
+        ids = set()
+        for a in list(spec.args) + list(getattr(spec, "_kwargs", {}).values()):
+            if a.ref is not None:
+                ids.add(a.ref.binary())
+            if a.payload is not None:
+                for c in a.payload.contained or []:
+                    ids.add(c.binary())
+        spec._pinned_arg_ids = ids
+        with self._rc_head_lock:
+            for k in ids:
+                self._arg_pins[k] = self._arg_pins.get(k, 0) + 1
+
+    def unpin_spec_args(self, spec: TaskSpec):
+        ids = getattr(spec, "_pinned_arg_ids", None)
+        if not ids:
+            return
+        spec._pinned_arg_ids = None
+        with self._rc_head_lock:
+            for k in ids:
+                n = self._arg_pins.get(k, 0) - 1
+                if n <= 0:
+                    self._arg_pins.pop(k, None)
+                else:
+                    self._arg_pins[k] = n
+        for k in ids:
+            self._maybe_free_object(k)
+
+    def _maybe_free_object(self, k: bytes):
+        """Free the store entry once NOTHING can reach it: no ref in any
+        process (head local count included — store containers hold live
+        refs there), no live spec pinning it as an argument."""
+        if self._stopped or not self.cfg.object_ref_counting:
+            return
+        if k.endswith(b"\xfe\xfe\xfe\xfe"):
+            return  # actor-ready sentinels are runtime-managed
+        from ray_tpu.core.object_ref import local_ref_count
+
+        oid = ObjectID(k)
+        if oid in self.generators:
+            return  # streaming generator state (incl. tombstones) manages these
+        with self._rc_head_lock:
+            # holder registrations serialize on this lock, and the local
+            # count is re-checked immediately before the delete — the
+            # remaining head-local incref window is the unavoidable
+            # distributed-GC race, shrunk to the delete call itself
+            if self._ref_holders.get(k) or self._arg_pins.get(k, 0) > 0:
+                return
+            if local_ref_count(oid) > 0:
+                return
+            entry = self.store.try_get_entry(oid)
+            if entry is not None:
+                self.store.delete(oid)
+                # a late get() of a freed id must error, not block forever
+                if len(self._freed_ids) == self._freed_ids.maxlen:
+                    self._freed_set.discard(self._freed_ids[0])
+                self._freed_ids.append(oid)
+                self._freed_set.add(oid)
+                # the entry's contained_refs die with it -> cascading
+                # releases surface on the next gc tick
+        # transitive lineage release: once ALL of a terminal task's outputs
+        # are unreachable, reconstruction can never run again, so the
+        # spec's argument pins release too (reference: lineage refcounting)
+        self._maybe_release_lineage(oid)
+
+    def _maybe_release_lineage(self, oid: ObjectID):
+        try:
+            tid = oid.task_id()
+        except Exception:
+            return
+        st = self.task_manager.get(tid)
+        if st is None or getattr(st.spec, "_pinned_arg_ids", None) is None:
+            return
+        from ray_tpu.core.task_manager import TERMINAL
+
+        if st.status not in TERMINAL:
+            return
+        from ray_tpu.core.object_ref import local_ref_count
+
+        for out_id in self._spec_return_ids(st.spec):
+            if self.store.contains(out_id) or local_ref_count(out_id) > 0:
+                return
+            with self._rc_head_lock:
+                if self._ref_holders.get(out_id.binary()):
+                    return
+        self.unpin_spec_args(st.spec)
+
     def _on_agent_death(self, node: Node):
         """A node agent went away: the whole node is dead (reference:
         gcs_health_check_manager.h:45 failure path)."""
@@ -1063,10 +1227,18 @@ class Runtime:
             self._on_stream_item(msg)
         elif t == "req":
             self._req_pool.submit(self._handle_client_req, w, msg)
+        elif t == "ref_events":
+            # ordered with this worker's done messages (same pipe)
+            self.on_ref_events(w.worker_id.hex(), [(bytes.fromhex(h), reg) for h, reg in msg["events"]])
         elif t == "pong":
             pass
 
     def _on_task_done(self, node: Node, w: WorkerHandle, msg: dict):
+        if msg.get("ref_events"):
+            # borrows registered BEFORE any pin release below
+            self.on_ref_events(
+                w.worker_id.hex(), [(bytes.fromhex(h), reg) for h, reg in msg["ref_events"]]
+            )
         task_id = msg["task_id"]
         entry = w.running_tasks.pop(task_id, None)
         if entry is None:
@@ -1195,6 +1367,7 @@ class Runtime:
     def _on_worker_death(self, node: Node, w: WorkerHandle, reason: str):
         if w.state == "dead" or self._stopped:
             return
+        self._drop_holder(w.worker_id.hex())
         if w.state == "retiring":
             self._finish_retirement(node, w)
             return
@@ -1252,6 +1425,8 @@ class Runtime:
         info = astate.info
         info.state = "DEAD"
         info.death_cause = cause
+        if info.creation_spec is not None:
+            self.unpin_spec_args(info.creation_spec)  # no more restarts
         # ready-ref waiters must observe the death (even if creation never ran)
         self.store.put_error(_actor_ready_oid(info.actor_id), ActorDiedError(info.actor_id, cause))
         for spec in inflight or []:
@@ -1550,7 +1725,9 @@ def _to_serialized(value) -> Serialized:
     from ray_tpu.core.serialization import serialize
 
     s = serialize(value)
-    return Serialized(header=s.header, buffers=[bytes(b) for b in s.buffers])
+    # contained_refs MUST survive: the store entry holding them is what
+    # keeps objects pickled inside this value alive (borrow protocol)
+    return Serialized(header=s.header, buffers=[bytes(b) for b in s.buffers], contained_refs=s.contained_refs)
 
 
 def _sched_options(opts: dict, is_actor: bool = False) -> SchedulingOptions:

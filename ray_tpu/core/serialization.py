@@ -30,6 +30,8 @@ class Serialized:
 
 
 def serialize(obj) -> Serialized:
+    from ray_tpu.core import object_ref as _oref
+
     buffers: list[pickle.PickleBuffer] = []
     contained: list = []
     _track_contained_refs(obj, contained)
@@ -38,7 +40,20 @@ def serialize(obj) -> Serialized:
         buffers.append(buf)
         return False  # out-of-band
 
-    header = cloudpickle.dumps(obj, protocol=5, buffer_callback=cb)
+    # pickle-time sink: ObjectRef.__reduce__ reports every ref actually
+    # serialized (incl. ones nested in arbitrary objects the pre-scan
+    # cannot see) — the union drives borrow/pin bookkeeping
+    sink: list = []
+    token = _oref.push_ref_sink(sink)
+    try:
+        header = cloudpickle.dumps(obj, protocol=5, buffer_callback=cb)
+    finally:
+        _oref.pop_ref_sink(token)
+    seen = {r.id.binary() for r in contained}
+    for oid in sink:
+        if oid.binary() not in seen:
+            seen.add(oid.binary())
+            contained.append(_oref.ObjectRef(oid))
     return Serialized(header=header, buffers=[b.raw() for b in buffers], contained_refs=contained)
 
 
@@ -51,9 +66,11 @@ def deserialize_s(s: Serialized) -> object:
 
 
 def _track_contained_refs(obj, out: list, depth: int = 0):
-    """Best-effort scan of containers for ObjectRefs (no recursion into
-    arbitrary objects — full tracking happens at pickle time via
-    ObjectRef.__reduce__ hooks registered by the runtime)."""
+    """Complete tracking happens at pickle time: ObjectRef.__reduce__
+    reports into the active serialization sink (see object_ref._REF_SINK),
+    catching refs nested inside arbitrary objects. This pre-scan remains
+    for the cheap shallow cases so contained_refs is populated even for
+    values that skip the sink path."""
     if depth > 3:
         return
     from ray_tpu.core.object_ref import ObjectRef

@@ -48,7 +48,9 @@ class TaskState:
 class TaskManager:
     def __init__(self, runtime):
         self.rt = runtime
-        self._lock = threading.Lock()
+        # RLock: pruning under the lock can cascade into lineage-release
+        # paths that consult task state again on the same thread
+        self._lock = threading.RLock()
         self._tasks: dict[TaskID, TaskState] = {}
         # lineage: object ids we may need to reconstruct keep their producing
         # spec alive via _tasks (keyed by ObjectID.task_id()). Bounded: old
@@ -60,6 +62,7 @@ class TaskManager:
 
     def register(self, spec: TaskSpec) -> TaskState:
         st = TaskState(spec)
+        self.rt.pin_spec_args(spec)  # args stay reachable while retryable
         with self._lock:
             self._tasks[spec.task_id] = st
             self._order.append(spec.task_id)
@@ -83,6 +86,10 @@ class TaskManager:
                     break  # everything is live
                 continue
             del self._tasks[tid]
+            # actor-creation specs outlive lineage pruning (restarts
+            # re-resolve their args); their pins release on actor death
+            if not st.spec.is_actor_creation:
+                self.rt.unpin_spec_args(st.spec)
             # reclaim anonymous shm segments backing by-value args
             for a in st.spec.args:
                 if a.payload is not None and a.payload.shm is not None:

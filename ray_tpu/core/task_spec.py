@@ -17,10 +17,13 @@ from ray_tpu.core.serialization import Serialized
 
 @dataclass
 class Payload:
-    """A serialized value in transit: inline bytes or an shm locator."""
+    """A serialized value in transit: inline bytes or an shm locator.
+    `contained`: ObjectIDs of any ObjectRefs pickled inside the value
+    (drives the borrow/pin bookkeeping of the reference counter)."""
 
     inline: Serialized | None = None
     shm: ShmDescriptor | None = None
+    contained: list = field(default_factory=list)
 
 
 @dataclass

@@ -19,6 +19,7 @@ import asyncio
 import inspect
 import os
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 
@@ -61,6 +62,21 @@ class WorkerClient:
         self._deferred_segs: list = []
 
     # ---------------- transport ----------------
+    def _send_done(self, msg: dict):
+        """Task-completion send: piggybacks this process's pending ref-count
+        transitions so the head registers borrows (refs deserialized during
+        the task) BEFORE it releases any argument pins — closing the race
+        between the async ref pump and pin release."""
+        from ray_tpu.core.object_ref import drain_ref_events
+
+        try:
+            events = drain_ref_events()
+            if events:
+                msg["ref_events"] = [(k.hex(), reg) for k, reg in events]
+        except Exception:
+            pass
+        self._send(msg)
+
     def _send(self, msg: dict):
         with self._send_lock:
             self.conn.send(msg)
@@ -227,7 +243,7 @@ class WorkerClient:
                 apply_runtime_env_in_worker(renv, lambda h: self.get_object(_OID.from_hex(h)))
             if spec.is_actor_creation:
                 self._create_actor_instance(spec, msg)
-                self._send({"type": "done", "task_id": spec.task_id, "returns": [], "error": None})
+                self._send_done({"type": "done", "task_id": spec.task_id, "returns": [], "error": None})
                 return
             if spec.actor_id is not None:
                 fn = self._actor_method(spec.method_name)
@@ -252,16 +268,16 @@ class WorkerClient:
             finally:
                 self._release_segments(segs)
                 del args, kwargs
-            self._send({"type": "done", "task_id": spec.task_id, "returns": returns, "error": None})
+            self._send_done({"type": "done", "task_id": spec.task_id, "returns": returns, "error": None})
         except BaseException as e:  # noqa: BLE001
             err = e if isinstance(e, TaskError) else TaskError.from_exception(e, task_desc=spec.desc())
             try:
-                self._send({"type": "done", "task_id": spec.task_id, "returns": [], "error": err})
+                self._send_done({"type": "done", "task_id": spec.task_id, "returns": [], "error": err})
             except Exception:
                 traceback.print_exc()
                 try:
                     fallback = TaskError(cause=None, tb_str=err.tb_str, task_desc=spec.desc())
-                    self._send({"type": "done", "task_id": spec.task_id, "returns": [], "error": fallback})
+                    self._send_done({"type": "done", "task_id": spec.task_id, "returns": [], "error": fallback})
                 except Exception:
                     pass
         finally:
@@ -298,11 +314,11 @@ class WorkerClient:
         def _cb(f):
             try:
                 returns = self._encode_returns(spec, f.result())
-                self._send({"type": "done", "task_id": spec.task_id, "returns": returns, "error": None})
+                self._send_done({"type": "done", "task_id": spec.task_id, "returns": returns, "error": None})
             except BaseException as e:  # noqa: BLE001
                 err = TaskError.from_exception(e, task_desc=spec.desc())
                 try:
-                    self._send({"type": "done", "task_id": spec.task_id, "returns": [], "error": err})
+                    self._send_done({"type": "done", "task_id": spec.task_id, "returns": [], "error": err})
                 except Exception:
                     pass
 
@@ -318,10 +334,10 @@ class WorkerClient:
                 payload = encode_value(item, obj_id=oid)
                 self._send({"type": "stream_item", "task_id": spec.task_id, "index": index, "obj_id": oid, "payload": payload})
                 index += 1
-            self._send({"type": "done", "task_id": spec.task_id, "returns": [], "error": None, "stream_count": index})
+            self._send_done({"type": "done", "task_id": spec.task_id, "returns": [], "error": None, "stream_count": index})
         except BaseException as e:  # noqa: BLE001
             err = TaskError.from_exception(e, task_desc=spec.desc())
-            self._send({"type": "done", "task_id": spec.task_id, "returns": [], "error": err, "stream_count": index})
+            self._send_done({"type": "done", "task_id": spec.task_id, "returns": [], "error": err, "stream_count": index})
 
     # -- actors --
     def _create_actor_instance(self, spec, msg):
@@ -370,7 +386,35 @@ class WorkerClient:
         return fut.result()
 
     # ---------------- main loop ----------------
+    def _ref_pump_loop(self):
+        """Flush this process's ref-count transitions to the head (the
+        borrow protocol's worker half; reference_counter.h)."""
+        from ray_tpu._config import get_config
+        from ray_tpu.core.object_ref import drain_ref_events
+
+        interval = max(0.05, get_config().ref_counting_interval_s)
+        while not self._shutdown:
+            time.sleep(interval)
+            try:
+                events = drain_ref_events()
+                if events:
+                    # one-way message on the worker pipe: FIFO with done
+                    # messages, so batches can never be applied out of
+                    # order relative to done-piggybacked borrows; a broken
+                    # pipe means worker death, where the head drops every
+                    # holder entry anyway
+                    self._send({"type": "ref_events", "events": [(k.hex(), reg) for k, reg in events]})
+            except Exception:
+                pass
+
     def run(self):
+        from ray_tpu._config import get_config
+        from ray_tpu.core.object_ref import set_ref_counting
+
+        if get_config().object_ref_counting:
+            threading.Thread(target=self._ref_pump_loop, daemon=True, name="rt-ref-pump").start()
+        else:
+            set_ref_counting(False)
         self._send({"type": "ready", "worker_id": self.worker_id, "pid": os.getpid()})
         while not self._shutdown:
             try:
