@@ -72,6 +72,9 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 1
+    # experiment-lifecycle hooks (ray_tpu.tune.callbacks; reference:
+    # air RunConfig.callbacks)
+    callbacks: list = field(default_factory=list)
 
     def __post_init__(self):
         if self.storage_path is None:

@@ -48,6 +48,18 @@ class TrainController:
         self.metrics_history: list[dict] = []
         self.resume_checkpoint = None  # user-provided seed; never evicted
         self._restarts = 0
+        # RunConfig.callbacks (tune.callbacks API): the whole run logs as
+        # one pseudo-trial keyed by the experiment name
+        self._callbacks = list(getattr(run_config, "callbacks", None) or [])
+        if self._callbacks:
+            from types import SimpleNamespace
+
+            self._cb_trial = SimpleNamespace(trial_id=run_config.name, config=dict(train_fn_config or {}))
+            for cb in self._callbacks:
+                try:
+                    cb.setup(self.run_dir)
+                except Exception:
+                    pass
 
     # ---------------- main entry ----------------
     def run(self) -> Result:
@@ -73,6 +85,7 @@ class TrainController:
 
                     cleanup_group_actor(group_name_for_attempt(self.run_config.name, group.attempt_uid))
             if error is None:
+                self._finish_callbacks()
                 latest = self.ckpt_manager.latest_checkpoint
                 return Result(
                     metrics=self.metrics_history[-1] if self.metrics_history else None,
@@ -169,6 +182,19 @@ class TrainController:
         metrics.setdefault("training_iteration", len(self.metrics_history) + 1)
         metrics["timestamp"] = time.time()
         self.metrics_history.append(metrics)
+        for cb in self._callbacks:
+            try:
+                cb.log_trial_result(self._cb_trial, metrics)
+            except Exception:
+                pass
+
+    def _finish_callbacks(self):
+        for cb in self._callbacks:
+            try:
+                cb.log_trial_end(self._cb_trial)
+                cb.on_experiment_end([self._cb_trial])
+            except Exception:
+                pass
 
     def _split_datasets(self, n: int):
         if not self.datasets:

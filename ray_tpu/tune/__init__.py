@@ -29,6 +29,14 @@ from ray_tpu.tune.search_space import (
     sample_from,
     uniform,
 )
+from ray_tpu.tune.callbacks import (  # noqa: F401
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    MLflowLoggerCallback,
+    TensorBoardLoggerCallback,
+    WandbLoggerCallback,
+)
 from ray_tpu.tune.tuner import TuneConfig, Tuner, run, with_parameters
 
 __all__ = [
@@ -45,6 +53,12 @@ __all__ = [
     "TrialScheduler",
     "TuneConfig",
     "Tuner",
+    "Callback",
+    "CSVLoggerCallback",
+    "JsonLoggerCallback",
+    "MLflowLoggerCallback",
+    "TensorBoardLoggerCallback",
+    "WandbLoggerCallback",
     "choice",
     "get_checkpoint",
     "grid_search",

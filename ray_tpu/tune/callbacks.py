@@ -1,0 +1,141 @@
+"""Tune/Train logger callbacks (reference: tune/logger/* + AIR
+integrations air/integrations/wandb.py, mlflow.py).
+
+File-based loggers work offline out of the box (JSON lines, CSV,
+TensorBoard via torch's SummaryWriter); network-backed integrations
+(wandb/mlflow) are gated imports with clear errors since this image has
+no egress.
+
+    run_config = RunConfig(callbacks=[JsonLoggerCallback(),
+                                      CSVLoggerCallback(),
+                                      TensorBoardLoggerCallback()])
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+
+
+class Callback:
+    """Experiment-lifecycle hooks (reference: tune/callback.py)."""
+
+    def setup(self, run_dir: str):
+        pass
+
+    def log_trial_result(self, trial, result: dict):
+        pass
+
+    def log_trial_end(self, trial):
+        pass
+
+    def on_experiment_end(self, trials: list):
+        pass
+
+
+class JsonLoggerCallback(Callback):
+    """result.json: one JSON line per reported result per trial
+    (reference: tune/logger/json.py)."""
+
+    def setup(self, run_dir: str):
+        self.run_dir = run_dir
+        self._files: dict[str, object] = {}
+
+    def _file(self, trial):
+        f = self._files.get(trial.trial_id)
+        if f is None:
+            d = os.path.join(self.run_dir, trial.trial_id)
+            os.makedirs(d, exist_ok=True)
+            f = self._files[trial.trial_id] = open(os.path.join(d, "result.json"), "a", buffering=1)
+        return f
+
+    def log_trial_result(self, trial, result: dict):
+        self._file(trial).write(json.dumps(result, default=str) + "\n")
+
+    def log_trial_end(self, trial):
+        f = self._files.pop(trial.trial_id, None)
+        if f is not None:
+            f.close()
+
+
+class CSVLoggerCallback(Callback):
+    """progress.csv per trial (reference: tune/logger/csv.py)."""
+
+    def setup(self, run_dir: str):
+        self.run_dir = run_dir
+        self._writers: dict[str, tuple] = {}
+
+    def log_trial_result(self, trial, result: dict):
+        entry = self._writers.get(trial.trial_id)
+        flat = {k: v for k, v in result.items() if not isinstance(v, (dict, list))}
+        if entry is None:
+            d = os.path.join(self.run_dir, trial.trial_id)
+            os.makedirs(d, exist_ok=True)
+            f = open(os.path.join(d, "progress.csv"), "a", buffering=1, newline="")
+            w = csv.DictWriter(f, fieldnames=sorted(flat))
+            w.writeheader()
+            entry = self._writers[trial.trial_id] = (f, w)
+        f, w = entry
+        w.writerow({k: flat.get(k, "") for k in w.fieldnames})
+
+    def log_trial_end(self, trial):
+        entry = self._writers.pop(trial.trial_id, None)
+        if entry is not None:
+            entry[0].close()
+
+
+class TensorBoardLoggerCallback(Callback):
+    """TB event files per trial via torch's SummaryWriter (offline; view
+    with tensorboard --logdir <run_dir>). Reference: tune/logger/
+    tensorboardx.py."""
+
+    def setup(self, run_dir: str):
+        self.run_dir = run_dir
+        self._writers: dict[str, object] = {}
+
+    def _writer(self, trial):
+        w = self._writers.get(trial.trial_id)
+        if w is None:
+            from torch.utils.tensorboard import SummaryWriter
+
+            w = self._writers[trial.trial_id] = SummaryWriter(
+                log_dir=os.path.join(self.run_dir, trial.trial_id)
+            )
+        return w
+
+    def log_trial_result(self, trial, result: dict):
+        w = self._writer(trial)
+        step = int(result.get("training_iteration", 0))
+        for k, v in result.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                w.add_scalar(k, v, global_step=step)
+
+    def log_trial_end(self, trial):
+        w = self._writers.pop(trial.trial_id, None)
+        if w is not None:
+            w.close()
+
+
+class WandbLoggerCallback(Callback):
+    """Gated: network-backed experiment tracking is not supported in this
+    deployment (zero egress) — raises unconditionally rather than ever
+    degrading into a silent no-op logger."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "WandbLoggerCallback is not supported in this deployment (no "
+            "egress). Use JsonLoggerCallback/CSVLoggerCallback/"
+            "TensorBoardLoggerCallback."
+        )
+
+
+class MLflowLoggerCallback(Callback):
+    """Gated like WandbLoggerCallback."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "MLflowLoggerCallback is not supported in this deployment (no "
+            "egress). Use JsonLoggerCallback/CSVLoggerCallback/"
+            "TensorBoardLoggerCallback."
+        )

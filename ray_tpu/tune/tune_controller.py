@@ -95,6 +95,7 @@ class TuneController:
         experiment_name: str,
         resources_per_trial: dict | None = None,
         max_failures_per_trial: int = 0,
+        callbacks: list | None = None,
     ):
         self.trainable = trainable
         self.searcher = searcher
@@ -114,6 +115,10 @@ class TuneController:
         self._exhausted = False
         self._dirty = False
         os.makedirs(run_dir, exist_ok=True)
+        self.callbacks = list(callbacks or [])
+        self._cb_warned: set = set()
+        for cb in self.callbacks:
+            cb.setup(run_dir)
 
     # ---------------- experiment snapshots ----------------
     # Reference: tune/execution/experiment_state.py — periodic experiment
@@ -174,6 +179,24 @@ class TuneController:
                 t.error = None
                 self._failures.pop(t.trial_id, None)
 
+    def _notify(self, method: str, *args):
+        """Dispatch one callback hook; a failing logger warns once instead
+        of silently eating every record or killing the experiment."""
+        import logging
+
+        for cb in self.callbacks:
+            try:
+                getattr(cb, method)(*args)
+            except Exception:
+                key = (type(cb).__name__, method)
+                if key not in self._cb_warned:
+                    self._cb_warned.add(key)
+                    logging.getLogger("ray_tpu.tune").warning(
+                        "callback %s.%s failed; suppressing further errors",
+                        *key,
+                        exc_info=True,
+                    )
+
     # ---------------- PBT hook ----------------
     def request_exploit(self, trial: Trial, donor: Trial, new_config: dict):
         trial.restore_config = new_config
@@ -196,6 +219,7 @@ class TuneController:
             if self._dirty:
                 self.save_snapshot()
         self.save_snapshot(force=True)
+        self._notify("on_experiment_end", self.trials)
         return self.trials
 
     def _maybe_launch(self) -> bool:
@@ -249,6 +273,7 @@ class TuneController:
         if trial.is_finished:
             self.searcher.on_trial_complete(trial.trial_id, result=trial.last_result, error=status == ERROR)
             self.scheduler.on_trial_complete(self, trial)
+            self._notify("log_trial_end", trial)
 
     def _resume_paused(self):
         for trial in self.trials:
@@ -316,6 +341,7 @@ class TuneController:
         trial.last_result = metrics
         trial.metrics_history.append(metrics)
         self._dirty = True
+        self._notify("log_trial_result", trial, metrics)
         return self.scheduler.on_trial_result(self, trial, metrics)
 
     def _finish_or_retry(self, trial: Trial):

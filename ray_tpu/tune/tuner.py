@@ -57,6 +57,7 @@ class Tuner:
         resume_errored: bool = False,
         restart_errored: bool = False,
         param_space: dict | None = None,
+        run_config=None,
     ) -> "Tuner":
         """Resume an experiment from its run_dir snapshot (reference:
         Tuner.restore + tune/execution/experiment_state.py). Live trials
@@ -70,10 +71,12 @@ class Tuner:
         snap_path = os.path.join(path, TuneController.SNAPSHOT_NAME)
         with open(snap_path, "rb") as f:
             state = cloudpickle.load(f)
-        run_config = RunConfig(
-            name=os.path.basename(os.path.normpath(path)),
-            storage_path=os.path.dirname(os.path.normpath(path)),
-        )
+        if run_config is None:
+            run_config = RunConfig()
+        # the experiment identity always comes from the snapshot path;
+        # everything else (callbacks, failure config) is re-suppliable
+        run_config.name = os.path.basename(os.path.normpath(path))
+        run_config.storage_path = os.path.dirname(os.path.normpath(path))
         tuner = cls(
             trainable,
             param_space=param_space,
@@ -114,6 +117,7 @@ class Tuner:
             experiment_name=self.run_config.name,
             resources_per_trial=resources,
             max_failures_per_trial=self.run_config.failure_config.max_failures,
+            callbacks=list(self.run_config.callbacks or []),
         )
         if self._restore_state is not None:
             controller.load_snapshot(self._restore_state, **self._restore_opts)

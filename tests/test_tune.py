@@ -196,3 +196,40 @@ def test_trial_failure_isolated(rt_start, tmp_path):
     ).fit()
     assert grid.num_errors == 1
     assert grid.get_best_result("v", "max").metrics["v"] == 3
+
+
+def test_logger_callbacks_write_files(rt_start, tmp_path):
+    """Json/CSV/TensorBoard callbacks produce per-trial artifacts
+    (reference: tune/logger/*, air integrations)."""
+    from ray_tpu import tune
+    from ray_tpu.train.config import RunConfig
+
+    def trainable(config):
+        from ray_tpu import train
+
+        for i in range(3):
+            train.report({"score": i * config["m"]})
+
+    cbs = [tune.JsonLoggerCallback(), tune.CSVLoggerCallback(), tune.TensorBoardLoggerCallback()]
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"m": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="cbexp", storage_path=str(tmp_path), callbacks=cbs),
+    )
+    grid = tuner.fit()
+    import glob
+    import json as _json
+
+    for t in grid._trials:
+        d = f"{tmp_path}/cbexp/{t.trial_id}"
+        lines = [_json.loads(x) for x in open(f"{d}/result.json")]
+        assert [r["score"] for r in lines] == [0.0, t.config["m"], 2 * t.config["m"]]
+        csv_body = open(f"{d}/progress.csv").read()
+        assert "score" in csv_body and csv_body.count("\n") == 4  # header + 3 rows
+        assert glob.glob(f"{d}/events.out.tfevents.*"), "no TB event file"
+
+    import pytest as _pytest
+
+    with _pytest.raises(NotImplementedError, match="Wandb"):
+        tune.WandbLoggerCallback()
