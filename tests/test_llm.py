@@ -148,7 +148,9 @@ def test_serve_llm_deployment_batches_concurrent_requests(rt_start):
             max_ongoing_requests=8,
         )
     )
-    h = serve.run(app, name="llm_app")
+    # engine construction + first jax compiles can exceed the default 60s
+    # readiness window when the suite runs under load
+    h = serve.run(app, name="llm_app", blocking_timeout_s=240.0)
     try:
         refs = [
             h.generate.remote([1 + i, 2, 3], {"max_tokens": 12, "seed": i}) for i in range(4)
