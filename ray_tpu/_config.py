@@ -47,6 +47,25 @@ class Config:
     # Use the C++ shared-memory store when the extension is built.
     use_native_object_store: bool = True
 
+    # --- transport / cross-node object plane ---
+    # Bind host for the head's agent listener (TCP) and transfer servers.
+    # 127.0.0.1 for single-host; 0.0.0.0 to accept cross-host `rt agent`
+    # joins (reference: gRPC server bind, rpc/grpc_server.h).
+    node_manager_host: str = "127.0.0.1"
+    # Give every added node its own shm namespace so all object movement
+    # crosses the transfer service, as it would between real hosts.
+    shm_isolation: bool = False
+    # Fixed agent-listener port (0 = ephemeral). A fixed port lets agents
+    # reconnect to a RESTARTED head (GCS fault tolerance; reference:
+    # gcs_server_port + raylet reconnect backoff).
+    node_manager_port: int = 0
+
+    # --- GCS persistence (reference: redis_store_client.h:126) ---
+    # Path of the append-only GCS table log; empty = in-memory only.
+    # With a path set, KV / job table / named+detached actors survive a
+    # head kill -9 and are re-hydrated by the next head.
+    gcs_persist_path: str = ""
+
     # --- scheduler ---
     # Pack onto busiest feasible node until its utilization crosses this
     # threshold, then spread (reference: scheduler_spread_threshold=0.5,

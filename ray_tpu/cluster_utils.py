@@ -35,12 +35,15 @@ class Cluster:
     def address(self) -> str:
         return "local://" + (self._rt.node_id.hex() if self._rt else "none")
 
-    def add_node(self, *, num_cpus: int = 1, num_tpus: int = 0, resources: dict | None = None, labels: dict | None = None, env: dict | None = None, remote: bool = True):
+    def add_node(self, *, num_cpus: int = 1, num_tpus: int = 0, resources: dict | None = None, labels: dict | None = None, env: dict | None = None, remote: bool = True, shm_isolation: bool | None = None):
+        """shm_isolation=True gives the node a private shm namespace: every
+        object crossing its boundary rides the TCP transfer service, like a
+        real second host (no same-host shm fast path)."""
         res = dict(resources or {})
         res.setdefault("CPU", float(num_cpus))
         if num_tpus:
             res["TPU"] = float(num_tpus)
-        return self._rt.add_node(res, labels=labels, env=env, remote=remote)
+        return self._rt.add_node(res, labels=labels, env=env, remote=remote, shm_isolation=shm_isolation)
 
     def remove_node(self, node, allow_graceful: bool = True):
         node_id = node.node_id if hasattr(node, "node_id") else node

@@ -249,6 +249,83 @@ class Node:
 # ----------------------------------------------------------------------
 # process-separated node: the node manager is a real OS daemon
 # ----------------------------------------------------------------------
+class AgentListener:
+    """Head-side TCP rendezvous for node agents (reference:
+    src/ray/rpc/grpc_server.h — the head's network server; here one
+    authkey-authenticated TCP listener that both head-spawned agents and
+    standalone cross-host agents dial into).
+
+    Spawned agents are matched to their waiting ``RemoteNode`` by node id;
+    hellos with unknown node ids go to ``on_join`` (standalone agents
+    started with ``rt agent --address`` on another host)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, authkey: bytes | None = None, on_join=None):
+        from multiprocessing import connection as mp_connection
+
+        self.authkey = authkey or __import__("os").urandom(16)
+        self._listener = mp_connection.Listener((host, port), "AF_INET", authkey=self.authkey)
+        self.address = self._listener.address  # (host, port)
+        self.on_join = on_join
+        self._pending: dict[str, list] = {}  # node_id_hex -> [Event, conn, hello]
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True, name="rt-agent-listener")
+        self._thread.start()
+
+    def expect(self, node_id_hex: str):
+        slot = [threading.Event(), None, None]
+        with self._lock:
+            self._pending[node_id_hex] = slot
+        return slot
+
+    def abandon(self, node_id_hex: str):
+        with self._lock:
+            self._pending.pop(node_id_hex, None)
+
+    def _accept_loop(self):
+        while not self._stopped:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError, Exception):
+                if self._stopped:
+                    return
+                continue
+            threading.Thread(target=self._handshake, args=(conn,), daemon=True).start()
+
+    def _handshake(self, conn):
+        try:
+            hello = conn.recv()
+        except Exception:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            return
+        if hello.get("type") != "agent_ready":
+            conn.close()
+            return
+        nid = hello.get("node_id")
+        with self._lock:
+            slot = self._pending.pop(nid, None)
+        if slot is not None:
+            slot[1], slot[2] = conn, hello
+            slot[0].set()
+        elif self.on_join is not None:
+            try:
+                self.on_join(conn, hello)
+            except Exception:
+                conn.close()
+        else:
+            conn.close()
+
+    def shutdown(self):
+        self._stopped = True
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+
+
 class _RemoteWorkerProc:
     """Liveness proxy for a worker owned by a node agent (the real
     process handle lives in the agent)."""
@@ -292,61 +369,21 @@ class _RemoteWorkerConn:
         pass
 
 
-class RemoteNode(Node):
+class AgentBackedNode(Node):
     """A node whose manager (worker pool, relays, health endpoint) runs in
-    a separate agent process — the process-separated raylet the round-1
-    review called for (reference: node_manager.h:133 as its own daemon,
-    health-checked per gcs_health_check_manager.h:45)."""
+    a separate agent process speaking the framed envelope protocol over TCP
+    — the process-separated raylet (reference: node_manager.h:133 as its
+    own daemon, health-checked per gcs_health_check_manager.h:45; transport
+    per rpc/grpc_server.h, here authkey-authenticated TCP)."""
 
     remote = True
+    agent_proc = None
 
-    def __init__(self, node_id, resources: dict, labels: dict | None = None, env: dict | None = None):
-        super().__init__(node_id, resources, labels=labels, env=env)
-        import os as _os
-
-        from multiprocessing import connection as mp_connection
-
-        from ray_tpu.core.node_agent import agent_entry
-
-        authkey = _os.urandom(16)
-        listener = mp_connection.Listener(None, "AF_UNIX", authkey=authkey)
-        ctx = _ctx()
-        self.agent_proc = ctx.Process(
-            target=agent_entry,
-            args=(listener.address, authkey, self.node_id.hex(), self.env, get_config().worker_start_method),
-            # non-daemon: the agent must be able to spawn worker children.
-            # Orphan safety comes from the socket: head exit -> EOF -> the
-            # agent shuts itself (and its workers) down.
-            daemon=False,
-            name=f"rt-agent-{self.node_id.hex()[:8]}",
-        )
-        with _suppress_child_main_import():
-            self.agent_proc.start()
-        # bounded accept: if the agent dies before connecting (import
-        # failure, OOM kill), add_node must raise, not hang forever
-        import socket as _socket
-
-        listener._listener._socket.settimeout(0.5)
-        deadline = time.monotonic() + 30.0
-        while True:
-            try:
-                self.agent_conn = listener.accept()
-                break
-            except (_socket.timeout, TimeoutError):
-                if not self.agent_proc.is_alive():
-                    listener.close()
-                    raise RuntimeError(
-                        f"node agent for {self.node_id.hex()[:8]} exited before connecting "
-                        f"(code {self.agent_proc.exitcode})"
-                    ) from None
-                if time.monotonic() > deadline:
-                    listener.close()
-                    self.agent_proc.terminate()
-                    raise RuntimeError("node agent never connected within 30s") from None
-        listener.close()
-        ready = self.agent_conn.recv()
-        assert ready.get("type") == "agent_ready", f"bad agent hello: {ready}"
-        self.agent_pid = ready["pid"]
+    def _attach(self, conn, hello: dict):
+        self.agent_conn = conn
+        self.agent_pid = hello["pid"]
+        self.transfer_addr = tuple(hello["transfer_addr"]) if hello.get("transfer_addr") else None
+        self.shm_ns = hello.get("ns", "")
         self._agent_send_lock = threading.Lock()
         self.last_pong = time.monotonic()
         self.ping_seq = 0
@@ -376,13 +413,82 @@ class RemoteNode(Node):
         with self._lock:
             self.workers.clear()
         self.agent_send({"type": "shutdown"})
-        try:
-            self.agent_proc.join(timeout=2.0)
-            if self.agent_proc.is_alive():
-                self.agent_proc.terminate()
-        except Exception:
-            pass
+        if self.agent_proc is not None:
+            try:
+                self.agent_proc.join(timeout=2.0)
+                if self.agent_proc.is_alive():
+                    self.agent_proc.terminate()
+            except Exception:
+                pass
         try:
             self.agent_conn.close()
         except Exception:
             pass
+
+
+class RemoteNode(AgentBackedNode):
+    """Agent spawned by the head on this machine; it dials back into the
+    head's AgentListener over TCP (the same path a cross-host agent takes,
+    so one transport covers both)."""
+
+    def __init__(
+        self,
+        node_id,
+        resources: dict,
+        labels: dict | None = None,
+        env: dict | None = None,
+        listener: AgentListener | None = None,
+        transfer_authkey: bytes = b"",
+    ):
+        super().__init__(node_id, resources, labels=labels, env=env)
+        from ray_tpu.core.node_agent import agent_entry
+
+        slot = listener.expect(self.node_id.hex())
+        ctx = _ctx()
+        self.agent_proc = ctx.Process(
+            target=agent_entry,
+            args=(
+                listener.address,
+                listener.authkey,
+                self.node_id.hex(),
+                self.env,
+                get_config().worker_start_method,
+                transfer_authkey,
+            ),
+            # non-daemon: the agent must be able to spawn worker children.
+            # Orphan safety comes from the socket: head exit -> EOF -> the
+            # agent shuts itself (and its workers) down.
+            daemon=False,
+            name=f"rt-agent-{self.node_id.hex()[:8]}",
+        )
+        with _suppress_child_main_import():
+            self.agent_proc.start()
+        # bounded wait: if the agent dies before connecting (import
+        # failure, OOM kill), add_node must raise, not hang forever
+        deadline = time.monotonic() + 30.0
+        while not slot[0].wait(timeout=0.5):
+            if not self.agent_proc.is_alive():
+                listener.abandon(self.node_id.hex())
+                raise RuntimeError(
+                    f"node agent for {self.node_id.hex()[:8]} exited before connecting "
+                    f"(code {self.agent_proc.exitcode})"
+                ) from None
+            if time.monotonic() > deadline:
+                listener.abandon(self.node_id.hex())
+                self.agent_proc.terminate()
+                raise RuntimeError("node agent never connected within 30s") from None
+        self._attach(slot[1], slot[2])
+
+
+class JoinedNode(AgentBackedNode):
+    """A node whose agent was started out-of-process (``rt agent
+    --address head:port`` — typically on another host) and joined through
+    the head's AgentListener. The head holds only the accepted socket; the
+    agent owns its process tree."""
+
+    def __init__(self, node_id, conn, hello: dict):
+        resources = dict(hello.get("resources") or {"CPU": 1.0})
+        labels = dict(hello.get("labels") or {})
+        labels.setdefault("ray_tpu.io/node-type", "joined")
+        super().__init__(node_id, resources, labels=labels, env=dict(hello.get("env") or {}))
+        self._attach(conn, hello)

@@ -1,10 +1,11 @@
 """Node agent: the per-node manager daemon, a separate OS process.
 
 Reference parity: src/ray/raylet/node_manager.h:133 (per-node raylet
-process) + src/ray/raylet/worker_pool.h:280 (local worker pool). The head
-talks to each agent over a framed AF_UNIX socket (the single-host stand-in
-for the reference's gRPC channel; the protocol is envelope-based so the
-transport can later move to TCP for true multi-host). The agent:
+process) + src/ray/raylet/worker_pool.h:280 (local worker pool). The agent
+dials the head's AgentListener over authkey-authenticated TCP (reference:
+rpc/grpc_server.h network channel) — the same path whether the agent is a
+child of the head on one machine or a standalone ``rt agent`` on another
+host. The agent:
 
 - spawns/kills worker processes on head request (the worker pool lives
   HERE, not in the head — a dead agent takes exactly its own node down);
@@ -12,20 +13,30 @@ transport can later move to TCP for true multi-host). The agent:
   them with worker ids;
 - detects worker death (pipe EOF / process exit) and reports it;
 - answers pings (the head's gcs_health_check_manager.h:45-style detector
-  declares the node dead after N missed pongs).
+  declares the node dead after N missed pongs);
+- runs the node's object transfer server and pulls foreign-namespace shm
+  segments for its workers (the raylet object-manager role: reference
+  object_manager/pull_manager.h:50, push_manager.h:28).
 
 Protocol (head -> agent):
   {"type": "start_worker", "wid": hex}
   {"type": "to_worker", "wid": hex, "data": frame}
   {"type": "kill_worker", "wid": hex}
   {"type": "ping", "seq": n}
+  {"type": "ns_addr", "ns": str, "addr": (host, port) | None}
+  {"type": "free_shm", "name": str}
   {"type": "shutdown"}
 Agent -> head:
-  {"type": "agent_ready", "pid": pid}
+  {"type": "agent_ready", "node_id": hex, "pid": pid,
+   "transfer_addr": (host, port), "ns": str, "resources": dict|None}
   {"type": "from_worker", "wid": hex, "data": frame}
   {"type": "worker_started", "wid": hex, "pid": pid}
   {"type": "worker_death", "wid": hex, "reason": str}
+  {"type": "resolve_ns", "ns": str}
   {"type": "pong", "seq": n}
+Worker -> agent (intercepted, everything else is relayed to the head):
+  {"type": "agent_req", "req_id": n, "method": "fetch_object",
+   "params": {"desc": ShmDescriptor}}  -> {"type": "resp", ...} on the pipe
 """
 
 from __future__ import annotations
@@ -36,12 +47,124 @@ import time
 from multiprocessing import connection as mp_connection
 
 
-def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_method: str):
-    """Main loop of the node-agent process."""
+class _NsResolver:
+    """ns -> transfer address cache, filled by asking the head (the owner
+    directory) once per namespace."""
+
+    def __init__(self, send_head):
+        self._send_head = send_head
+        self._lock = threading.Lock()
+        self._cache: dict[str, tuple | None] = {}
+        self._events: dict[str, threading.Event] = {}
+
+    def deliver(self, ns: str, addr):
+        with self._lock:
+            self._cache[ns] = tuple(addr) if addr else None
+            ev = self._events.pop(ns, None)
+        if ev:
+            ev.set()
+
+    def resolve(self, ns: str, timeout: float = 30.0):
+        with self._lock:
+            if ns in self._cache:
+                return self._cache[ns]
+            ev = self._events.get(ns)
+            if ev is None:
+                ev = self._events[ns] = threading.Event()
+                ask = True
+            else:
+                ask = False
+        if ask:
+            self._send_head({"type": "resolve_ns", "ns": ns})
+        ok = ev.wait(timeout)
+        with self._lock:
+            if not ok:
+                # reply lost: drop the pending event so the next resolve
+                # re-asks instead of stalling on a dead waiter forever
+                if self._events.get(ns) is ev:
+                    del self._events[ns]
+            return self._cache.get(ns)
+
+    def invalidate(self, ns: str):
+        with self._lock:
+            self._cache.pop(ns, None)
+
+
+class _FetchCache:
+    """Accounting for foreign segments pulled into this node's namespace;
+    evicts oldest pulls when over budget (a lost cache copy is re-pulled
+    or reconstructed — never authoritative)."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = budget_bytes
+        self._lock = threading.Lock()
+        self._entries: dict[str, int] = {}  # name -> size, insertion-ordered
+
+    def add(self, name: str, size: int):
+        with self._lock:
+            # refresh recency: re-adds move to the end so hot entries
+            # aren't the first eviction victims
+            self._entries.pop(name, None)
+            self._entries[name] = size
+            total = sum(self._entries.values())
+            victims = []
+            for n, s in list(self._entries.items()):
+                if total <= self.budget:
+                    break
+                if n == name:
+                    continue  # never evict the entry just installed
+                victims.append(n)
+                total -= s
+                del self._entries[n]
+        for n in victims:
+            try:
+                os.unlink("/dev/shm/" + n)
+            except OSError:
+                pass
+
+    def drop(self, name: str):
+        with self._lock:
+            self._entries.pop(name, None)
+
+
+def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_method: str, transfer_authkey: bytes = b"", resources: dict | None = None):
+    """Main loop of the node-agent process. ``resources`` is only sent in
+    the hello for standalone (joined) agents, where the head has no prior
+    record of the node."""
     import multiprocessing as mp
 
-    conn = mp_connection.Client(address, authkey=authkey)
-    conn.send({"type": "agent_ready", "pid": os.getpid()})
+    if env:
+        os.environ.update({k: str(v) for k, v in env.items()})
+
+    from ray_tpu._config import get_config
+    from ray_tpu.core import transport
+    from ray_tpu.core.object_store import _session_tag, local_shm_name
+
+    my_ns = _session_tag()
+
+    conn = mp_connection.Client(tuple(address) if isinstance(address, (list, tuple)) else address, authkey=authkey)
+    # advertise the interface we reach the head on: that address is what
+    # other nodes (and the head) can dial for object pulls
+    import socket as _socket
+
+    try:
+        _s = _socket.socket(fileno=os.dup(conn.fileno()))
+        my_ip = _s.getsockname()[0]
+        _s.close()
+    except OSError:
+        my_ip = "127.0.0.1"
+    transfer_srv = transport.ObjectTransferServer(transfer_authkey, advertise_host=my_ip)
+    conn.send(
+        {
+            "type": "agent_ready",
+            "node_id": node_id_hex,
+            "pid": os.getpid(),
+            "transfer_addr": transfer_srv.address,
+            "ns": my_ns,
+            "resources": resources,
+            "labels": None,
+        }
+    )
 
     if start_method == "forkserver":
         ctx = mp.get_context("forkserver")
@@ -60,6 +183,9 @@ def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_meth
                 conn.send(msg)
             except (OSError, EOFError):
                 shutdown.set()
+
+    resolver = _NsResolver(send_head)
+    fetch_cache = _FetchCache(get_config().object_store_memory)
 
     def start_worker(wid_hex: str):
         from ray_tpu.core.node import _suppress_child_main_import
@@ -96,6 +222,61 @@ def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_meth
             pass
         if report:
             send_head({"type": "worker_death", "wid": wid_hex, "reason": reason})
+
+    def fetch_object(wid_hex: str, req_id, desc):
+        """Pull a foreign-namespace segment into this node's namespace on
+        behalf of a worker; reply on the worker's pipe."""
+        resp = {"type": "resp", "req_id": req_id, "ok": True, "payload": None, "error": None}
+        try:
+            if desc.ns == my_ns:
+                resp["payload"] = desc.shm_name
+            else:
+                addr = resolver.resolve(desc.ns)
+                if addr is None:
+                    raise FileNotFoundError(f"no transfer address for shm namespace {desc.ns!r} (node gone?)")
+                local = local_shm_name(desc)
+                try:
+                    n = transport.pull_segment(addr, transfer_authkey, desc.shm_name, local)
+                except FileNotFoundError:
+                    # stale address after node restart: re-resolve once
+                    resolver.invalidate(desc.ns)
+                    addr2 = resolver.resolve(desc.ns)
+                    if not addr2 or addr2 == addr:
+                        raise
+                    n = transport.pull_segment(addr2, transfer_authkey, desc.shm_name, local)
+                fetch_cache.add(local, n)
+                resp["payload"] = local
+        except BaseException as e:  # noqa: BLE001
+            resp["ok"] = False
+            resp["error"] = e
+        with lock:
+            entry = workers.get(wid_hex)
+        if entry is not None:
+            try:
+                entry[1].send(resp)
+            except (OSError, ValueError, EOFError):
+                pass
+
+    def handle_worker_frame(wid: str, data: dict):
+        if isinstance(data, dict) and data.get("type") == "agent_req":
+            method = data.get("method")
+            if method == "fetch_object":
+                threading.Thread(
+                    target=fetch_object,
+                    args=(wid, data["req_id"], data["params"]["desc"]),
+                    daemon=True,
+                ).start()
+                return
+            # unknown agent method: error back on the pipe
+            with lock:
+                entry = workers.get(wid)
+            if entry is not None:
+                try:
+                    entry[1].send({"type": "resp", "req_id": data["req_id"], "ok": False, "error": ValueError(f"unknown agent method {method!r}")})
+                except Exception:
+                    pass
+            return
+        send_head({"type": "from_worker", "wid": wid, "data": data})
 
     while not shutdown.is_set():
         with lock:
@@ -137,6 +318,16 @@ def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_meth
                     reap_worker(msg["wid"], "killed by head", report=msg.get("report", True))
                 elif t == "ping":
                     send_head({"type": "pong", "seq": msg.get("seq", 0), "pid": os.getpid()})
+                elif t == "ns_addr":
+                    resolver.deliver(msg["ns"], msg.get("addr"))
+                elif t == "free_shm":
+                    name = msg.get("name", "")
+                    if name.startswith("rt") and "/" not in name:
+                        fetch_cache.drop(name)
+                        try:
+                            os.unlink("/dev/shm/" + name)
+                        except OSError:
+                            pass
                 elif t == "shutdown":
                     shutdown.set()
             else:
@@ -148,7 +339,7 @@ def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_meth
                 except (EOFError, OSError):
                     reap_worker(wid, "worker process exited")
                     continue
-                send_head({"type": "from_worker", "wid": wid, "data": data})
+                handle_worker_frame(wid, data)
 
     # drain: kill workers, close head socket
     with lock:
@@ -171,7 +362,40 @@ def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_meth
             wconn.close()
         except Exception:
             pass
+    transfer_srv.shutdown()
+    if my_ns != os.environ.get("RT_SESSION_PID", ""):
+        # private namespace dies with the node: unlink our segments
+        # (produced objects are reconstructable via lineage; cache copies
+        # are re-pullable)
+        try:
+            for name in os.listdir("/dev/shm"):
+                if name.startswith(f"rt{my_ns}_"):
+                    try:
+                        os.unlink("/dev/shm/" + name)
+                    except OSError:
+                        pass
+        except OSError:
+            pass
     try:
         conn.close()
     except Exception:
         pass
+
+
+def standalone_agent_main(head_host: str, head_port: int, authkey: bytes, transfer_authkey: bytes, resources: dict, env: dict | None = None):
+    """Entry for ``rt agent --address head:port`` — a node agent on (
+    typically) another host joining an existing cluster over TCP. Blocks
+    until the head disconnects."""
+    from ray_tpu._config import get_config
+    from ray_tpu.core.ids import NodeID
+
+    node_id = NodeID.from_random()
+    agent_entry(
+        (head_host, head_port),
+        authkey,
+        node_id.hex(),
+        dict(env or {}),
+        get_config().worker_start_method,
+        transfer_authkey=transfer_authkey,
+        resources=resources,
+    )

@@ -30,12 +30,22 @@ from ray_tpu.core.serialization import Serialized
 
 @dataclass
 class ShmDescriptor:
-    """Locator for an object living in shared memory."""
+    """Locator for an object living in shared memory.
+
+    ``ns`` is the shm namespace of the node that holds the bytes (the
+    producer's). A process whose own namespace differs cannot attach the
+    segment directly — it pulls the bytes through the object transfer
+    service (core/transport.py) into a same-named segment in its own
+    namespace first. On one host all nodes share a namespace by default,
+    so the descriptor doubles as the cross-host location record (reference:
+    object_manager/ownership_object_directory.h — the owner knows where
+    each object's primary copy lives)."""
 
     shm_name: str
     header_len: int
     buffer_lens: list[int]
     total_size: int
+    ns: str = ""
 
 
 @dataclass
@@ -74,11 +84,50 @@ def _attach_no_track(name: str) -> shared_memory.SharedMemory:
 
 
 def _session_tag() -> str:
-    """Segment names embed the session (driver) pid so orphans from killed
-    sessions can be reclaimed (reference: plasma store restart cleanup)."""
+    """This process's shm namespace tag. Segment names embed it so orphans
+    from killed sessions can be reclaimed (reference: plasma store restart
+    cleanup). ``RT_SHM_NS`` (set per node in shm-isolation / multi-host
+    mode) takes precedence over the session pid."""
     import os
 
+    ns = os.environ.get("RT_SHM_NS")
+    if ns:
+        return ns
     return os.environ.get("RT_SESSION_PID", str(os.getpid()))
+
+
+# Installed by the runtime (head) / worker client: pulls a foreign-namespace
+# segment into the local namespace and returns the local segment name.
+_fetch_hook = None
+
+
+def set_fetch_hook(fn):
+    global _fetch_hook
+    _fetch_hook = fn
+
+
+def local_shm_name(desc: "ShmDescriptor") -> str:
+    """Name the local cached copy of a (possibly foreign) descriptor."""
+    return f"rt{_session_tag()}_" + desc.shm_name.split("_", 1)[1]
+
+
+def ensure_local_segment(desc: "ShmDescriptor") -> str:
+    """Return the name of an attachable local segment for ``desc``,
+    pulling the bytes from the owning node if the descriptor lives in a
+    foreign shm namespace."""
+    import os
+
+    if not desc.ns or desc.ns == _session_tag():
+        return desc.shm_name
+    local = local_shm_name(desc)
+    if os.path.exists("/dev/shm/" + local):
+        return local
+    if _fetch_hook is None:
+        raise FileNotFoundError(
+            f"object segment {desc.shm_name} lives in foreign shm namespace "
+            f"{desc.ns!r} and no transfer fetch hook is installed"
+        )
+    return _fetch_hook(desc)
 
 
 def cleanup_orphan_segments():
@@ -91,7 +140,9 @@ def cleanup_orphan_segments():
     except OSError:
         return
     for n in names:
-        m = re.match(r"^rt(\d+)_", n)
+        # namespaces: "<pid>" (session), "<pid>n<k>" (isolated node),
+        # "<pid>j" (joined agent) — the leading pid is the liveness key
+        m = re.match(r"^rt(\d+)(?:[nj][0-9a-f]*)?_", n)
         if not m:
             continue
         pid = int(m.group(1))
@@ -132,15 +183,17 @@ def write_to_shm(obj_id: ObjectID, s: Serialized) -> ShmDescriptor:
         seg.buf[off : off + n] = mv
         off += n
         lens.append(n)
-    desc = ShmDescriptor(shm_name=name, header_len=len(s.header), buffer_lens=lens, total_size=total)
+    desc = ShmDescriptor(shm_name=name, header_len=len(s.header), buffer_lens=lens, total_size=total, ns=_session_tag())
     seg.close()
     return desc
 
 
 def read_from_shm(desc: ShmDescriptor, zero_copy: bool = False):
     """Return (Serialized, segment). With zero_copy the buffers are
-    memoryviews into the mapping and the caller must keep `segment` alive."""
-    seg = _attach_no_track(desc.shm_name)
+    memoryviews into the mapping and the caller must keep `segment` alive.
+    Foreign-namespace descriptors are first materialized locally through
+    the transfer service (see ensure_local_segment)."""
+    seg = _attach_no_track(ensure_local_segment(desc))
     off = 0
     hdr_mv = seg.buf[off : off + desc.header_len]
     header = bytes(hdr_mv)
@@ -186,6 +239,23 @@ class ObjectStore:
         self.cfg = get_config()
         # called (outside the lock) with the ObjectID on every seal
         self.listeners: list = []
+        # installed by the runtime: free a segment that lives in a FOREIGN
+        # shm namespace (ask the owning node's agent to unlink it)
+        self.remote_free = None
+
+    def _free_shm(self, desc: ShmDescriptor):
+        """Unlink the backing segment wherever it lives: locally for our
+        namespace, via the owning node's agent otherwise (plus any local
+        cached copy pulled through the transfer service)."""
+        if not desc.ns or desc.ns == _session_tag():
+            unlink_shm(desc.shm_name)
+            return
+        unlink_shm(local_shm_name(desc))  # drop our cache copy if any
+        if self.remote_free is not None:
+            try:
+                self.remote_free(desc)
+            except Exception:
+                pass
 
     # -- write path --------------------------------------------------------
     def put_serialized(self, obj_id: ObjectID, s: Serialized, inline_threshold: int | None = None) -> StoredObject:
@@ -206,7 +276,7 @@ class ObjectStore:
             old = self._objects.get(obj_id)
             if old is not None and old.shm is not None:
                 self._shm_bytes -= old.shm.total_size
-                unlink_shm(old.shm.shm_name)
+                self._free_shm(old.shm)
             self._objects[obj_id] = entry
             self._evicted.discard(obj_id)
             if entry.shm is not None:
@@ -286,7 +356,7 @@ class ObjectStore:
             self._evicted.discard(obj_id)
             if entry is not None and entry.shm is not None:
                 self._shm_bytes -= entry.shm.total_size
-                unlink_shm(entry.shm.shm_name)
+                self._free_shm(entry.shm)
 
     def mark_lost(self, obj_id: ObjectID):
         """The object's shm backing vanished (raced eviction / external
@@ -302,6 +372,10 @@ class ObjectStore:
 
         if entry.shm is None:
             return True
+        if entry.shm.ns and entry.shm.ns != _session_tag():
+            # remote segment: existence is verified at pull time (a failed
+            # pull surfaces as FileNotFoundError -> mark_lost -> lineage)
+            return True
         return os.path.exists("/dev/shm/" + entry.shm.shm_name)
 
     def evict(self, obj_id: ObjectID) -> bool:
@@ -315,7 +389,7 @@ class ObjectStore:
                 return False
             if entry.shm is not None:
                 self._shm_bytes -= entry.shm.total_size
-                unlink_shm(entry.shm.shm_name)
+                self._free_shm(entry.shm)
             self._evicted.add(obj_id)
             return True
 
@@ -351,7 +425,7 @@ class ObjectStore:
         with self._lock:
             for entry in self._objects.values():
                 if entry.shm is not None:
-                    unlink_shm(entry.shm.shm_name)
+                    self._free_shm(entry.shm)
             self._objects.clear()
             self._shm_bytes = 0
             self._evicted.clear()

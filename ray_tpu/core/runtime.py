@@ -113,6 +113,52 @@ class Runtime:
         self.gcs = Gcs()
         self.task_manager = TaskManager(self)
         self.scheduler = Scheduler(self)
+        # ---- cross-node object plane (core/transport.py) ----
+        # The head is the owner directory: shm namespace -> transfer
+        # address of the node holding the bytes (reference:
+        # object_manager/ownership_object_directory.h).
+        from ray_tpu.core import object_store as _os_mod
+        from ray_tpu.core import transport as _transport
+
+        self._transfer_authkey = os.urandom(16)
+        if not local_mode:
+            adv = self.cfg.node_manager_host
+            if adv in ("", "0.0.0.0"):
+                import socket as _socket
+
+                try:
+                    adv = _socket.gethostbyname(_socket.gethostname())
+                except OSError:
+                    adv = "127.0.0.1"
+            self._transfer_server = _transport.ObjectTransferServer(self._transfer_authkey, advertise_host=adv)
+        else:
+            self._transfer_server = None
+        self._head_ns = _os_mod._session_tag()
+        self._ns_addrs: dict[str, tuple] = {}
+        self._ns_nodes: dict[str, NodeID] = {}
+        self._shm_ns_counter = 0
+        if self._transfer_server is not None:
+            self._ns_addrs[self._head_ns] = self._transfer_server.address
+        _os_mod.set_fetch_hook(self._fetch_foreign_segment)
+        self.store.remote_free = self._free_foreign_segment
+        # TCP rendezvous all node agents dial into (spawned locally or
+        # joined from another host via `rt agent --address`).
+        if not local_mode:
+            from ray_tpu.core.node import AgentListener
+
+            self._agent_listener = AgentListener(
+                host=self.cfg.node_manager_host,
+                port=self.cfg.node_manager_port,
+                on_join=self._on_agent_join,
+            )
+            try:
+                from ray_tpu.util.state import dump_cluster_info
+
+                dump_cluster_info(self)
+            except Exception:
+                pass
+        else:
+            self._agent_listener = None
         from ray_tpu.core.lock_sanitizer import make_lock
 
         self._nodes_lock = make_lock("runtime.nodes")
@@ -202,15 +248,39 @@ class Runtime:
     # ------------------------------------------------------------------
     # cluster membership
     # ------------------------------------------------------------------
-    def add_node(self, resources: dict, labels: dict | None = None, env: dict | None = None, remote: bool = True) -> Node:
+    def add_node(
+        self,
+        resources: dict,
+        labels: dict | None = None,
+        env: dict | None = None,
+        remote: bool = True,
+        shm_isolation: bool | None = None,
+    ) -> Node:
         """Add a node. remote=True (default) runs the node manager as a
-        separate agent process with a socket transport + health checks —
+        separate agent process over the TCP agent channel + health checks —
         real process separation like the reference's raylet; remote=False
-        keeps the legacy in-process simulation."""
+        keeps the legacy in-process simulation. shm_isolation=True gives
+        the node its own shm namespace so every object crossing the node
+        boundary moves through the transfer service — exactly what a
+        separate host would do (no same-host fast path)."""
+        if shm_isolation is None:
+            shm_isolation = self.cfg.shm_isolation
         if remote and not self.local_mode:
             from ray_tpu.core.node import RemoteNode
 
-            node = RemoteNode(None, resources, labels=labels, env=env)
+            env = dict(env or {})
+            if shm_isolation:
+                self._shm_ns_counter += 1
+                env["RT_SHM_NS"] = f"{self._head_ns.split('n')[0]}n{self._shm_ns_counter}"
+            node = RemoteNode(
+                None,
+                resources,
+                labels=labels,
+                env=env,
+                listener=self._agent_listener,
+                transfer_authkey=self._transfer_authkey,
+            )
+            self._register_node_transfer(node)
         else:
             node = Node(None, resources, labels=labels, env=env)
         with self._nodes_lock:
@@ -219,6 +289,52 @@ class Runtime:
         self.gcs.pubsub.publish("node", {"event": "added", "node_id": node.node_id.hex()})
         self.scheduler.wake()
         return node
+
+    def _register_node_transfer(self, node):
+        ns = getattr(node, "shm_ns", "")
+        if ns and getattr(node, "transfer_addr", None):
+            self._ns_addrs.setdefault(ns, node.transfer_addr)
+            self._ns_nodes[ns] = node.node_id
+
+    def _on_agent_join(self, conn, hello: dict):
+        """A standalone agent (``rt agent --address head:port``, typically
+        another host) connected to the agent listener: adopt it as a node."""
+        from ray_tpu.core.ids import NodeID as _NodeID
+        from ray_tpu.core.node import JoinedNode
+
+        node = JoinedNode(_NodeID.from_hex(hello["node_id"]), conn, hello)
+        self._register_node_transfer(node)
+        with self._nodes_lock:
+            self.nodes[node.node_id] = node
+        self.gcs.events.record("node_added", node_id=node.node_id.hex(), resources=node.total_resources, joined=True)
+        self.gcs.pubsub.publish("node", {"event": "added", "node_id": node.node_id.hex()})
+        logger.info("node %s joined via agent listener (ns=%s)", node.node_id.hex()[:8], node.shm_ns)
+        self.scheduler.wake()
+
+    # ---- cross-node segment fetch/free (head side) ----
+    def _fetch_foreign_segment(self, desc) -> str:
+        """object_store fetch hook: pull a foreign-namespace segment into
+        the head's namespace; returns the local segment name."""
+        from ray_tpu.core import transport
+        from ray_tpu.core.object_store import local_shm_name
+
+        addr = self._ns_addrs.get(desc.ns)
+        if addr is None:
+            raise FileNotFoundError(f"no transfer address for shm namespace {desc.ns!r} (node dead?)")
+        local = local_shm_name(desc)
+        transport.pull_segment(addr, self._transfer_authkey, desc.shm_name, local)
+        return local
+
+    def _free_foreign_segment(self, desc):
+        """object_store remote_free hook: ask the owning node's agent to
+        unlink a segment living in its namespace."""
+        node_id = self._ns_nodes.get(desc.ns)
+        if node_id is None:
+            return
+        with self._nodes_lock:
+            node = self.nodes.get(node_id)
+        if node is not None and getattr(node, "remote", False) and node.alive:
+            node.agent_send({"type": "free_shm", "name": desc.shm_name})
 
     def remove_node(self, node_id: NodeID, graceful: bool = False):
         """Simulate node death (reference: GcsHealthCheckManager failure path —
@@ -249,6 +365,12 @@ class Runtime:
         node.shutdown()
         with self._nodes_lock:
             self.nodes.pop(node_id, None)
+        ns = getattr(node, "shm_ns", "")
+        if ns and ns != self._head_ns:
+            # the node's namespace dies with it: lookups fail fast and
+            # objects there fall back to lineage reconstruction
+            self._ns_addrs.pop(ns, None)
+            self._ns_nodes.pop(ns, None)
         self.gcs.events.record("node_removed", node_id=node_id.hex())
         self.gcs.pubsub.publish("node", {"event": "removed", "node_id": node_id.hex()})
         self.scheduler.wake()
@@ -1025,6 +1147,11 @@ class Runtime:
                 w.proc.pid = msg.get("pid")
         elif t == "pong":
             node.last_pong = time.monotonic()
+        elif t == "resolve_ns":
+            # owner-directory lookup: which node serves this shm namespace
+            # (reference: ownership_object_directory.h)
+            ns = msg.get("ns", "")
+            node.agent_send({"type": "ns_addr", "ns": ns, "addr": self._ns_addrs.get(ns)})
 
     def _state_dump_loop(self):
         """Periodic session state.json for the out-of-process CLI
@@ -1227,10 +1354,33 @@ class Runtime:
             self._on_stream_item(msg)
         elif t == "req":
             self._req_pool.submit(self._handle_client_req, w, msg)
+        elif t == "agent_req":
+            # head-node workers have no agent; the head fills the role
+            # (fetch_object pulls into the head namespace, which head-node
+            # workers share)
+            self._req_pool.submit(self._handle_agent_req_local, w, msg)
         elif t == "ref_events":
             # ordered with this worker's done messages (same pipe)
             self.on_ref_events(w.worker_id.hex(), [(bytes.fromhex(h), reg) for h, reg in msg["events"]])
         elif t == "pong":
+            pass
+
+    def _handle_agent_req_local(self, w: WorkerHandle, msg: dict):
+        resp = {"type": "resp", "req_id": msg["req_id"], "ok": True, "payload": None, "error": None}
+        try:
+            if msg.get("method") == "fetch_object":
+                desc = msg["params"]["desc"]
+                from ray_tpu.core.object_store import ensure_local_segment
+
+                resp["payload"] = ensure_local_segment(desc)
+            else:
+                raise ValueError(f"unknown agent method {msg.get('method')!r}")
+        except BaseException as e:  # noqa: BLE001
+            resp["ok"] = False
+            resp["error"] = e
+        try:
+            w.send(resp)
+        except Exception:
             pass
 
     def _on_task_done(self, node: Node, w: WorkerHandle, msg: dict):
@@ -1713,6 +1863,13 @@ class Runtime:
         for node in list(self.nodes.values()):
             node.shutdown()
         self.store.shutdown()
+        if getattr(self, "_agent_listener", None) is not None:
+            self._agent_listener.shutdown()
+        if getattr(self, "_transfer_server", None) is not None:
+            self._transfer_server.shutdown()
+        from ray_tpu.core import object_store as _os_mod
+
+        _os_mod.set_fetch_hook(None)
         self._req_pool.shutdown(wait=False, cancel_futures=True)
         context.set_client(None)
 

@@ -1,0 +1,211 @@
+"""Cross-node object transfer: chunked pulls of shm segments over TCP.
+
+This is the DCN half of the object plane. Every node process (the head and
+each node agent) runs an ``ObjectTransferServer`` that serves byte ranges of
+the shared-memory segments living in ITS shm namespace; any other node pulls
+a segment it needs in chunks and installs it in its own namespace as a local
+cache. Reference semantics: the object manager's admission-controlled pulls
+and chunked pushes between nodes (reference:
+src/ray/object_manager/pull_manager.h:50 chunked pull orchestration,
+src/ray/object_manager/push_manager.h:28 chunk windowing,
+src/ray/object_manager/ownership_object_directory.h owner-directed location
+lookup — here the head IS the owner directory, resolving an shm namespace to
+the transfer address of the node that holds the bytes).
+
+Design notes (TPU-first framing): the data plane stays host-to-host TCP
+(DCN); device arrays never travel through here during a jitted step — GSPMD
+collectives over ICI own that path. This service moves task arguments,
+returns and dataset blocks between hosts.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+
+_STATS_LOCK = threading.Lock()
+STATS = {"pulls": 0, "pull_bytes": 0, "serves": 0, "serve_bytes": 0, "pull_errors": 0}
+
+
+def _bump(key: str, n: int = 1):
+    with _STATS_LOCK:
+        STATS[key] += n
+
+
+def reset_stats():
+    with _STATS_LOCK:
+        for k in STATS:
+            STATS[k] = 0
+
+
+# ---------------------------------------------------------------------------
+# wire protocol: length-prefixed frames over a raw TCP socket.
+#   client -> server:  HMAC-free hello: 16-byte authkey digest handshake via
+#                      challenge/response (same scheme as multiprocessing's
+#                      connection auth, reimplemented minimally), then one
+#                      request frame: b"PULL" + u32 name_len + name bytes.
+#   server -> client:  u64 total_size (or 0xFFFF..FF on error + error frame),
+#                      then raw chunks until total_size bytes are sent.
+# ---------------------------------------------------------------------------
+_ERR = 0xFFFFFFFFFFFFFFFF
+
+
+def _send_frame(sock: socket.socket, data: bytes):
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ConnectionError("transfer peer closed")
+        buf.extend(part)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if n > 1 << 20:
+        raise ConnectionError("oversized transfer frame")
+    return _recv_exact(sock, n)
+
+
+def _auth_server(sock: socket.socket, authkey: bytes):
+    import hmac
+
+    challenge = os.urandom(20)
+    _send_frame(sock, challenge)
+    resp = _recv_frame(sock)
+    if not hmac.compare_digest(resp, hmac.new(authkey, challenge, "sha256").digest()):
+        raise ConnectionError("transfer auth failed")
+    _send_frame(sock, b"OK")
+
+
+def _auth_client(sock: socket.socket, authkey: bytes):
+    import hmac
+
+    challenge = _recv_frame(sock)
+    _send_frame(sock, hmac.new(authkey, challenge, "sha256").digest())
+    if _recv_frame(sock) != b"OK":
+        raise ConnectionError("transfer auth rejected")
+
+
+class ObjectTransferServer:
+    """Serves chunked reads of /dev/shm segments in this process's namespace.
+
+    ``advertise_host`` is the address peers dial — it must be routable FROM
+    other nodes, so a cross-host agent advertises the interface it reaches
+    the head on, not the bind wildcard."""
+
+    def __init__(self, authkey: bytes, host: str = "0.0.0.0", advertise_host: str = "127.0.0.1", chunk_bytes: int = 1 << 20):
+        self.authkey = authkey
+        self.chunk_bytes = chunk_bytes
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(64)
+        self.address = (advertise_host, self._sock.getsockname()[1])
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True, name="rt-transfer-srv")
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_one, args=(conn,), daemon=True).start()
+
+    def _serve_one(self, conn: socket.socket):
+        try:
+            conn.settimeout(30.0)
+            _auth_server(conn, self.authkey)
+            req = _recv_frame(conn)
+            if not req.startswith(b"PULL"):
+                raise ConnectionError(f"bad transfer op {req[:8]!r}")
+            name = req[4:].decode()
+            if "/" in name or not name.startswith("rt"):
+                raise ConnectionError("illegal segment name")
+            path = "/dev/shm/" + name
+            try:
+                f = open(path, "rb")
+            except OSError:
+                conn.sendall(struct.pack("<Q", _ERR))
+                _send_frame(conn, b"not found")
+                return
+            with f:
+                size = os.fstat(f.fileno()).st_size
+                conn.sendall(struct.pack("<Q", size))
+                sent = 0
+                while sent < size:
+                    chunk = f.read(min(self.chunk_bytes, size - sent))
+                    if not chunk:
+                        break
+                    conn.sendall(chunk)
+                    sent += len(chunk)
+            _bump("serves")
+            _bump("serve_bytes", sent)
+        except Exception:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def shutdown(self):
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def pull_segment(addr, authkey: bytes, src_name: str, dst_name: str, timeout: float = 60.0) -> int:
+    """Pull segment ``src_name`` from the transfer server at ``addr`` and
+    install it atomically as /dev/shm/``dst_name``. Returns byte count.
+    Raises FileNotFoundError if the peer no longer has the segment (callers
+    treat that as object-lost and fall back to lineage reconstruction)."""
+    if os.path.exists("/dev/shm/" + dst_name):
+        return os.path.getsize("/dev/shm/" + dst_name)
+    sock = socket.create_connection(tuple(addr), timeout=timeout)
+    tmp = f"/dev/shm/{dst_name}.t{os.getpid()}.{threading.get_ident()}"
+    try:
+        sock.settimeout(timeout)
+        _auth_client(sock, authkey)
+        _send_frame(sock, b"PULL" + src_name.encode())
+        (size,) = struct.unpack("<Q", _recv_exact(sock, 8))
+        if size == _ERR:
+            err = _recv_frame(sock)
+            _bump("pull_errors")
+            raise FileNotFoundError(f"remote segment {src_name}: {err.decode()}")
+        got = 0
+        with open(tmp, "wb") as f:
+            while got < size:
+                part = sock.recv(min(1 << 20, size - got))
+                if not part:
+                    raise ConnectionError("transfer truncated")
+                f.write(part)
+                got += len(part)
+        os.rename(tmp, "/dev/shm/" + dst_name)
+        _bump("pulls")
+        _bump("pull_bytes", got)
+        return got
+    except (ConnectionError, socket.timeout, OSError) as e:
+        _bump("pull_errors")
+        if isinstance(e, FileNotFoundError):
+            raise
+        raise FileNotFoundError(f"pull of {src_name} from {addr} failed: {e}") from None
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass

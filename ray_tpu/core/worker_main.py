@@ -81,13 +81,13 @@ class WorkerClient:
         with self._send_lock:
             self.conn.send(msg)
 
-    def call(self, method: str, timeout: float | None = None, **params):
+    def call(self, method: str, timeout: float | None = None, _kind: str = "req", **params):
         with self._req_lock:
             self._req_seq += 1
             req_id = self._req_seq
             slot = [threading.Event(), False, None]
             self._pending[req_id] = slot
-        self._send({"type": "req", "req_id": req_id, "method": method, "params": params})
+        self._send({"type": _kind, "req_id": req_id, "method": method, "params": params})
         if not slot[0].wait(timeout=timeout):
             with self._req_lock:
                 self._pending.pop(req_id, None)
@@ -95,6 +95,16 @@ class WorkerClient:
         if not slot[1]:
             raise slot[2]
         return slot[2]
+
+    def call_agent(self, method: str, timeout: float | None = None, **params):
+        """RPC answered by this node's agent (data-plane ops like pulling a
+        foreign shm segment) instead of the head. Same response framing."""
+        return self.call(method, timeout=timeout, _kind="agent_req", **params)
+
+    def _fetch_remote_segment(self, desc) -> str:
+        """object_store fetch hook: the node agent pulls the bytes from the
+        owning node's transfer server into this node's namespace."""
+        return self.call_agent("fetch_object", desc=desc, timeout=120.0)
 
     def _handle_resp(self, msg):
         with self._req_lock:
@@ -481,10 +491,25 @@ def worker_entry(conn, worker_id: str, node_id: str, env: dict | None = None):
     """Process entry point (multiprocessing target)."""
     if env:
         os.environ.update(env)
+    # Honor JAX_PLATFORMS in workers even when the forkserver's interpreter
+    # already imported jax with an explicit jax_platforms config (the axon
+    # sitecustomize does this): forked children inherit that config, and
+    # config beats the env var — so re-assert the env contract here.
+    import sys as _sys
+
+    _jp = os.environ.get("JAX_PLATFORMS")
+    if _jp and "jax" in _sys.modules:
+        try:
+            _sys.modules["jax"].config.update("jax_platforms", _jp)
+        except Exception:
+            pass
     os.environ["RT_WORKER_ID"] = worker_id  # metrics flusher / log capture key
     _redirect_worker_logs(worker_id)
     # Workers must not inherit a driver-side TPU lock; JAX is imported lazily
     # by user code (reference warns likewise: train/v2/jax/jax_trainer.py:88).
     client = WorkerClient(conn, worker_id, node_id)
+    from ray_tpu.core.object_store import set_fetch_hook
+
+    set_fetch_hook(client._fetch_remote_segment)
     context.set_client(client)
     client.run()

@@ -83,6 +83,45 @@ def cmd_summary(args):
         print(json.dumps(snap["status"].get("actors", {}), indent=2))
 
 
+def cmd_agent(args):
+    """Join a running cluster as a node agent — the cross-host worker-node
+    entry point (reference: `ray start --address=head:port`,
+    python/ray/scripts/scripts.py). Credentials come from flags or, when
+    --address is omitted, from the head's session cluster_info.json (same
+    machine)."""
+    import os
+    import secrets
+
+    from ray_tpu.core.node_agent import standalone_agent_main
+
+    if args.address:
+        if not args.authkey or not args.transfer_authkey:
+            print("--address requires --authkey and --transfer-authkey (hex, from the head's cluster_info.json)", file=sys.stderr)
+            sys.exit(2)
+        host, _, port = args.address.rpartition(":")
+        authkey = bytes.fromhex(args.authkey)
+        transfer_key = bytes.fromhex(args.transfer_authkey)
+    else:
+        from ray_tpu.util.state import load_latest_cluster_info
+
+        info = load_latest_cluster_info()
+        if info is None:
+            print("no running session found; pass --address/--authkey", file=sys.stderr)
+            sys.exit(1)
+        host, port = info["agent_address"]
+        authkey = bytes.fromhex(info["authkey"])
+        transfer_key = bytes.fromhex(info["transfer_authkey"])
+    # a joined agent is its own "host": take a globally-unique private shm
+    # namespace (pid alone could collide with the head's session pid or a
+    # joined agent on another machine)
+    os.environ.setdefault("RT_SHM_NS", f"{os.getpid()}j{secrets.token_hex(2)}")
+    resources = {"CPU": float(args.num_cpus)}
+    if args.num_tpus:
+        resources["TPU"] = float(args.num_tpus)
+    print(f"joining head at {host}:{port} with {resources}", flush=True)
+    standalone_agent_main(host, int(port), authkey, transfer_key, resources)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="rt", description="ray_tpu cluster CLI")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -91,8 +130,14 @@ def main(argv=None):
     lp.add_argument("kind", choices=["nodes", "actors", "tasks", "objects", "pgs", "placement_groups"])
     sp = sub.add_parser("summary")
     sp.add_argument("kind", choices=["tasks", "actors"])
+    ap = sub.add_parser("agent", help="join a running cluster as a worker node (cross-host)")
+    ap.add_argument("--address", default=None, help="head agent listener host:port")
+    ap.add_argument("--authkey", default=None, help="hex agent-channel authkey")
+    ap.add_argument("--transfer-authkey", default=None, help="hex object-transfer authkey")
+    ap.add_argument("--num-cpus", type=float, default=1.0)
+    ap.add_argument("--num-tpus", type=float, default=0.0)
     args = p.parse_args(argv)
-    {"status": cmd_status, "list": cmd_list, "summary": cmd_summary}[args.cmd](args)
+    {"status": cmd_status, "list": cmd_list, "summary": cmd_summary, "agent": cmd_agent}[args.cmd](args)
 
 
 if __name__ == "__main__":

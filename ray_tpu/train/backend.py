@@ -62,8 +62,15 @@ class _JaxBackend(Backend):
         if mode == "never" or (mode == "auto" and n <= 1):
             return
         # coordinator = worker 0's host (slice worker 0 per the reference's
-        # TPU topology: tpu.py worker-id labels); pick a free port there
-        host, port = worker_group.execute_single(0, _free_coordinator_addr)
+        # TPU topology: tpu.py worker-id labels); pick a free port there.
+        # When every worker reports the same hostname the job is single-
+        # machine (shm-isolated test nodes included): use loopback, which
+        # is the only iface guaranteed reachable across its processes.
+        hostnames = worker_group.execute(_get_hostname)
+        if len(set(hostnames)) == 1:
+            host, port = worker_group.execute_single(0, _free_coordinator_addr, loopback=True)
+        else:
+            host, port = worker_group.execute_single(0, _free_coordinator_addr)
         coordinator = f"{host}:{port}"
         worker_group.execute(_init_jax_distributed, coordinator, n)
 
@@ -74,23 +81,50 @@ class _JaxBackend(Backend):
             pass
 
 
-def _free_coordinator_addr():
-    """Runs ON worker 0: its routable IP + a free port (other hosts of the
-    slice must be able to dial it — 127.0.0.1 would only work single-host)."""
+def _get_hostname():
     import socket
 
-    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
+    return socket.gethostname()
+
+
+def _free_coordinator_addr(loopback: bool = False):
+    """Runs ON worker 0: its routable IP + a free port (other hosts of the
+    slice must be able to dial it — 127.0.0.1 would only work single-host).
+    Candidate interfaces are VERIFIED by a loopback dial: an egress probe
+    can report a non-routable address in sandboxed/NATed environments."""
+    import socket
+
+    candidates = []
+    if loopback:
+        candidates.append("127.0.0.1")
     try:
         probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         probe.connect(("8.8.8.8", 80))  # no packets sent; just picks the egress iface
-        host = probe.getsockname()[0]
+        candidates.append(probe.getsockname()[0])
         probe.close()
     except OSError:
-        host = "127.0.0.1"
-    return host, port
+        pass
+    try:
+        candidates.append(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    candidates.append("127.0.0.1")
+    for host in candidates:
+        try:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.bind((host, 0))
+            srv.listen(1)
+            port = srv.getsockname()[1]
+            dial = socket.create_connection((host, port), timeout=1.0)
+            dial.close()
+            srv.close()
+            return host, port
+        except OSError:
+            try:
+                srv.close()
+            except OSError:
+                pass
+    raise RuntimeError("no dialable interface for the jax.distributed coordinator")
 
 
 def _init_jax_distributed(coordinator: str, num_processes: int):

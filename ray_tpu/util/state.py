@@ -105,6 +105,56 @@ def dump_state(client=None) -> str:
     return path
 
 
+def dump_cluster_info(client) -> str:
+    """Write the join credentials (agent listener address + authkeys) for
+    out-of-process `rt agent` joins. 0600: the authkeys gate cluster entry
+    (reference: redis password in `ray start --address`)."""
+    d = session_dir()
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, "cluster_info.json")
+    info = {
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "agent_address": list(client._agent_listener.address),
+        "authkey": client._agent_listener.authkey.hex(),
+        "transfer_authkey": client._transfer_authkey.hex(),
+    }
+    tmp = path + ".tmp"
+    # 0600 from birth: the file holds cluster-entry authkeys
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        json.dump(info, f)
+    os.replace(tmp, path)
+    return path
+
+
+def load_latest_cluster_info() -> dict | None:
+    """Newest cluster_info.json across live sessions (for `rt agent`)."""
+    root = os.path.join("/tmp", "ray_tpu")
+    best, best_ts = None, -1.0
+    try:
+        sessions = os.listdir(root)
+    except FileNotFoundError:
+        return None
+    for s in sessions:
+        p = os.path.join(root, s, "cluster_info.json")
+        try:
+            ts = os.path.getmtime(p)
+        except OSError:
+            continue
+        if ts > best_ts:
+            best, best_ts = p, ts
+    if best is None:
+        return None
+    with open(best) as f:
+        info = json.load(f)
+    try:
+        os.kill(info["pid"], 0)
+    except (ProcessLookupError, PermissionError):
+        return None  # head is gone
+    return info
+
+
 def load_latest_state() -> dict | None:
     """Newest state.json across sessions (CLI entry)."""
     root = os.path.join("/tmp", "ray_tpu")

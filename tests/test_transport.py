@@ -1,0 +1,235 @@
+"""Cross-node transport tests: TCP agent channel, shm-namespace isolation,
+chunked object transfer, cross-host agent join.
+
+Reference strategy: python/ray/tests/test_object_manager.py (cross-node
+pulls of plasma objects between raylets) and test_multi_node.py — here the
+"hosts" are shm-isolated nodes: each gets a private shm namespace, so any
+object crossing a node boundary MUST ride the TCP transfer service
+(core/transport.py); a same-host fast path would fail the assertions on
+transfer counters and cached-copy segment names.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import context, transport
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+def _pin(node):
+    return NodeAffinitySchedulingStrategy(node_id=node.node_id.hex(), soft=False)
+
+
+@pytest.fixture
+def iso_cluster():
+    """Head + two shm-isolated remote nodes (simulated hosts)."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1)
+    client = context.get_client()
+    n1 = client.add_node({"CPU": 2.0}, shm_isolation=True)
+    n2 = client.add_node({"CPU": 2.0}, shm_isolation=True)
+    transport.reset_stats()
+    yield client, n1, n2
+    ray_tpu.shutdown()
+
+
+def test_isolated_nodes_have_distinct_namespaces(iso_cluster):
+    client, n1, n2 = iso_cluster
+    head_ns = client._head_ns
+    assert n1.shm_ns and n2.shm_ns
+    assert len({head_ns, n1.shm_ns, n2.shm_ns}) == 3
+    # the head's owner directory knows every namespace's transfer address
+    assert n1.shm_ns in client._ns_addrs and n2.shm_ns in client._ns_addrs
+
+
+def test_driver_pulls_remote_object_over_tcp(iso_cluster):
+    client, n1, _ = iso_cluster
+
+    @ray_tpu.remote(scheduling_strategy=None)
+    def produce():
+        return np.arange(500_000, dtype=np.float64)
+
+    ref = produce.options(scheduling_strategy=_pin(n1)).remote()
+    v = ray_tpu.get(ref, timeout=60)
+    assert v.shape == (500_000,) and v[-1] == 499_999
+    # the bytes crossed the transfer service into the head's namespace
+    assert transport.STATS["pulls"] >= 1
+    assert transport.STATS["pull_bytes"] >= v.nbytes
+
+
+def test_cross_node_transfer_no_fast_path(iso_cluster):
+    """n2 consumes n1's output: the pull happens node-to-node (in n2's
+    agent), leaving a cached copy in n2's namespace on this host."""
+    client, n1, n2 = iso_cluster
+
+    @ray_tpu.remote
+    def produce():
+        return np.full(300_000, 7.0)
+
+    @ray_tpu.remote
+    def consume(a):
+        return float(a.sum())
+
+    ref = produce.options(scheduling_strategy=_pin(n1)).remote()
+    total = ray_tpu.get(consume.options(scheduling_strategy=_pin(n2)).remote(ref), timeout=60)
+    assert total == 7.0 * 300_000
+    # producer segment lives in n1's namespace; consumer cached a copy in
+    # n2's namespace after pulling it over TCP
+    oid = ref.id.hex()
+    assert os.path.exists(f"/dev/shm/rt{n1.shm_ns}_{oid}")
+    deadline = time.monotonic() + 10
+    while not os.path.exists(f"/dev/shm/rt{n2.shm_ns}_{oid}"):
+        assert time.monotonic() < deadline, "no cached copy in consumer namespace"
+        time.sleep(0.1)
+
+
+def test_worker_put_fetched_by_driver(iso_cluster):
+    client, n1, _ = iso_cluster
+
+    @ray_tpu.remote
+    def putter():
+        r = ray_tpu.put(np.ones(300_000))
+        return [r]
+
+    inner = ray_tpu.get(putter.options(scheduling_strategy=_pin(n1)).remote(), timeout=60)[0]
+    assert ray_tpu.get(inner, timeout=60).sum() == 300_000
+    assert transport.STATS["pulls"] >= 1
+
+
+def test_remote_free_unlinks_producer_segment(iso_cluster):
+    client, n1, _ = iso_cluster
+
+    @ray_tpu.remote
+    def produce():
+        return np.zeros(200_000)
+
+    ref = produce.options(scheduling_strategy=_pin(n1)).remote()
+    ray_tpu.get(ref, timeout=60)
+    name = f"/dev/shm/rt{n1.shm_ns}_{ref.id.hex()}"
+    assert os.path.exists(name)
+    client.free_objects([ref.id])
+    deadline = time.monotonic() + 10
+    while os.path.exists(name):
+        assert time.monotonic() < deadline, "free_shm never reached the producer agent"
+        time.sleep(0.1)
+
+
+def test_node_death_triggers_lineage_reconstruction(iso_cluster):
+    """The producing node dies; its namespace is gone; get() falls back to
+    lineage reconstruction on a surviving node (reference:
+    object_recovery_manager.h:41)."""
+    client, n1, n2 = iso_cluster
+
+    @ray_tpu.remote(max_retries=3)
+    def produce():
+        return np.arange(100_000)
+
+    ref = produce.options(scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=n1.node_id.hex(), soft=True)).remote()
+    v1 = ray_tpu.get(ref, timeout=60)
+    client.remove_node(n1.node_id)
+    # head's cached copy must not satisfy the re-get: drop it so the path
+    # truly exercises lost-namespace -> reconstruct
+    from ray_tpu.core.object_store import local_shm_name
+
+    entry = client.store.try_get_entry(ref.id)
+    if entry is not None and entry.shm is not None:
+        try:
+            os.unlink("/dev/shm/" + local_shm_name(entry.shm))
+        except OSError:
+            pass
+        client.store.mark_lost(ref.id)
+    v2 = ray_tpu.get(ref, timeout=120)
+    np.testing.assert_array_equal(v1, v2)
+
+
+def test_jax_distributed_trainer_across_isolated_nodes(iso_cluster):
+    """Two JaxTrainer workers on shm-isolated nodes bring up
+    jax.distributed (coordination service + gloo over TCP) and exchange a
+    cross-process allgather — the v5e-multi-host training topology, with
+    control plane, object plane, and collective bootstrap all riding the
+    network transport (reference: train/v2 jax backend + NCCL bootstrap)."""
+    client, n1, n2 = iso_cluster
+    for n in (n1, n2):
+        n.total_resources["trainer"] = 1.0
+        n.available["trainer"] = 1.0
+
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    def train_fn(config):
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        from ray_tpu.train import get_context, report
+
+        rank = get_context().get_world_rank()
+        assert jax.process_count() == 2
+        total = multihost_utils.process_allgather(jnp.array([rank + 1.0]))
+        report({"rank": rank, "total": float(total.sum()), "nproc": jax.process_count()})
+
+    trainer = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2, resources_per_worker={"CPU": 1, "trainer": 1}),
+    )
+    result = trainer.fit(raise_on_error=False)
+    assert result.error is None, (
+        f"{result.error!r}; training_error="
+        f"{getattr(result.error, 'training_error', None)!r}"
+    )
+    assert result.metrics["nproc"] == 2
+    assert result.metrics["total"] == 3.0
+
+
+def test_agent_join_over_tcp(rt_start):
+    """A standalone `rt agent` process (the cross-host join path) connects
+    through the head's TCP listener and serves tasks from its own shm
+    namespace."""
+    client = context.get_client()
+    n_before = len(client.node_list())
+    env = dict(os.environ)
+    env.pop("RT_SHM_NS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "agent", "--num-cpus", "2"],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    try:
+        deadline = time.monotonic() + 30
+        joined = None
+        while joined is None:
+            assert time.monotonic() < deadline, f"agent never joined: {proc.stdout.read1(4096)}"
+            time.sleep(0.2)
+            for node in client.node_list():
+                if node.labels.get("ray_tpu.io/node-type") == "joined":
+                    joined = node
+        assert joined.shm_ns != client._head_ns
+
+        @ray_tpu.remote
+        def where():
+            return os.getpid()
+
+        pid = ray_tpu.get(where.options(scheduling_strategy=_pin(joined)).remote(), timeout=60)
+        assert pid != os.getpid()
+
+        @ray_tpu.remote
+        def produce():
+            return np.ones(200_000)
+
+        v = ray_tpu.get(produce.options(scheduling_strategy=_pin(joined)).remote(), timeout=60)
+        assert v.sum() == 200_000
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+    # head notices the agent's death and removes the node
+    deadline = time.monotonic() + 15
+    while any(n.labels.get("ray_tpu.io/node-type") == "joined" for n in client.node_list()):
+        assert time.monotonic() < deadline, "joined node never removed after agent death"
+        time.sleep(0.2)
