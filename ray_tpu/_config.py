@@ -46,6 +46,14 @@ class Config:
     object_store_eviction_threshold: float = 0.8
     # Use the C++ shared-memory store when the extension is built.
     use_native_object_store: bool = True
+    # Spill cold sealed objects to disk under memory pressure instead of
+    # evicting them (reference: local_object_manager.h:43); restore on read.
+    object_spilling_enabled: bool = True
+    # Directory for spill files; empty = <session_dir>/spill.
+    object_spill_dir: str = ""
+    # Disk budget for spilled bytes; past it, cold objects are evicted
+    # (lineage reconstruction) instead of spilled.
+    object_spill_max_bytes: int = 50 * 1024 * 1024 * 1024
 
     # --- transport / cross-node object plane ---
     # Bind host for the head's agent listener (TCP) and transfer servers.
@@ -59,6 +67,9 @@ class Config:
     # reconnect to a RESTARTED head (GCS fault tolerance; reference:
     # gcs_server_port + raylet reconnect backoff).
     node_manager_port: int = 0
+    # Seconds an agent keeps redialing the head after connection loss
+    # (0 = die with the head; set alongside node_manager_port for head FT).
+    agent_reconnect_s: float = 0.0
 
     # --- GCS persistence (reference: redis_store_client.h:126) ---
     # Path of the append-only GCS table log; empty = in-memory only.

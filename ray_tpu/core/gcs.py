@@ -17,18 +17,48 @@ from collections import defaultdict
 
 
 class KVStore:
-    """Namespaced binary KV (reference: gcs_kv_manager.h InternalKV)."""
+    """Namespaced binary KV (reference: gcs_kv_manager.h InternalKV),
+    write-through to the pluggable table store so a persistent backend
+    makes it survive head restarts (reference: redis_store_client.h:126)."""
 
-    def __init__(self):
+    def __init__(self, store=None):
+        import base64
+        import pickle
+
+        from ray_tpu.core.table_store import InMemoryTableStore
+
         self._lock = threading.Lock()
         self._data: dict[str, dict[bytes, bytes]] = defaultdict(dict)
+        self._store = store or InMemoryTableStore()
+        # re-hydrate from a persistent backend. Keys/values are arbitrary
+        # picklable objects (callers pass str, bytes, dicts), so the table
+        # rows are pickled on both sides.
+        for skey, value in self._store.all("kv").items():
+            ns, _, key_b64 = skey.partition("::")
+            try:
+                self._data[ns][pickle.loads(base64.b64decode(key_b64))] = pickle.loads(value)
+            except Exception:
+                continue
+
+    @staticmethod
+    def _skey(namespace: str, key) -> str:
+        import base64
+        import pickle
+
+        return f"{namespace}::{base64.b64encode(pickle.dumps(key)).decode()}"
 
     def put(self, key: bytes, value: bytes, overwrite: bool = True, namespace: str = "default") -> bool:
+        import pickle
+
         with self._lock:
             ns = self._data[namespace]
             if not overwrite and key in ns:
                 return False
             ns[key] = value
+            try:
+                self._store.put("kv", self._skey(namespace, key), pickle.dumps(value))
+            except Exception:
+                pass  # unpicklable value: kept in memory only
             return True
 
     def get(self, key: bytes, namespace: str = "default") -> bytes | None:
@@ -37,7 +67,10 @@ class KVStore:
 
     def delete(self, key: bytes, namespace: str = "default") -> bool:
         with self._lock:
-            return self._data[namespace].pop(key, None) is not None
+            existed = self._data[namespace].pop(key, None) is not None
+            if existed:
+                self._store.delete("kv", self._skey(namespace, key))
+            return existed
 
     def exists(self, key: bytes, namespace: str = "default") -> bool:
         with self._lock:
@@ -45,7 +78,11 @@ class KVStore:
 
     def keys(self, prefix: bytes = b"", namespace: str = "default") -> list[bytes]:
         with self._lock:
-            return [k for k in self._data[namespace] if k.startswith(prefix)]
+            if not prefix:
+                return list(self._data[namespace])
+            # keys may be str or bytes depending on the caller; only
+            # same-typed keys can match a prefix
+            return [k for k in self._data[namespace] if isinstance(k, type(prefix)) and k.startswith(prefix)]
 
 
 class Publisher:
@@ -106,14 +143,29 @@ class EventBuffer:
 
 
 class Gcs:
-    def __init__(self):
-        self.kv = KVStore()
+    def __init__(self, store=None):
+        from ray_tpu.core.table_store import InMemoryTableStore
+
+        self.store = store or InMemoryTableStore()
+        self.kv = KVStore(self.store)
         self.pubsub = Publisher()
         self.events = EventBuffer()
         self._lock = threading.Lock()
         # named actor registry: (namespace, name) -> ActorID
         self.named_actors: dict[tuple, object] = {}
         self.job_counter = 0
+
+    # -- detached actor persistence (reference: gcs_actor_manager.h
+    # RegisterActor persisted to the store; on GCS restart detached actors
+    # are reloaded and restarted) --
+    def persist_detached_actor(self, actor_id, blob: bytes):
+        self.store.put("detached_actors", actor_id.hex(), blob)
+
+    def drop_detached_actor(self, actor_id):
+        self.store.delete("detached_actors", actor_id.hex())
+
+    def load_detached_actors(self) -> dict[str, bytes]:
+        return self.store.all("detached_actors")
 
     def register_named_actor(self, name: str, namespace: str, actor_id) -> bool:
         with self._lock:

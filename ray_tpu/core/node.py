@@ -21,6 +21,37 @@ from ray_tpu.core.ids import NodeID, WorkerID
 _mp_ctx = None
 
 
+def stop_forkserver():
+    """Stop the multiprocessing forkserver (if running). The forkserver
+    holds a copy of the resource tracker's pipe fd; if the tracker's
+    finalizer runs at interpreter teardown while the forkserver is still
+    alive, os.waitpid deadlocks. The stop itself can block the same way
+    (a straggler worker forked from the server keeps its alive-fd open),
+    so it runs under a watchdog that falls back to SIGKILL. It restarts
+    on demand at the next spawn."""
+    global _mp_ctx
+    try:
+        import os
+        import signal as _signal
+
+        from multiprocessing import forkserver
+
+        fs = forkserver._forkserver
+        pid = getattr(fs, "_forkserver_pid", None)
+        t = threading.Thread(target=fs._stop, daemon=True, name="rt-fks-stop")
+        t.start()
+        t.join(3.0)
+        if t.is_alive() and pid:
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except OSError:
+                pass
+            t.join(2.0)
+    except Exception:
+        pass
+    _mp_ctx = None
+
+
 def _ctx():
     global _mp_ctx
     if _mp_ctx is None:
@@ -199,6 +230,8 @@ class Node:
     def start_worker(self) -> WorkerHandle:
         from ray_tpu.core.worker_main import worker_entry
 
+        if not self.alive:
+            raise RuntimeError("node is shut down")
         ctx = _ctx()
         parent_conn, child_conn = ctx.Pipe(duplex=True)
         wid = WorkerID.from_random()
@@ -213,6 +246,19 @@ class Node:
         child_conn.close()
         handle = WorkerHandle(worker_id=wid, proc=proc, conn=parent_conn, node_id=self.node_id)
         with self._lock:
+            if not self.alive:
+                # spawn raced shutdown (the first spawn's forkserver boot
+                # takes seconds): reap immediately or the orphan keeps the
+                # forkserver/resource-tracker pipes open forever
+                try:
+                    proc.terminate()
+                except Exception:
+                    pass
+                try:
+                    parent_conn.close()
+                except Exception:
+                    pass
+                raise RuntimeError("node shut down during worker spawn")
             self.workers[wid] = handle
         return handle
 
@@ -454,6 +500,10 @@ class RemoteNode(AgentBackedNode):
                 self.env,
                 get_config().worker_start_method,
                 transfer_authkey,
+                dict(self.total_resources),  # re-hello capacity for head-restart re-joins
+                # explicit: the agent process rebuilds Config from env only,
+                # so programmatic _system_config values must ride the args
+                get_config().agent_reconnect_s,
             ),
             # non-daemon: the agent must be able to spawn worker children.
             # Orphan safety comes from the socket: head exit -> EOF -> the

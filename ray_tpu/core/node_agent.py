@@ -127,10 +127,23 @@ class _FetchCache:
             self._entries.pop(name, None)
 
 
-def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_method: str, transfer_authkey: bytes = b"", resources: dict | None = None):
-    """Main loop of the node-agent process. ``resources`` is only sent in
-    the hello for standalone (joined) agents, where the head has no prior
-    record of the node."""
+def agent_entry(
+    address,
+    authkey: bytes,
+    node_id_hex: str,
+    env: dict,
+    start_method: str,
+    transfer_authkey: bytes = b"",
+    resources: dict | None = None,
+    reconnect_s: float | None = None,
+):
+    """Main loop of the node-agent process. ``resources`` rides in every
+    hello so a RESTARTED head (same node_manager_port) can adopt this agent
+    as a re-join with the right capacity. ``reconnect_s`` > 0 makes the
+    agent survive head-connection loss: it kills its workers (the head
+    lost all task state), then redials the same address for that window —
+    the raylet-reconnects-to-restarted-GCS behavior (reference: raylet
+    GCS client reconnect backoff, test_gcs_fault_tolerance.py)."""
     import multiprocessing as mp
 
     if env:
@@ -141,8 +154,14 @@ def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_meth
     from ray_tpu.core.object_store import _session_tag, local_shm_name
 
     my_ns = _session_tag()
+    if reconnect_s is None:
+        # fallback only (standalone/misc callers pass it explicitly; the
+        # head passes its own config value because this process's Config
+        # is rebuilt from env and misses programmatic overrides)
+        reconnect_s = get_config().agent_reconnect_s
+    address = tuple(address) if isinstance(address, (list, tuple)) else address
 
-    conn = mp_connection.Client(tuple(address) if isinstance(address, (list, tuple)) else address, authkey=authkey)
+    conn = mp_connection.Client(address, authkey=authkey)
     # advertise the interface we reach the head on: that address is what
     # other nodes (and the head) can dial for object pulls
     import socket as _socket
@@ -154,17 +173,21 @@ def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_meth
     except OSError:
         my_ip = "127.0.0.1"
     transfer_srv = transport.ObjectTransferServer(transfer_authkey, advertise_host=my_ip)
-    conn.send(
-        {
-            "type": "agent_ready",
-            "node_id": node_id_hex,
-            "pid": os.getpid(),
-            "transfer_addr": transfer_srv.address,
-            "ns": my_ns,
-            "resources": resources,
-            "labels": None,
-        }
-    )
+
+    def send_hello(c):
+        c.send(
+            {
+                "type": "agent_ready",
+                "node_id": node_id_hex,
+                "pid": os.getpid(),
+                "transfer_addr": transfer_srv.address,
+                "ns": my_ns,
+                "resources": resources,
+                "labels": None,
+            }
+        )
+
+    send_hello(conn)
 
     if start_method == "forkserver":
         ctx = mp.get_context("forkserver")
@@ -175,14 +198,15 @@ def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_meth
     workers: dict[str, tuple] = {}  # wid_hex -> (proc, conn)
     lock = threading.Lock()
     send_lock = threading.Lock()
-    shutdown = threading.Event()
+    shutdown = threading.Event()  # definitive shutdown (no reconnect)
+    conn_lost = threading.Event()  # head connection dropped
 
     def send_head(msg):
         with send_lock:
             try:
                 conn.send(msg)
             except (OSError, EOFError):
-                shutdown.set()
+                conn_lost.set()
 
     resolver = _NsResolver(send_head)
     fetch_cache = _FetchCache(get_config().object_store_memory)
@@ -278,7 +302,59 @@ def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_meth
             return
         send_head({"type": "from_worker", "wid": wid, "data": data})
 
+    def kill_all_workers():
+        # no head notification: callers run when the head connection is
+        # already gone (reconnect) or the agent is draining for good
+        with lock:
+            all_w = list(workers.items())
+            workers.clear()
+        for wid, (proc, wconn) in all_w:
+            try:
+                wconn.send({"type": "shutdown"})
+            except Exception:
+                pass
+        deadline = time.time() + 1.0
+        for wid, (proc, wconn) in all_w:
+            try:
+                proc.join(timeout=max(0.0, deadline - time.time()))
+                if proc.is_alive():
+                    proc.terminate()
+            except Exception:
+                pass
+            try:
+                wconn.close()
+            except Exception:
+                pass
+
     while not shutdown.is_set():
+        if conn_lost.is_set():
+            # head connection dropped: without a reconnect window that is
+            # terminal; with one, redial the same address (a restarted head
+            # on a fixed node_manager_port) and re-hello as a join
+            if reconnect_s <= 0:
+                break
+            kill_all_workers()  # head lost all task state
+            resolver = _NsResolver(send_head)  # old transfer addrs are stale
+            new_conn = None
+            deadline = time.time() + reconnect_s
+            while new_conn is None and time.time() < deadline:
+                try:
+                    new_conn = mp_connection.Client(address, authkey=authkey)
+                except Exception:
+                    time.sleep(0.5)
+            if new_conn is None:
+                break
+            try:
+                conn.close()
+            except Exception:
+                pass
+            conn = new_conn
+            conn_lost.clear()
+            try:
+                send_hello(conn)
+            except (OSError, EOFError):
+                conn_lost.set()
+                continue
         with lock:
             wconn_map = {wc: wid for wid, (_, wc) in workers.items()}
         waitlist = [conn] + list(wconn_map)
@@ -291,7 +367,7 @@ def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_meth
                 try:
                     msg = conn.recv()
                 except (EOFError, OSError):
-                    shutdown.set()
+                    conn_lost.set()
                     break
                 t = msg.get("type")
                 if t == "start_worker":
@@ -342,26 +418,10 @@ def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_meth
                 handle_worker_frame(wid, data)
 
     # drain: kill workers, close head socket
-    with lock:
-        all_workers = list(workers.items())
-        workers.clear()
-    for wid, (proc, wconn) in all_workers:
-        try:
-            wconn.send({"type": "shutdown"})
-        except Exception:
-            pass
-    deadline = time.time() + 1.0
-    for wid, (proc, wconn) in all_workers:
-        try:
-            proc.join(timeout=max(0.0, deadline - time.time()))
-            if proc.is_alive():
-                proc.terminate()
-        except Exception:
-            pass
-        try:
-            wconn.close()
-        except Exception:
-            pass
+    kill_all_workers()
+    from ray_tpu.core.node import stop_forkserver
+
+    stop_forkserver()
     transfer_srv.shutdown()
     if my_ns != os.environ.get("RT_SESSION_PID", ""):
         # private namespace dies with the node: unlink our segments
@@ -382,10 +442,19 @@ def agent_entry(address, authkey: bytes, node_id_hex: str, env: dict, start_meth
         pass
 
 
-def standalone_agent_main(head_host: str, head_port: int, authkey: bytes, transfer_authkey: bytes, resources: dict, env: dict | None = None):
+def standalone_agent_main(
+    head_host: str,
+    head_port: int,
+    authkey: bytes,
+    transfer_authkey: bytes,
+    resources: dict,
+    env: dict | None = None,
+    reconnect_s: float = 60.0,
+):
     """Entry for ``rt agent --address head:port`` — a node agent on (
     typically) another host joining an existing cluster over TCP. Blocks
-    until the head disconnects."""
+    until the head disconnects (and the reconnect window, if any, runs
+    out)."""
     from ray_tpu._config import get_config
     from ray_tpu.core.ids import NodeID
 
@@ -398,4 +467,5 @@ def standalone_agent_main(head_host: str, head_port: int, authkey: bytes, transf
         get_config().worker_start_method,
         transfer_authkey=transfer_authkey,
         resources=resources,
+        reconnect_s=reconnect_s,
     )

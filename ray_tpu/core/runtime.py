@@ -39,6 +39,8 @@ from ray_tpu.exceptions import (
 
 logger = logging.getLogger(__name__)
 
+_exit_hook_registered = False
+
 
 #: placeholder for a stream index whose item has not arrived (out-of-order
 #: replay gap). Distinct from None, which means end-of-stream to consumers.
@@ -97,6 +99,18 @@ class Runtime:
         self.cfg = get_config()
         self.cfg.update(system_config)
         os.environ["RT_SESSION_PID"] = str(os.getpid())
+        # One-time exit hook: stop the forkserver before interpreter
+        # teardown so the resource tracker's finalizer can't deadlock on
+        # it (see node.stop_forkserver). NOT done per-shutdown — a live
+        # forkserver is reused by the next init() and saves its ~5s boot.
+        global _exit_hook_registered
+        if not _exit_hook_registered:
+            import atexit
+
+            from ray_tpu.core.node import stop_forkserver
+
+            atexit.register(stop_forkserver)
+            _exit_hook_registered = True
         from ray_tpu.core.object_store import cleanup_orphan_segments
 
         cleanup_orphan_segments()
@@ -110,7 +124,15 @@ class Runtime:
         self.assigned_resources = {}
 
         self.store = ObjectStore()
-        self.gcs = Gcs()
+        # GCS tables: persistent append-only log when configured, so KV /
+        # jobs / named+detached actors survive a head kill -9 (reference:
+        # redis_store_client.h:126, test_gcs_fault_tolerance.py)
+        if self.cfg.gcs_persist_path and not local_mode:
+            from ray_tpu.core.table_store import FileTableStore
+
+            self.gcs = Gcs(FileTableStore(self.cfg.gcs_persist_path))
+        else:
+            self.gcs = Gcs()
         self.task_manager = TaskManager(self)
         self.scheduler = Scheduler(self)
         # ---- cross-node object plane (core/transport.py) ----
@@ -120,7 +142,10 @@ class Runtime:
         from ray_tpu.core import object_store as _os_mod
         from ray_tpu.core import transport as _transport
 
-        self._transfer_authkey = os.urandom(16)
+        # Cluster credentials: stable across head restarts when the GCS is
+        # persistent — reconnecting agents still hold the old keys.
+        self._transfer_authkey = self._persistent_secret("transfer_authkey")
+        self._listener_authkey = self._persistent_secret("listener_authkey")
         if not local_mode:
             adv = self.cfg.node_manager_host
             if adv in ("", "0.0.0.0"):
@@ -149,6 +174,7 @@ class Runtime:
             self._agent_listener = AgentListener(
                 host=self.cfg.node_manager_host,
                 port=self.cfg.node_manager_port,
+                authkey=self._listener_authkey,
                 on_join=self._on_agent_join,
             )
             try:
@@ -241,9 +267,16 @@ class Runtime:
                     for _ in range(n):
                         if self._stopped:  # re-check: shutdown can race the warmup
                             return
-                        head.start_worker()
+                        try:
+                            head.start_worker()
+                        except RuntimeError:
+                            return  # node shut down mid-spawn
 
-                threading.Thread(target=_prestart, daemon=True).start()
+                self._prestart_thread = threading.Thread(target=_prestart, daemon=True)
+                self._prestart_thread.start()
+
+        if self.cfg.gcs_persist_path and not local_mode:
+            self._rehydrate_detached_actors()
 
     # ------------------------------------------------------------------
     # cluster membership
@@ -290,6 +323,13 @@ class Runtime:
         self.scheduler.wake()
         return node
 
+    def _persistent_secret(self, name: str) -> bytes:
+        key = self.gcs.store.get("cluster_secrets", name)
+        if key is None:
+            key = os.urandom(16)
+            self.gcs.store.put("cluster_secrets", name, key)
+        return key
+
     def _register_node_transfer(self, node):
         ns = getattr(node, "shm_ns", "")
         if ns and getattr(node, "transfer_addr", None):
@@ -302,7 +342,14 @@ class Runtime:
         from ray_tpu.core.ids import NodeID as _NodeID
         from ray_tpu.core.node import JoinedNode
 
-        node = JoinedNode(_NodeID.from_hex(hello["node_id"]), conn, hello)
+        node_id = _NodeID.from_hex(hello["node_id"])
+        with self._nodes_lock:
+            stale = self.nodes.get(node_id)
+        if stale is not None:
+            # re-join after a transient drop: the old record's socket is
+            # dead — retire it before adopting the fresh connection
+            self.remove_node(node_id, graceful=False)
+        node = JoinedNode(node_id, conn, hello)
         self._register_node_transfer(node)
         with self._nodes_lock:
             self.nodes[node.node_id] = node
@@ -408,7 +455,8 @@ class Runtime:
                 try:
                     s, _ = read_from_shm(entry.shm, zero_copy=False)
                 except FileNotFoundError:
-                    self.store.mark_lost(obj_id)  # raced an eviction
+                    # raced an eviction or the bytes were spilled to disk
+                    self.store.restore_or_mark_lost(obj_id)
                     continue
                 return deserialize_s(s)
             return deserialize_s(entry.value)
@@ -428,7 +476,7 @@ class Runtime:
             entry = self.store.get_entry(obj_id, timeout=0.2 if timeout is None else min(timeout, 0.2))
             if entry is not None:
                 if not self.store.shm_backing_exists(entry):
-                    self.store.mark_lost(obj_id)
+                    self.store.restore_or_mark_lost(obj_id)
                     continue
                 return entry
             if deadline is not None and time.monotonic() >= deadline:
@@ -625,12 +673,79 @@ class Runtime:
         )
         self.actors[actor_id] = ActorState(info)
         self.task_manager.register(spec)
+        if info.detached and self.cfg.gcs_persist_path:
+            self._persist_detached_actor(info, func_blob)
         self.gcs.events.record("actor_created", actor_id=actor_id.hex(), name=name_desc)
         if self.local_mode:
             self._local_create_actor(spec)
         else:
             self.scheduler.submit(spec)
         return {"actor_id": actor_id, "method_meta": {}}
+
+    # ---- detached-actor persistence (GCS fault tolerance) ----
+    def _persist_detached_actor(self, info: ActorInfo, func_blob):
+        """Record everything needed to recreate the actor after a head
+        restart: creation spec + class blob. Inline ctor args only — args
+        referencing shm objects would dangle across a restart (reference:
+        gcs_actor_manager.h persists registered actors to the store)."""
+        import pickle
+
+        spec = info.creation_spec
+        if any(a.ref is not None or (a.payload and a.payload.shm is not None) for a in spec.args):
+            return  # not restorable: ctor args live in the object plane
+        try:
+            blob = pickle.dumps(
+                {
+                    "spec": spec,
+                    "kwargs": getattr(spec, "_kwargs", {}),
+                    "func_blob": func_blob if func_blob is not None else self._functions.get(spec.func_id),
+                    "name": info.name,
+                    "namespace": info.namespace,
+                    "detached": True,
+                }
+            )
+        except Exception:
+            return  # unpicklable spec: skip persistence, actor still works
+        self.gcs.persist_detached_actor(info.actor_id, blob)
+
+    def _rehydrate_detached_actors(self):
+        """On head start with a persistent GCS: recreate detached actors
+        recorded by the previous head, keeping their actor ids and names
+        (the reference restarts detached actors on GCS recovery)."""
+        import pickle
+
+        for actor_hex, blob in self.gcs.load_detached_actors().items():
+            try:
+                rec = pickle.loads(blob)
+            except Exception:
+                continue
+            spec = rec["spec"]
+            if spec.actor_id in self.actors:
+                continue
+            self.register_function(spec.func_id, rec.get("func_blob"))
+            spec._kwargs = rec.get("kwargs", {})
+            spec.attempt = 0
+            info = ActorInfo(
+                actor_id=spec.actor_id,
+                name=rec.get("name"),
+                namespace=rec.get("namespace", "default"),
+                class_id=spec.func_id,
+                state="PENDING",
+                max_restarts=spec.max_restarts,
+                max_task_retries=spec.max_task_retries,
+                max_concurrency=spec.max_concurrency,
+                creation_spec=spec,
+                resources=dict(spec.scheduling.resources),
+                placement_group=None,
+                bundle_index=-1,
+                detached=True,
+            )
+            if info.name:
+                self.gcs.register_named_actor(info.name, info.namespace, spec.actor_id)
+            self.actors[spec.actor_id] = ActorState(info)
+            self.task_manager.register(spec)
+            self.gcs.events.record("actor_rehydrated", actor_id=actor_hex, name=info.name or "")
+            self.scheduler.submit(spec)
 
     def submit_actor_task(
         self,
@@ -955,7 +1070,10 @@ class Runtime:
                 nonactor = sum(1 for w in node.workers.values() if w.state in ("starting", "idle", "busy"))
                 limit = int(node.total_resources.get("CPU", 1)) + self._worker_count_limit_extra
                 if (nonactor < limit or chips) and starting < len(node.dispatch_queue):
-                    node.start_worker()
+                    try:
+                        node.start_worker()
+                    except RuntimeError:
+                        pass  # node shut down mid-spawn; queue drains via remove_node
                 elif nonactor >= limit and starting == 0:
                     # pool full of env-incompatible idle workers (different
                     # runtime_env or chip binding): retire one so a
@@ -1589,6 +1707,8 @@ class Runtime:
         self._release_actor_resources(astate)
         if info.name:
             self.gcs.unregister_named_actor(info.name, info.namespace)
+        if info.detached:
+            self.gcs.drop_detached_actor(info.actor_id)  # dead for good
         self.gcs.events.record("actor_dead", actor_id=info.actor_id.hex(), cause=cause)
 
     def _release_actor_resources(self, astate: ActorState):
@@ -1627,7 +1747,9 @@ class Runtime:
         return True
 
     def _rpc_mark_object_lost(self, obj_id):
-        self.store.mark_lost(obj_id)
+        # a worker failed to attach the segment: restore from spill when
+        # the bytes are on disk, otherwise mark lost for reconstruction
+        self.store.restore_or_mark_lost(obj_id)
         return True
 
     def _rpc_wait_ready(self, obj_ids, num_returns, timeout_s=None):
@@ -1860,6 +1982,12 @@ class Runtime:
         if getattr(self, "_memory_monitor", None) is not None:
             self._memory_monitor.stop()
         self.scheduler.stop()
+        # a prestart spawn mid-forkserver-boot must finish (and be reaped
+        # by the alive check in start_worker) before teardown, or the
+        # orphan worker wedges the resource tracker at interpreter exit
+        t = getattr(self, "_prestart_thread", None)
+        if t is not None and t.is_alive():
+            t.join(timeout=15.0)
         for node in list(self.nodes.values()):
             node.shutdown()
         self.store.shutdown()
@@ -1870,6 +1998,10 @@ class Runtime:
         from ray_tpu.core import object_store as _os_mod
 
         _os_mod.set_fetch_hook(None)
+        try:
+            self.gcs.store.close()
+        except Exception:
+            pass
         self._req_pool.shutdown(wait=False, cancel_futures=True)
         context.set_client(None)
 

@@ -119,7 +119,7 @@ def cmd_agent(args):
     if args.num_tpus:
         resources["TPU"] = float(args.num_tpus)
     print(f"joining head at {host}:{port} with {resources}", flush=True)
-    standalone_agent_main(host, int(port), authkey, transfer_key, resources)
+    standalone_agent_main(host, int(port), authkey, transfer_key, resources, reconnect_s=args.reconnect)
 
 
 def main(argv=None):
@@ -136,6 +136,7 @@ def main(argv=None):
     ap.add_argument("--transfer-authkey", default=None, help="hex object-transfer authkey")
     ap.add_argument("--num-cpus", type=float, default=1.0)
     ap.add_argument("--num-tpus", type=float, default=0.0)
+    ap.add_argument("--reconnect", type=float, default=60.0, help="seconds to keep redialing a lost head (head FT window)")
     args = p.parse_args(argv)
     {"status": cmd_status, "list": cmd_list, "summary": cmd_summary, "agent": cmd_agent}[args.cmd](args)
 
