@@ -56,13 +56,19 @@ class StoredObject:
     `contained_refs` holds live ObjectRef objects pickled INSIDE this
     value: the head's local ref count then keeps those inner objects
     alive for exactly as long as the container entry exists (the store
-    side of the borrow protocol)."""
+    side of the borrow protocol).
+
+    `spill_path` set means the segment's bytes were moved to disk under
+    memory pressure (reference: raylet/local_object_manager.h:43 spill
+    orchestration); the shm descriptor is retained as the layout record
+    and the segment is re-created from the file on the next read."""
 
     value: Serialized | None = None
     shm: ShmDescriptor | None = None
     error: BaseException | None = None
     sealed_at: float = field(default_factory=time.monotonic)
     contained_refs: list = field(default_factory=list)
+    spill_path: str | None = None
 
     def size(self) -> int:
         if self.shm is not None:
@@ -131,10 +137,26 @@ def ensure_local_segment(desc: "ShmDescriptor") -> str:
 
 
 def cleanup_orphan_segments():
-    """Unlink rt<pid>_* segments whose owning session is dead."""
+    """Unlink rt<pid>_* segments whose owning session is dead, and sweep
+    dead sessions' default spill directories (a kill -9'd head can leave
+    gigabytes of spill files behind)."""
     import os
     import re
+    import shutil
 
+    try:
+        for sess in os.listdir("/tmp/ray_tpu"):
+            m = re.match(r"^session_(\d+)$", sess)
+            if not m:
+                continue
+            try:
+                os.kill(int(m.group(1)), 0)
+            except ProcessLookupError:
+                shutil.rmtree(os.path.join("/tmp/ray_tpu", sess, "spill"), ignore_errors=True)
+            except PermissionError:
+                pass
+    except OSError:
+        pass
     try:
         names = os.listdir("/dev/shm")
     except OSError:
@@ -242,6 +264,25 @@ class ObjectStore:
         # installed by the runtime: free a segment that lives in a FOREIGN
         # shm namespace (ask the owning node's agent to unlink it)
         self.remote_free = None
+        # spilling (reference: local_object_manager.h:43): cold sealed
+        # objects move to disk instead of being dropped; restore on read
+        self._spilled_bytes = 0
+        self._spill_count = 0
+        self._restore_count = 0
+        self._spill_dir = None
+
+    def spill_dir(self) -> str:
+        if self._spill_dir is None:
+            import os
+
+            d = self.cfg.object_spill_dir
+            if not d:
+                from ray_tpu.util.state import session_dir
+
+                d = os.path.join(session_dir(), "spill")
+            os.makedirs(d, exist_ok=True)
+            self._spill_dir = d
+        return self._spill_dir
 
     def _free_shm(self, desc: ShmDescriptor):
         """Unlink the backing segment wherever it lives: locally for our
@@ -275,8 +316,11 @@ class ObjectStore:
         with self._lock:
             old = self._objects.get(obj_id)
             if old is not None and old.shm is not None:
-                self._shm_bytes -= old.shm.total_size
-                self._free_shm(old.shm)
+                if old.spill_path is not None:
+                    self._drop_spill_file(old)
+                else:
+                    self._shm_bytes -= old.shm.total_size
+                    self._free_shm(old.shm)
             self._objects[obj_id] = entry
             self._evicted.discard(obj_id)
             if entry.shm is not None:
@@ -355,8 +399,11 @@ class ObjectStore:
             entry = self._objects.pop(obj_id, None)
             self._evicted.discard(obj_id)
             if entry is not None and entry.shm is not None:
-                self._shm_bytes -= entry.shm.total_size
-                self._free_shm(entry.shm)
+                if entry.spill_path is not None:
+                    self._drop_spill_file(entry)
+                else:
+                    self._shm_bytes -= entry.shm.total_size
+                    self._free_shm(entry.shm)
 
     def mark_lost(self, obj_id: ObjectID):
         """The object's shm backing vanished (raced eviction / external
@@ -364,7 +411,10 @@ class ObjectStore:
         with self._lock:
             entry = self._objects.pop(obj_id, None)
             if entry is not None and entry.shm is not None:
-                self._shm_bytes -= entry.shm.total_size
+                if entry.spill_path is not None:
+                    self._drop_spill_file(entry)
+                else:
+                    self._shm_bytes -= entry.shm.total_size
             self._evicted.add(obj_id)
 
     def shm_backing_exists(self, entry: StoredObject) -> bool:
@@ -372,6 +422,8 @@ class ObjectStore:
 
         if entry.shm is None:
             return True
+        if entry.spill_path is not None:
+            return False  # bytes are on disk: reader must restore first
         if entry.shm.ns and entry.shm.ns != _session_tag():
             # remote segment: existence is verified at pull time (a failed
             # pull surfaces as FileNotFoundError -> mark_lost -> lineage)
@@ -387,13 +439,136 @@ class ObjectStore:
             entry = self._objects.pop(obj_id, None)
             if entry is None:
                 return False
-            if entry.shm is not None:
+            if entry.spill_path is not None:
+                self._drop_spill_file(entry)
+            elif entry.shm is not None:
                 self._shm_bytes -= entry.shm.total_size
                 self._free_shm(entry.shm)
             self._evicted.add(obj_id)
             return True
 
+    # -- spilling (reference: local_object_manager.h:43) -------------------
+    def _drop_spill_file(self, entry: StoredObject):
+        import os
+
+        self._spilled_bytes -= entry.shm.total_size if entry.shm else 0
+        try:
+            os.unlink(entry.spill_path)
+        except OSError:
+            pass
+        entry.spill_path = None
+
+    def spill(self, obj_id: ObjectID) -> bool:
+        """Move a sealed local-namespace shm object's bytes to disk. The
+        entry keeps its descriptor (layout) and gains spill_path; the shm
+        segment is unlinked. Readers restore transparently.
+
+        The disk copy runs OUTSIDE the store lock (reference does spill IO
+        on async workers, local_object_manager.h:43): the segment stays
+        attachable during the copy, and the commit re-checks the entry."""
+        import os
+        import shutil
+
+        with self._lock:
+            entry = self._objects.get(obj_id)
+            if (
+                entry is None
+                or obj_id in self._pinned
+                or entry.shm is None
+                or entry.spill_path is not None
+                or getattr(entry, "_spill_inflight", False)
+                or (entry.shm.ns and entry.shm.ns != _session_tag())
+            ):
+                return False
+            entry._spill_inflight = True
+            src = "/dev/shm/" + entry.shm.shm_name
+            dst = os.path.join(self.spill_dir(), entry.shm.shm_name)
+        ok = True
+        try:
+            shutil.copyfile(src, dst)
+        except OSError:
+            try:
+                os.unlink(dst)
+            except OSError:
+                pass
+            ok = False  # disk full / segment raced away: caller evicts
+        with self._lock:
+            cur = self._objects.get(obj_id)
+            if cur is not entry or not ok:
+                entry._spill_inflight = False
+                if cur is not entry:  # deleted/replaced mid-copy
+                    try:
+                        os.unlink(dst)
+                    except OSError:
+                        pass
+                    return True  # nothing left to free
+                return False
+            entry._spill_inflight = False
+            entry.spill_path = dst
+            self._shm_bytes -= entry.shm.total_size
+            self._spilled_bytes += entry.shm.total_size
+            self._spill_count += 1
+            unlink_shm(entry.shm.shm_name)
+            return True
+
+    def restore(self, obj_id: ObjectID) -> bool:
+        """Bring a spilled object's bytes back into a shm segment (same
+        name, so outstanding descriptors attach again). The bytes are
+        staged under a temp name and renamed into place so no reader can
+        attach a partially-written segment; file IO runs outside the
+        store lock."""
+        import os
+
+        with self._lock:
+            entry = self._objects.get(obj_id)
+            if entry is None or entry.shm is None:
+                return False
+            if entry.spill_path is None:
+                return not getattr(entry, "_spill_inflight", False)
+            path, desc = entry.spill_path, entry.shm
+        tmp_name = f"{desc.shm_name}.r{time.monotonic_ns()}"
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            seg = shared_memory.SharedMemory(name=tmp_name, create=True, size=max(len(data), 1))
+        except OSError:
+            return False  # spill file lost: caller falls back to lineage
+        try:
+            resource_tracker.unregister(seg._name, "shared_memory")  # noqa: SLF001
+        except Exception:
+            pass
+        seg.buf[: len(data)] = data
+        seg.close()
+        with self._lock:
+            cur = self._objects.get(obj_id)
+            if cur is not entry or entry.spill_path is None:
+                unlink_shm(tmp_name)  # concurrent restore/delete won
+                return cur is not None
+            try:
+                os.rename("/dev/shm/" + tmp_name, "/dev/shm/" + desc.shm_name)
+            except OSError:
+                unlink_shm(tmp_name)
+                return False
+            self._drop_spill_file(entry)
+            self._shm_bytes += desc.total_size
+            self._restore_count += 1
+            entry.sealed_at = time.monotonic()
+        self._maybe_evict()
+        return True
+
+    def restore_or_mark_lost(self, obj_id: ObjectID):
+        """Missing shm backing: restore from spill if possible, else flip
+        to evicted so lineage reconstruction kicks in."""
+        if self.restore(obj_id):
+            return
+        self.mark_lost(obj_id)
+
     def _maybe_evict(self):
+        """Memory-pressure policy, LRU order over sealed unpinned objects:
+        spill local objects to disk first (bytes survive, no recompute);
+        evict when spilling is off, fails (disk full), the object lives in
+        a foreign namespace, or the disk budget is exhausted — lineage
+        reconstruction is the fallback for evicted entries."""
         cfg = self.cfg
         limit = int(cfg.object_store_memory * cfg.object_store_eviction_threshold)
         with self._lock:
@@ -403,11 +578,18 @@ class ObjectStore:
                 (
                     (e.sealed_at, oid)
                     for oid, e in self._objects.items()
-                    if e.shm is not None and oid not in self._pinned
+                    if e.shm is not None and e.spill_path is None and oid not in self._pinned
                 ),
             )
         for _, oid in candidates:
-            self.evict(oid)
+            spilled = False
+            if cfg.object_spilling_enabled:
+                with self._lock:
+                    disk_ok = self._spilled_bytes < cfg.object_spill_max_bytes
+                if disk_ok:
+                    spilled = self.spill(oid)
+            if not spilled:
+                self.evict(oid)
             with self._lock:
                 if self._shm_bytes <= limit:
                     break
@@ -419,13 +601,24 @@ class ObjectStore:
                 "shm_bytes": self._shm_bytes,
                 "num_evicted": len(self._evicted),
                 "num_pinned": len(self._pinned),
+                "spilled_bytes": self._spilled_bytes,
+                "spill_count": self._spill_count,
+                "restore_count": self._restore_count,
             }
 
     def shutdown(self):
+        import os
+
         with self._lock:
             for entry in self._objects.values():
-                if entry.shm is not None:
+                if entry.spill_path is not None:
+                    try:
+                        os.unlink(entry.spill_path)
+                    except OSError:
+                        pass
+                elif entry.shm is not None:
                     self._free_shm(entry.shm)
             self._objects.clear()
             self._shm_bytes = 0
+            self._spilled_bytes = 0
             self._evicted.clear()

@@ -1,0 +1,147 @@
+"""Object spilling tests: spill-to-disk under store pressure, restore on
+read, eviction fallback.
+
+Reference strategy: python/ray/tests/test_object_spilling.py (fill the
+store past its budget, assert objects survive via disk and restore on
+get) against the policy in raylet/local_object_manager.h:43.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._config import get_config, reset_config
+from ray_tpu.core import context
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import ObjectStore
+from ray_tpu.core.serialization import serialize
+
+
+def _mk_store(tmp_path, budget_bytes, spilling=True, disk_budget=None):
+    reset_config()
+    cfg = get_config()
+    cfg.object_store_memory = budget_bytes
+    cfg.object_store_eviction_threshold = 1.0
+    cfg.object_spilling_enabled = spilling
+    cfg.object_spill_dir = str(tmp_path / "spill")
+    if disk_budget is not None:
+        cfg.object_spill_max_bytes = disk_budget
+    return ObjectStore()
+
+
+def _put(store, nbytes, seed):
+    oid = ObjectID.from_random()
+    arr = np.full(nbytes // 8, seed, dtype=np.float64)
+    store.put_serialized(oid, serialize(arr))
+    return oid, arr
+
+
+def _read(store, oid):
+    from ray_tpu.core.object_store import read_from_shm
+    from ray_tpu.core.serialization import deserialize_s
+
+    entry = store.try_get_entry(oid)
+    assert entry is not None
+    if not store.shm_backing_exists(entry):
+        store.restore_or_mark_lost(oid)
+    s, _ = read_from_shm(entry.shm, zero_copy=False)
+    return deserialize_s(s)
+
+
+def test_spill_then_restore_roundtrip(tmp_path):
+    store = _mk_store(tmp_path, budget_bytes=3 * 2**20)
+    oids = [_put(store, 2**20, i) for i in range(6)]  # 6 MB into a 3 MB store
+    st = store.stats()
+    assert st["spill_count"] >= 3, st
+    assert st["num_evicted"] == 0, "spilling must win over eviction"
+    # spill files on disk, within the spill dir
+    spill_files = os.listdir(str(tmp_path / "spill"))
+    assert len(spill_files) == st["spill_count"]
+    # every object still readable — cold ones restore from disk
+    for oid, arr in oids:
+        got = _read(store, oid)
+        np.testing.assert_array_equal(got, arr)
+    assert store.stats()["restore_count"] >= 3
+    store.shutdown()
+    assert os.listdir(str(tmp_path / "spill")) == []
+
+
+def test_pinned_objects_never_spill(tmp_path):
+    store = _mk_store(tmp_path, budget_bytes=2 * 2**20)
+    (pinned_oid, pinned_arr) = _put(store, 2**20, 42)
+    store.pin(pinned_oid)
+    for i in range(4):
+        _put(store, 2**20, i)
+    entry = store.try_get_entry(pinned_oid)
+    assert entry.spill_path is None
+    assert store.shm_backing_exists(entry)
+    np.testing.assert_array_equal(_read(store, pinned_oid), pinned_arr)
+    store.shutdown()
+
+
+def test_eviction_fallback_when_spilling_disabled(tmp_path):
+    store = _mk_store(tmp_path, budget_bytes=2 * 2**20, spilling=False)
+    for i in range(5):
+        _put(store, 2**20, i)
+    st = store.stats()
+    assert st["spill_count"] == 0
+    assert st["num_evicted"] >= 2
+    store.shutdown()
+
+
+def test_eviction_fallback_when_disk_budget_exhausted(tmp_path):
+    store = _mk_store(tmp_path, budget_bytes=2 * 2**20, disk_budget=2 * 2**20)
+    for i in range(8):
+        _put(store, 2**20, i)
+    st = store.stats()
+    assert st["spill_count"] >= 1
+    assert st["num_evicted"] >= 1, "disk budget must cap spilling"
+    assert st["spilled_bytes"] <= 3 * 2**20
+    store.shutdown()
+
+
+def test_lru_order_spills_coldest_first(tmp_path):
+    store = _mk_store(tmp_path, budget_bytes=3 * 2**20)
+    (a, _), (b, _), (c, _) = (_put(store, 2**20, i) for i in range(3))
+    _read(store, a)  # touch a: now b is coldest
+    _put(store, 2**20, 99)  # push over budget -> spill coldest
+    assert store.try_get_entry(b).spill_path is not None
+    assert store.try_get_entry(a).spill_path is None
+    store.shutdown()
+
+
+def test_dataset_3x_store_size_materializes(tmp_path):
+    """VERDICT done-criterion: a dataset ~3x the shm budget materializes
+    and iterates correctly, spilling instead of dying."""
+    ray_tpu.shutdown()
+    ray_tpu.init(
+        num_cpus=4,
+        _system_config={
+            "object_store_memory": 8 * 2**20,
+            "object_store_eviction_threshold": 1.0,
+            "object_spill_dir": str(tmp_path / "spill"),
+        },
+    )
+    try:
+        from ray_tpu import data
+
+        n_blocks, block_elems = 24, 2**17  # 24 x 1 MB = 3x the 8 MB budget
+        ds = data.range(n_blocks, parallelism=n_blocks).map_batches(
+            lambda b: {"x": np.full(block_elems, int(b["id"][0]), dtype=np.float64)},
+            batch_size=None,
+        )
+        mat = ds.materialize()
+        client = context.get_client()
+        seen = set()
+        total = 0
+        for batch in mat.iter_batches(batch_size=None):
+            x = batch["x"]
+            total += x.size
+            seen.update(np.unique(x).astype(int).tolist())
+        assert total == n_blocks * block_elems
+        assert seen == set(range(n_blocks))
+        assert client.store.stats()["spill_count"] > 0, client.store.stats()
+    finally:
+        ray_tpu.shutdown()
