@@ -15,9 +15,10 @@ from ray_tpu.serve.api import (
     start,
     status,
 )
+from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions, ReplicaConfig
 from ray_tpu.serve.deployment import Application, Deployment, deployment
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse, DeploymentResponseGenerator
 from ray_tpu.serve._proxy import Request
 
 __all__ = [
@@ -27,9 +28,11 @@ __all__ = [
     "DeploymentConfig",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentResponseGenerator",
     "HTTPOptions",
     "ReplicaConfig",
     "Request",
+    "batch",
     "delete",
     "deployment",
     "get_app_handle",

@@ -50,7 +50,16 @@ class HTTPProxy:
 
     # -- route table --
 
-    def _refresh_routes(self):
+    def _refresh_routes(self, force: bool = False):
+        # cached: one controller round-trip per interval, not per request
+        # (reference: proxy long-polls the route table). Forced refreshes
+        # (route misses) are rate-limited too, or a 404 scanner would
+        # reintroduce a controller RTT per request.
+        now = time.time()
+        interval = 0.25 if force else 1.0
+        if now - getattr(self, "_routes_at", 0.0) < interval:
+            return
+        self._routes_at = now
         apps = ray_tpu.get(self._controller.list_applications.remote())
         with self._routes_lock:
             known = set(self._routes)
@@ -88,6 +97,10 @@ class HTTPProxy:
                     parsed = urlparse(self.path)
                     handle, prefix = proxy._match(parsed.path)
                     if handle is None:
+                        # route may be new: force one refresh before 404ing
+                        proxy._refresh_routes(force=True)
+                        handle, prefix = proxy._match(parsed.path)
+                    if handle is None:
                         self._respond(404, {"error": f"no route for {parsed.path}"})
                         return
                     n = int(self.headers.get("Content-Length", 0) or 0)
@@ -100,10 +113,74 @@ class HTTPProxy:
                         headers=dict(self.headers.items()),
                         body=body,
                     )
-                    result = handle.remote(req).result(timeout_s=60.0)
+                    timeout = proxy._opts.request_timeout_s
+                    if self._wants_stream(req):
+                        self._stream(handle.options(stream=True).remote(req), timeout)
+                        return
+                    try:
+                        result = handle.remote(req).result(timeout_s=timeout)
+                    except ray_tpu.exceptions.GetTimeoutError:
+                        # result() already cancelled the replica task
+                        self._respond(504, {"error": f"request exceeded {timeout}s"})
+                        return
                     self._respond(200, result)
                 except Exception as e:  # noqa: BLE001
                     self._respond(500, {"error": repr(e)})
+
+            def _wants_stream(self, req: Request) -> bool:
+                accept = req.headers.get("Accept", "") or req.headers.get("accept", "")
+                return "text/event-stream" in accept or req.headers.get("X-Serve-Stream") == "1"
+
+            def _stream(self, gen, timeout):
+                """Chunked transfer: one chunk per yielded item (reference:
+                proxy streaming of StreamingResponse bodies). Errors and
+                timeouts after the 200 header abort the connection WITHOUT
+                the chunked terminator — a truncated stream is the only
+                honest error signal once streaming began; a clean
+                terminator would make partial output look complete (and a
+                second response would desync HTTP/1.1 keep-alive)."""
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                deadline = time.time() + timeout if timeout else None
+
+                def chunk(data: bytes):
+                    self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+                    self.wfile.flush()
+
+                clean = False
+                try:
+                    it = iter(gen)
+                    while True:
+                        if deadline is not None:
+                            remaining = deadline - time.time()
+                            if remaining <= 0:
+                                break  # unclean abort below
+                            gen.item_timeout_s = remaining
+                        try:
+                            item = next(it)
+                        except StopIteration:
+                            clean = True
+                            break
+                        if isinstance(item, (bytes, bytearray)):
+                            data = bytes(item)
+                        elif isinstance(item, str):
+                            data = item.encode()
+                        else:
+                            data = (json.dumps(item) + "\n").encode()
+                        chunk(data)
+                except Exception:  # noqa: BLE001  (incl. GetTimeoutError)
+                    clean = False
+                finally:
+                    if clean:
+                        try:
+                            self.wfile.write(b"0\r\n\r\n")
+                            self.wfile.flush()
+                        except OSError:
+                            pass
+                    else:
+                        self.close_connection = True
 
             def _respond(self, code: int, payload):
                 if isinstance(payload, (bytes, bytearray)):

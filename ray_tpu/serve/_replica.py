@@ -1,14 +1,18 @@
 """Replica actor: hosts one instance of a deployment's user class.
 
-Reference parity: serve/_private/replica.py (UserCallableWrapper,
-handle_request, health checks, graceful shutdown) — collapsed to a single
-actor class. Concurrency comes from the actor's max_concurrency thread
-pool; the replica tracks its in-flight count, which is both the router's
-load signal (pow-2 choice) and the autoscaler's metric.
+Reference parity: serve/_private/replica.py (UserCallableWrapper with a
+dedicated user-code event loop, handle_request / handle_request_streaming,
+health checks, graceful shutdown) — collapsed to a single actor class.
+Sync callables run on the actor's max_concurrency thread pool; coroutines
+and async generators run on ONE persistent replica event loop (the
+reference's user-callable loop), so async deployments don't pay a loop per
+request. Streaming methods yield through the runtime's streaming-generator
+machinery back to the caller.
 """
 
 from __future__ import annotations
 
+import asyncio
 import inspect
 import threading
 import time
@@ -41,6 +45,11 @@ class Replica:
         self._ongoing = 0
         self._total = 0
         self._created_at = time.time()
+        # one persistent loop for all async user code (reference: the
+        # replica's user-code event loop, serve/_private/replica.py)
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(target=self._loop.run_forever, name="serve-user-loop", daemon=True)
+        self._loop_thread.start()
         init_args = tuple(_resolve_handle_markers(a) for a in (init_args or ()))
         init_kwargs = {k: _resolve_handle_markers(v) for k, v in (init_kwargs or {}).items()}
         if inspect.isfunction(cls_or_fn):
@@ -75,33 +84,79 @@ class Replica:
             fn(user_config)
 
     def prepare_shutdown(self, timeout_s: float = 5.0):
-        """Drain: wait until in-flight requests finish (or timeout)."""
+        """Drain in-flight requests (bounded), then run the deployment's
+        cleanup hook — `shutdown()`/`close()`/`__del__` in that order
+        (reference: replica graceful shutdown calls the user __del__)."""
         deadline = time.time() + timeout_s
         while time.time() < deadline:
             with self._lock:
                 if self._ongoing == 0:
                     break
             time.sleep(0.02)
-        shutdown = getattr(self._callable, "__del__", None)
+        if not self._is_function:
+            for name in ("shutdown", "close", "__del__"):
+                hook = getattr(self._callable, name, None)
+                if callable(hook):
+                    try:
+                        res = hook()
+                        if inspect.iscoroutine(res):
+                            asyncio.run_coroutine_threadsafe(res, self._loop).result(timeout=timeout_s)
+                    except Exception:
+                        pass
+                    break
+        with self._lock:
+            drained = self._ongoing == 0
+        if drained:
+            # only a drained loop may stop: an in-flight coroutine on a
+            # stopped loop would hang its handler thread forever
+            try:
+                self._loop.call_soon_threadsafe(self._loop.stop)
+            except Exception:
+                pass
         return True
 
     # -- data plane --
+
+    def _target(self, method_name: str):
+        if self._is_function:
+            return self._callable
+        return getattr(self._callable, method_name)
 
     def handle_request(self, method_name: str, args: tuple, kwargs: dict):
         with self._lock:
             self._ongoing += 1
             self._total += 1
         try:
-            if self._is_function:
-                fn = self._callable
-            else:
-                fn = getattr(self._callable, method_name)
-            result = fn(*args, **(kwargs or {}))
+            result = self._target(method_name)(*args, **(kwargs or {}))
             if inspect.iscoroutine(result):
-                import asyncio
-
-                result = asyncio.new_event_loop().run_until_complete(result)
+                result = asyncio.run_coroutine_threadsafe(result, self._loop).result()
             return result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def handle_request_streaming(self, method_name: str, args: tuple, kwargs: dict):
+        """Generator method: items stream back through the runtime's
+        streaming-generator path (reference: handle_request_streaming,
+        serve/_private/replica.py). Called with num_returns='streaming'."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            result = self._target(method_name)(*args, **(kwargs or {}))
+            if inspect.iscoroutine(result):
+                result = asyncio.run_coroutine_threadsafe(result, self._loop).result()
+            if inspect.isasyncgen(result):
+                while True:
+                    try:
+                        item = asyncio.run_coroutine_threadsafe(result.__anext__(), self._loop).result()
+                    except StopAsyncIteration:
+                        return
+                    yield item
+            elif inspect.isgenerator(result):
+                yield from result
+            else:
+                yield result  # unary fallback: stream of one
         finally:
             with self._lock:
                 self._ongoing -= 1

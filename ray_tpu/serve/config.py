@@ -71,3 +71,7 @@ class ReplicaConfig:
 class HTTPOptions:
     host: str = "127.0.0.1"
     port: int = 8000
+    # end-to-end per-request deadline; on expiry the proxy responds 504
+    # and cancels the replica task (reference: request_timeout_s in
+    # HTTPOptions, proxy timeout -> cancellation)
+    request_timeout_s: float = 60.0

@@ -27,7 +27,8 @@ _REFRESH_INTERVAL_S = 0.25
 class DeploymentResponse:
     """Future for one request (reference: serve/handle.py
     DeploymentResponse). `result()` blocks; `_to_object_ref()` unwraps for
-    composition with ray_tpu.get/wait."""
+    composition with ray_tpu.get/wait; `cancel()` propagates to the
+    replica task and releases the router slot."""
 
     def __init__(self, router, replica_id, ref):
         self._router = router
@@ -43,11 +44,57 @@ class DeploymentResponse:
     def result(self, timeout_s: float | None = None):
         try:
             return ray_tpu.get(self._ref, timeout=timeout_s)
+        except ray_tpu.exceptions.GetTimeoutError:
+            self.cancel()
+            raise
         finally:
             self._settle()
 
+    def cancel(self):
+        """Best-effort cancellation (reference: DeploymentResponse.cancel):
+        a queued replica task is dropped; the router slot frees either way."""
+        try:
+            ray_tpu.cancel(self._ref)
+        except Exception:
+            pass
+        self._settle()
+
     def _to_object_ref(self):
         return self._ref
+
+
+class DeploymentResponseGenerator:
+    """Streaming response: iterate per-item results as the replica yields
+    them (reference: serve/handle.py DeploymentResponseGenerator over the
+    streaming-generator protocol). ``item_timeout_s`` bounds each item
+    fetch (GetTimeoutError aborts the stream and frees the slot)."""
+
+    def __init__(self, router, replica_id, gen):
+        self._router = router
+        self._replica_id = replica_id
+        self._gen = gen
+        self._done = False
+        self.item_timeout_s: float | None = None
+
+    def __iter__(self):
+        try:
+            for item_ref in self._gen:
+                yield ray_tpu.get(item_ref, timeout=self.item_timeout_s)
+        finally:
+            self._settle()
+
+    def _settle(self):
+        if not self._done:
+            self._done = True
+            self._router._on_done(self._replica_id, self._gen)
+
+    def __del__(self):
+        # backstop: a dropped, never-iterated stream must not leak its
+        # replica slot forever (reap can't settle streaming entries)
+        try:
+            self._settle()
+        except Exception:
+            pass
 
 
 class _Router:
@@ -109,17 +156,22 @@ class _Router:
                 self._lock.notify_all()
         self._push_metrics()
 
-    def _reap(self):
-        """Settle finished in-flight refs without fetching their values."""
+    def _waitable_refs(self):
         with self._lock:
-            pending = list(self._inflight_refs.items())
+            return [ref for ref, _rid, waitable in self._inflight_refs.values() if waitable]
+
+    def _reap(self):
+        """Settle finished in-flight refs without fetching their values
+        (streaming entries settle through their generator's consumer)."""
+        with self._lock:
+            pending = [(k, ref, rid) for k, (ref, rid, waitable) in self._inflight_refs.items() if waitable]
         if not pending:
             return
-        refs = [ref for _, (ref, _) in pending]
+        refs = [ref for _, ref, _ in pending]
         ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0, fetch_local=False)
         ready_ids = {id(r) for r in ready}
         with self._lock:
-            for key, (ref, rid) in pending:
+            for key, ref, rid in pending:
                 if id(ref) in ready_ids and key in self._inflight_refs:
                     del self._inflight_refs[key]
                     if rid in self._inflight:
@@ -141,7 +193,7 @@ class _Router:
             picks = random.sample(candidates, 2)
         return min(picks, key=lambda c: self._inflight.get(c[0], 0))
 
-    def submit(self, method_name: str, args: tuple, kwargs: dict, timeout_s: float | None = 60.0):
+    def submit(self, method_name: str, args: tuple, kwargs: dict, timeout_s: float | None = 60.0, stream: bool = False):
         deadline = time.time() + timeout_s if timeout_s else None
         self._refresh(force=not self._replicas)
         with self._lock:
@@ -154,12 +206,25 @@ class _Router:
                         rid, actor = pick
                         self._inflight[rid] = self._inflight.get(rid, 0) + 1
                         break
-                # no capacity: reap completions, re-sync, wait a beat
+                # At capacity: settle any finished requests, re-sync the
+                # replica set, then BLOCK on our in-flight completions
+                # (the object store's waiter condition wakes us the moment
+                # one finishes — no fixed-interval polling). With nothing
+                # of ours in flight the replicas are saturated by other
+                # handles: sleep one refresh beat for topology/metrics.
                 self._reap()
                 self._refresh(force=True)
                 with self._lock:
-                    if self._pick_replica() is None:
-                        self._lock.wait(timeout=0.05)
+                    if self._pick_replica() is not None:
+                        continue
+                refs = self._waitable_refs()
+                remaining = None if deadline is None else max(0.0, deadline - time.time())
+                if refs:
+                    wait_t = _REFRESH_INTERVAL_S if remaining is None else min(remaining, _REFRESH_INTERVAL_S)
+                    ray_tpu.wait(refs, num_returns=1, timeout=wait_t, fetch_local=False)
+                    self._reap()
+                else:
+                    time.sleep(0.02 if remaining is None else min(remaining, 0.02))
                 if deadline and time.time() > deadline:
                     raise TimeoutError(
                         f"no replica of {self._app}/{self._deployment} accepted the request within {timeout_s}s"
@@ -169,14 +234,19 @@ class _Router:
                 self._queued -= 1
         self._push_metrics()
         try:
-            ref = actor.handle_request.remote(method_name, args, kwargs)
+            if stream:
+                ref = actor.handle_request_streaming.options(num_returns="streaming").remote(method_name, args, kwargs)
+            else:
+                ref = actor.handle_request.remote(method_name, args, kwargs)
         except Exception:
             with self._lock:
                 if rid in self._inflight:
                     self._inflight[rid] = max(0, self._inflight[rid] - 1)
             raise
         with self._lock:
-            self._inflight_refs[id(ref)] = (ref, rid)
+            self._inflight_refs[id(ref)] = (ref, rid, not stream)
+        if stream:
+            return DeploymentResponseGenerator(self, rid, ref)
         return DeploymentResponse(self, rid, ref)
 
 
@@ -187,15 +257,25 @@ class DeploymentHandle:
     ref = h.remote(x) / h.method.remote(x); ref.result()
     """
 
-    def __init__(self, controller, app_name: str, deployment: str, method_name: str = "__call__"):
+    def __init__(self, controller, app_name: str, deployment: str, method_name: str = "__call__", stream: bool = False):
         self._controller = controller
         self._app = app_name
         self._deployment = deployment
         self._method = method_name
+        self._stream = stream
         self._router = _Router(controller, app_name, deployment)
 
-    def options(self, method_name: str | None = None):
-        h = DeploymentHandle(self._controller, self._app, self._deployment, method_name or self._method)
+    def options(self, method_name: str | None = None, stream: bool | None = None):
+        """`stream=True` makes `.remote()` return a
+        DeploymentResponseGenerator over the replica's yielded items
+        (reference: handle.options(stream=True))."""
+        h = DeploymentHandle(
+            self._controller,
+            self._app,
+            self._deployment,
+            method_name or self._method,
+            stream=self._stream if stream is None else stream,
+        )
         h._router = self._router  # share the router: one in-flight view
         return h
 
@@ -204,8 +284,8 @@ class DeploymentHandle:
             raise AttributeError(name)
         return _MethodProxy(self, name)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
-        return self._router.submit(self._method, args, kwargs)
+    def remote(self, *args, **kwargs):
+        return self._router.submit(self._method, args, kwargs, stream=self._stream)
 
 
 class _MethodProxy:
@@ -213,5 +293,5 @@ class _MethodProxy:
         self._handle = handle
         self._method = method
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
-        return self._handle._router.submit(self._method, args, kwargs)
+    def remote(self, *args, **kwargs):
+        return self._handle._router.submit(self._method, args, kwargs, stream=self._handle._stream)
