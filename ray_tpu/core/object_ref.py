@@ -173,9 +173,14 @@ class ObjectRefGenerator:
         return self
 
     def __next__(self) -> ObjectRef:
+        return self.next_ref(timeout_s=None)
+
+    def next_ref(self, timeout_s: float | None = None) -> ObjectRef:
+        """next() with a bound on the wait for the producer's next item
+        (GetTimeoutError on expiry; the stream stays consumable)."""
         if self._done:
             raise StopIteration
-        ref = _client().next_generator_item(self.generator_id, self._index, timeout=None)
+        ref = _client().next_generator_item(self.generator_id, self._index, timeout=timeout_s)
         if ref is None:
             self._done = True
             raise StopIteration

@@ -1774,8 +1774,8 @@ class Runtime:
     def _rpc_get_actor_handle_info(self, name, namespace="default"):
         return self.get_actor_handle_info(name, namespace)
 
-    def _rpc_next_generator_item(self, gen_id, index):
-        return self.next_generator_item(gen_id, index, timeout=None)
+    def _rpc_next_generator_item(self, gen_id, index, timeout_s=None):
+        return self.next_generator_item(gen_id, index, timeout=timeout_s)
 
     def _rpc_free_objects(self, obj_ids):
         self.free_objects(obj_ids)
@@ -1821,6 +1821,18 @@ class Runtime:
                 for oid in self._spec_return_ids(st.spec):
                     self.store.put_error(oid, RayTpuError(f"task {task_id.hex()[:8]} was cancelled"))
             return True
+        # running streaming task: cooperative cancel — the worker's
+        # generator loop stops between items and ends the stream cleanly
+        # (reference: streaming generator cancellation in core_worker)
+        for node in self.node_list():
+            for w in list(node.workers.values()):
+                entry = w.running_tasks.get(task_id)
+                if entry is not None and entry[0].streaming:
+                    try:
+                        w.send({"type": "cancel_stream", "task_id": task_id})
+                    except Exception:
+                        pass
+                    return True
         if force:
             for node in self.node_list():
                 for w in list(node.workers.values()):

@@ -60,6 +60,9 @@ class WorkerClient:
         # shm mappings whose close was deferred because user code still
         # holds zero-copy views into them
         self._deferred_segs: list = []
+        # streaming tasks asked to stop early (cooperative cancel: the
+        # generator loop checks between items)
+        self._cancelled_streams: set = set()
 
     # ---------------- transport ----------------
     def _send_done(self, msg: dict):
@@ -170,7 +173,7 @@ class WorkerClient:
         return self.call("get_actor_handle_info", name=name, namespace=namespace)
 
     def next_generator_item(self, gen_id, index, timeout=None):
-        oid = self.call("next_generator_item", gen_id=gen_id, index=index, timeout=None)
+        oid = self.call("next_generator_item", gen_id=gen_id, index=index, timeout_s=timeout, timeout=None)
         return ObjectRef(oid) if oid is not None else None
 
     def free_objects(self, obj_ids):
@@ -340,6 +343,15 @@ class WorkerClient:
             if inspect.isasyncgen(gen):
                 gen = _drain_async_gen(self._get_actor_loop(), gen)
             for item in gen:
+                if spec.task_id in self._cancelled_streams:
+                    # cooperative cancel (reference: streaming generator
+                    # cancellation): stop producing, close the generator
+                    # so its finally blocks run, end the stream cleanly
+                    try:
+                        gen.close()
+                    except Exception:
+                        pass
+                    break
                 oid = ObjectID.for_task_return(spec.task_id, index + 1)
                 payload = encode_value(item, obj_id=oid)
                 self._send({"type": "stream_item", "task_id": spec.task_id, "index": index, "obj_id": oid, "payload": payload})
@@ -348,6 +360,8 @@ class WorkerClient:
         except BaseException as e:  # noqa: BLE001
             err = TaskError.from_exception(e, task_desc=spec.desc())
             self._send_done({"type": "done", "task_id": spec.task_id, "returns": [], "error": err, "stream_count": index})
+        finally:
+            self._cancelled_streams.discard(spec.task_id)
 
     # -- actors --
     def _create_actor_instance(self, spec, msg):
@@ -439,6 +453,8 @@ class WorkerClient:
             elif t == "exec_inline":
                 # ordered lane used for actor creation (must precede methods)
                 self._execute(msg)
+            elif t == "cancel_stream":
+                self._cancelled_streams.add(msg["task_id"])
             elif t == "shutdown":
                 break
             elif t == "ping":

@@ -76,6 +76,7 @@ class LLMEngine:
         prefill_buckets: tuple | None = None,
         seed: int = 0,
         cache_dtype: str | None = None,
+        mesh=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -86,6 +87,7 @@ class LLMEngine:
         from ray_tpu.models.llama import init_params
 
         self.config = config
+        self.mesh = mesh
         self.max_num_seqs = int(max_num_seqs)
         self.max_seq_len = int(max_seq_len or config.max_seq_len)
         if prefill_buckets is None:
@@ -96,20 +98,32 @@ class LLMEngine:
             buckets.append(self.max_seq_len)
             prefill_buckets = tuple(buckets)
         self.prefill_buckets = tuple(sorted(prefill_buckets))
-        self.params = params if params is not None else init_params(config, jax.random.PRNGKey(seed))
         self._prefill, self._insert, self._decode = make_runner_fns(config)
         self._sample = jax.jit(sample)
 
-        self.cache = kvc.alloc(
-            kvc.CacheConfig(
-                num_layers=config.num_layers,
-                num_slots=self.max_num_seqs,
-                max_seq_len=self.max_seq_len,
-                num_kv_heads=config.num_kv_heads,
-                head_dim=config.hd,
-                dtype=cache_dtype or config.dtype,
-            )
+        cache_cfg = kvc.CacheConfig(
+            num_layers=config.num_layers,
+            num_slots=self.max_num_seqs,
+            max_seq_len=self.max_seq_len,
+            num_kv_heads=config.num_kv_heads,
+            head_dim=config.hd,
+            dtype=cache_dtype or config.dtype,
         )
+        if mesh is None:
+            self.params = params if params is not None else init_params(config, jax.random.PRNGKey(seed))
+            self.cache = kvc.alloc(cache_cfg)
+        else:
+            param_sh, cache_sh = self._mesh_shardings(mesh)
+            if params is not None:
+                # host/device arrays go straight to their shards
+                self.params = jax.device_put(params, param_sh)
+            else:
+                # init SHARDED: no single device ever holds the full tree
+                # (the whole point of tp for models beyond one chip's HBM)
+                self.params = jax.jit(lambda k: init_params(config, k), out_shardings=param_sh)(
+                    jax.random.PRNGKey(seed)
+                )
+            self.cache = jax.jit(lambda: kvc.alloc(cache_cfg), out_shardings=cache_sh)()
         B = self.max_num_seqs
         # per-slot device-side sampling state
         self._temps = np.zeros((B,), np.float32)
@@ -125,6 +139,36 @@ class LLMEngine:
         self._requests: dict[str, RequestState] = {}
         self._lock = threading.Lock()
         self._auto_id = 0
+
+    def _mesh_shardings(self, mesh):
+        """Tensor-parallel serving (reference capability: the vLLM engine's
+        tensor_parallel_size, llm/_internal/serve/engines/vllm/
+        vllm_models.py:215-228 — here expressed as GSPMD shardings, no
+        NCCL): weights shard by the model's logical axes (heads/kv_heads/
+        mlp/vocab -> tp), the KV cache shards its kv_heads dim, and the
+        SAME jitted prefill/decode programs compile SPMD over the mesh —
+        XLA inserts the tp collectives on ICI."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_tpu.models.llama import param_logical_axes
+        from ray_tpu.parallel.mesh import ShardingRules, axis_or_none, mesh_axes
+
+        tp = axis_or_none(mesh, "tp")
+        tp_size = mesh_axes(mesh).get("tp", 1)
+        if self.config.num_kv_heads % max(tp_size, 1) != 0:
+            raise ValueError(
+                f"num_kv_heads ({self.config.num_kv_heads}) must divide by tp ({tp_size}) to shard the KV cache"
+            )
+        rules = ShardingRules()
+        param_sh = jax.tree.map(
+            lambda axes: NamedSharding(mesh, rules.spec(axes, mesh)),
+            param_logical_axes(self.config),
+            is_leaf=lambda x: isinstance(x, tuple),
+        )
+        kv_s = NamedSharding(mesh, P(None, None, None, tp, None))
+        cache_sh = {"k": kv_s, "v": kv_s, "length": NamedSharding(mesh, P())}
+        return param_sh, cache_sh
 
     # ------------------------------------------------------------- admission
 
@@ -284,7 +328,13 @@ class LLMEngine:
 
     def generate(self, prompts, params: SamplingParams | list | None = None) -> list[RequestOutput]:
         """Blocking batch generation with continuous batching underneath."""
-        single = isinstance(prompts[0], int)
+        import numbers
+
+        if len(prompts) == 0:
+            return []
+        # a single prompt is a sequence of token ids — including numpy
+        # integer ids from tokenizers/arrays, hence Integral not int
+        single = isinstance(prompts[0], numbers.Integral)
         if single:
             prompts = [prompts]
         if params is None or isinstance(params, SamplingParams):

@@ -117,10 +117,11 @@ class HTTPProxy:
                     if self._wants_stream(req):
                         self._stream(handle.options(stream=True).remote(req), timeout)
                         return
+                    resp = handle.remote(req)
                     try:
-                        result = handle.remote(req).result(timeout_s=timeout)
+                        result = resp.result(timeout_s=timeout)
                     except ray_tpu.exceptions.GetTimeoutError:
-                        # result() already cancelled the replica task
+                        resp.cancel()  # deadline is final at the proxy
                         self._respond(504, {"error": f"request exceeded {timeout}s"})
                         return
                     self._respond(200, result)

@@ -40,8 +40,10 @@ class _Batcher:
         import concurrent.futures
 
         fut = concurrent.futures.Future()
-        self._ensure_thread()
+        # enqueue BEFORE ensuring the thread: the idle-exit path re-checks
+        # queue emptiness under the same lock, so the item can't strand
         self._q.put((bound_args, fut))
+        self._ensure_thread()
         return fut
 
     def _ensure_thread(self):
@@ -64,7 +66,22 @@ class _Batcher:
         import time
 
         while True:
-            bound_args, fut = self._q.get()
+            try:
+                bound_args, fut = self._q.get(timeout=30.0)
+            except queue.Empty:
+                # idle exit so short-lived instances don't each pin a
+                # thread forever; submit() restarts on demand
+                with self._started:
+                    if self._q.empty():
+                        self._thread = None
+                        if self._loop is not None:
+                            try:
+                                self._loop.call_soon_threadsafe(self._loop.stop)
+                            except Exception:
+                                pass
+                            self._loop = None
+                        return
+                continue
             batch = [(bound_args, fut)]
             deadline = time.monotonic() + self._wait_s
             while len(batch) < self._max:

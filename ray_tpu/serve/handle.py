@@ -42,13 +42,18 @@ class DeploymentResponse:
             self._router._on_done(self._replica_id, self._ref)
 
     def result(self, timeout_s: float | None = None):
+        """A timeout raises but does NOT cancel (matching the reference:
+        poll-with-timeout keeps the request running; call cancel() to
+        abort)."""
         try:
-            return ray_tpu.get(self._ref, timeout=timeout_s)
-        except ray_tpu.exceptions.GetTimeoutError:
-            self.cancel()
-            raise
-        finally:
+            v = ray_tpu.get(self._ref, timeout=timeout_s)
             self._settle()
+            return v
+        except ray_tpu.exceptions.GetTimeoutError:
+            raise  # still in flight: slot stays held until done/cancelled
+        except BaseException:
+            self._settle()
+            raise
 
     def cancel(self):
         """Best-effort cancellation (reference: DeploymentResponse.cancel):
@@ -74,18 +79,42 @@ class DeploymentResponseGenerator:
         self._replica_id = replica_id
         self._gen = gen
         self._done = False
+        self._exhausted = False
         self.item_timeout_s: float | None = None
 
     def __iter__(self):
         try:
-            for item_ref in self._gen:
+            while True:
+                try:
+                    # bounds the wait for the NEXT item too, not just the
+                    # fetch of a produced one
+                    item_ref = self._gen.next_ref(timeout_s=self.item_timeout_s)
+                except StopIteration:
+                    self._exhausted = True
+                    break
                 yield ray_tpu.get(item_ref, timeout=self.item_timeout_s)
         finally:
             self._settle()
 
+    def cancel(self):
+        """Stop the replica-side generator (cooperative: it halts between
+        yields) and release the router slot."""
+        try:
+            ray_tpu.cancel(ray_tpu.ObjectRef(self._gen.generator_id))
+        except Exception:
+            pass
+        self._done = True
+        self._router._on_done(self._replica_id, self._gen)
+
     def _settle(self):
         if not self._done:
             self._done = True
+            if not self._exhausted:
+                # abandoned/aborted mid-stream: stop the producer too
+                try:
+                    ray_tpu.cancel(ray_tpu.ObjectRef(self._gen.generator_id))
+                except Exception:
+                    pass
             self._router._on_done(self._replica_id, self._gen)
 
     def __del__(self):

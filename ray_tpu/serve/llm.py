@@ -30,9 +30,16 @@ class LLMConfig:
     params: object = None  # optional pretrained pytree
     engine_kwargs: dict = field(default_factory=dict)  # max_num_seqs, ...
     num_replicas: int = 1
-    num_tpus_per_replica: float = 0
+    # -1 = auto: tensor_parallel_size chips when tp > 1, else none.
+    # Explicit 0 opts out (CPU-mesh testing).
+    num_tpus_per_replica: float = -1
     autoscaling_config: object = None  # serve.AutoscalingConfig
     max_ongoing_requests: int = 32
+    # TP-sharded engine: the replica builds a tp mesh over this many of
+    # its visible devices and the engine compiles SPMD over it (reference
+    # capability: vllm_models.py:215-228 tensor_parallel_size). Also sets
+    # the replica's TPU resource request when num_tpus_per_replica is 0.
+    tensor_parallel_size: int = 1
 
 
 class LLMServer:
@@ -46,7 +53,18 @@ class LLMServer:
             from ray_tpu.models.llama import LlamaConfig
 
             cfg = LlamaConfig.tiny(dtype="float32")
-        self.engine = LLMEngine(cfg, params=llm_config.params, **llm_config.engine_kwargs)
+        engine_kwargs = dict(llm_config.engine_kwargs)
+        tp = int(llm_config.tensor_parallel_size or 1)
+        if tp > 1 and "mesh" not in engine_kwargs:
+            import jax
+
+            from ray_tpu.parallel.mesh import create_mesh
+
+            devices = jax.devices()
+            if len(devices) < tp:
+                raise ValueError(f"tensor_parallel_size={tp} but replica sees {len(devices)} devices")
+            engine_kwargs["mesh"] = create_mesh(tp=tp, devices=devices[:tp])
+        self.engine = LLMEngine(cfg, params=llm_config.params, **engine_kwargs)
         self._done: dict[str, object] = {}  # request_id -> RequestOutput
         self._events: dict[str, threading.Event] = {}
         self._lock = threading.Lock()
@@ -155,7 +173,12 @@ def build_llm_deployment(llm_config: LLMConfig, *, name: str = "LLMServer"):
         opts["autoscaling_config"] = llm_config.autoscaling_config
     else:
         opts["num_replicas"] = llm_config.num_replicas
-    if llm_config.num_tpus_per_replica:
-        opts["num_tpus"] = llm_config.num_tpus_per_replica  # ReplicaConfig field
+    num_tpus = llm_config.num_tpus_per_replica
+    if num_tpus < 0:
+        # auto: a TP replica gang-reserves its chips (reference: vLLM
+        # replicas request tensor_parallel_size accelerators via their PG)
+        num_tpus = float(llm_config.tensor_parallel_size) if llm_config.tensor_parallel_size > 1 else 0.0
+    if num_tpus:
+        opts["num_tpus"] = num_tpus  # ReplicaConfig field
     deployment = serve.deployment(**opts)(LLMServer)
     return deployment.bind(llm_config)

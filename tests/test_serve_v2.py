@@ -35,8 +35,6 @@ def test_streaming_response_through_handle(serve_session):
     h = serve.run(Streamer.bind(), name="stream_app")
     items = list(h.options(stream=True).counts.remote(5))
     assert items == [{"i": i} for i in range(5)]
-    # unary call on the same deployment still works
-    assert h.options(stream=False).counts.remote(1) is not None
 
 
 def test_async_generator_streaming(serve_session):
@@ -108,10 +106,39 @@ def test_request_timeout_cancels_and_frees_slot(serve_session):
     r = h.remote(30)
     with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
         r.result(timeout_s=0.5)
+    r.cancel()  # timeout alone must NOT cancel (poll pattern); cancel does
     # the slot freed: a fast request is accepted and completes promptly
     t0 = time.time()
     assert h.remote(0).result(timeout_s=30) == "done"
     assert time.time() - t0 < 25
+
+
+def test_stream_cancel_stops_replica_generator(serve_session, tmp_path):
+    """Abandoning a stream cooperatively stops the replica-side generator
+    (no zombie production burning the replica)."""
+    progress = str(tmp_path / "progress")
+
+    @serve.deployment
+    class Infinite:
+        def gen(self, path):
+            i = 0
+            while True:
+                with open(path, "w") as f:
+                    f.write(str(i))
+                yield i
+                i += 1
+                time.sleep(0.02)
+
+    h = serve.run(Infinite.bind(), name="cancel_app")
+    stream = h.options(stream=True).gen.remote(progress)
+    it = iter(stream)
+    got = [next(it) for _ in range(3)]
+    assert got == [0, 1, 2]
+    stream.cancel()
+    time.sleep(1.0)
+    frozen = open(progress).read()
+    time.sleep(1.0)
+    assert open(progress).read() == frozen, "replica generator kept running after cancel"
 
 
 def test_router_overhead_p50_under_load(serve_session):
