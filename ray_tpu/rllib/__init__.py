@@ -14,6 +14,12 @@ Public surface:
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm  # noqa: F401
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.impala.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rllib.core.rl_module import MLPModule, RLModule, RLModuleSpec  # noqa: F401
+from ray_tpu.rllib.env.multi_agent import MultiAgentEnv, MultiAgentEnvRunner  # noqa: F401
+from ray_tpu.rllib.utils.replay_buffers import (  # noqa: F401
+    EpisodeReplayBuffer,
+    PrioritizedEpisodeReplayBuffer,
+)

@@ -1,0 +1,214 @@
+"""DQN (reference: rllib/algorithms/dqn/dqn.py DQNConfig + training_step;
+loss in rllib/algorithms/dqn/torch/dqn_torch_learner.py).
+
+Off-policy Q-learning over an episode replay buffer: env runners fill the
+buffer continuously; the learner draws uniform or prioritized transition
+batches and takes jitted double-Q TD steps against a periodically-synced
+target network. Exploration is epsilon-greedy in the env runner (the
+Q-module's action "distribution").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import MLPModule
+from ray_tpu.rllib.utils.replay_buffers import EpisodeReplayBuffer, PrioritizedEpisodeReplayBuffer
+
+
+def make_epsilon_greedy(epsilon: float):
+    """Epsilon-greedy as a distribution over Q-values (the runner's
+    sample() hook; reference: EpsilonGreedy exploration)."""
+
+    class EpsilonGreedy:
+        eps = float(epsilon)
+
+        @staticmethod
+        def sample(key, q_values):
+            k1, k2 = jax.random.split(key)
+            greedy = jnp.argmax(q_values, axis=-1)
+            rand = jax.random.randint(k1, greedy.shape, 0, q_values.shape[-1])
+            explore = jax.random.uniform(k2, greedy.shape) < EpsilonGreedy.eps
+            return jnp.where(explore, rand, greedy)
+
+        @staticmethod
+        def logp(q_values, actions):
+            n = q_values.shape[-1]
+            greedy = jnp.argmax(q_values, axis=-1)
+            p = jnp.where(actions == greedy, 1.0 - EpsilonGreedy.eps + EpsilonGreedy.eps / n, EpsilonGreedy.eps / n)
+            return jnp.log(p)
+
+        @staticmethod
+        def deterministic(q_values):
+            return jnp.argmax(q_values, axis=-1)
+
+        @staticmethod
+        def entropy(q_values):
+            return jnp.zeros(q_values.shape[:-1])
+
+    return EpsilonGreedy
+
+
+class QModule(MLPModule):
+    """MLP Q-network: action_dist_inputs ARE the Q-values; exploration is
+    epsilon-greedy over them."""
+
+    def __init__(self, observation_space, action_space, model_config=None):
+        assert hasattr(action_space, "n"), "DQN requires a Discrete action space"
+        super().__init__(observation_space, action_space, model_config)
+        self.action_dist_cls = make_epsilon_greedy(self.model_config.get("epsilon", 0.1))
+
+    def init(self, key):
+        return {"q": self._mlp_init(key, (self.obs_dim, *self.hiddens, self.out_dim), final_scale=0.01)}
+
+    def forward(self, params, obs):
+        obs = obs.reshape(obs.shape[0], -1).astype(jnp.float32)
+        q = self._mlp_apply(params["q"], obs)
+        return {"action_dist_inputs": q, "vf": jnp.max(q, axis=-1)}
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.train_batch_size = 64
+        self.replay_buffer_capacity = 50_000
+        self.prioritized_replay = False
+        self.prioritized_alpha = 0.6
+        self.prioritized_beta = 0.4
+        self.num_steps_sampled_before_learning_starts = 500
+        self.target_network_update_freq = 500  # env steps between target syncs
+        self.double_q = True
+        # epsilon-greedy schedule: linear initial -> final over
+        # epsilon_timesteps env steps (reference: DQNConfig.epsilon
+        # [[0, 1.0], [10000, 0.05]] piecewise schedule)
+        self.initial_epsilon = 1.0
+        self.final_epsilon = 0.05
+        self.epsilon_timesteps = 10_000
+        self.rollout_fragment_length = 64
+        self.train_intensity = 2.0  # learner sgd steps per env step / batch size
+        self.module_class = QModule
+
+    @property
+    def algo_class(self):
+        return DQN
+
+
+class DQNLearner(Learner):
+    """Jitted (double-)Q TD step against target params. The target tree is
+    an ARGUMENT of the jitted grad (it changes across updates), not a
+    closure capture."""
+
+    def build(self, seed: int = 0):
+        super().build(seed)
+        self.target_params = jax.tree.map(jnp.array, self.params)
+
+        def td_loss(params, target_params, batch):
+            cfg = self.config
+            q = self.module.forward(params, batch["obs"])["action_dist_inputs"]
+            q_taken = jnp.take_along_axis(q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+            q_next_target = self.module.forward(target_params, batch["next_obs"])["action_dist_inputs"]
+            if cfg.double_q:
+                # online net picks the argmax, target net evaluates it
+                q_next_online = self.module.forward(params, batch["next_obs"])["action_dist_inputs"]
+                next_a = jnp.argmax(q_next_online, axis=-1)
+                q_next = jnp.take_along_axis(q_next_target, next_a[:, None], axis=-1)[:, 0]
+            else:
+                q_next = jnp.max(q_next_target, axis=-1)
+            target = batch["rewards"] + cfg.gamma * (1.0 - batch["done"]) * jax.lax.stop_gradient(q_next)
+            td = q_taken - target
+            weights = batch.get("weights", jnp.ones_like(td))
+            loss = jnp.mean(weights * jnp.square(td))
+            return loss, {"total_loss": loss, "qf_mean": jnp.mean(q_taken), "td_abs": jnp.abs(td)}
+
+        self._td_grad = jax.jit(jax.grad(td_loss, has_aux=True))
+
+    def update_dqn(self, batch: dict) -> tuple[dict, np.ndarray]:
+        """One TD step; returns (metrics, |td| per row for priorities)."""
+        mb = {k: jnp.asarray(v) for k, v in batch.items() if k != "batch_indices"}
+        grads, aux = self._td_grad(self.params, self.target_params, mb)
+        grads = self._sync_grads(grads)
+        self.params, self.opt_state = self._apply_fn(self.params, self.opt_state, grads)
+        td_abs = np.asarray(aux.pop("td_abs"))
+        return {k: float(v) for k, v in aux.items()}, td_abs
+
+    def sync_target(self):
+        self.target_params = jax.tree.map(jnp.array, self.params)
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        state["target_params"] = jax.tree.map(np.asarray, self.target_params)
+        return state
+
+    def set_state(self, state: dict):
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = jax.tree.map(jnp.asarray, state["target_params"])
+
+
+class DQN(Algorithm):
+    learner_cls = DQNLearner
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._total_env_steps / max(1, cfg.epsilon_timesteps))
+        return cfg.initial_epsilon + frac * (cfg.final_epsilon - cfg.initial_epsilon)
+
+    def setup(self):
+        cfg = self.config
+        cfg.model = {**cfg.model, "epsilon": cfg.initial_epsilon}
+        if cfg.num_learners > 0:
+            raise NotImplementedError("DQN runs a single (local) learner; scale sampling with num_env_runners")
+        super().setup()
+        if cfg.prioritized_replay:
+            self.replay = PrioritizedEpisodeReplayBuffer(
+                cfg.replay_buffer_capacity, alpha=cfg.prioritized_alpha, beta=cfg.prioritized_beta, seed=cfg.seed
+            )
+        else:
+            self.replay = EpisodeReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
+        self._steps_since_target_sync = 0
+
+    @property
+    def _learner(self) -> DQNLearner:
+        return self.learner_group._local
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        eps = self._epsilon()
+        self.env_runner_group.set_exploration(eps=eps)
+        segments, runner_metrics = self.env_runner_group.sample(cfg.rollout_fragment_length)
+        row_ids = []
+        for seg in segments:
+            row_ids.extend(self.replay.add(seg))
+        new_steps = len(row_ids)
+        self._total_env_steps += new_steps
+        self._steps_since_target_sync += new_steps
+
+        result = self._merge_runner_metrics(runner_metrics)
+        if self._total_env_steps < cfg.num_steps_sampled_before_learning_starts or len(self.replay) < cfg.train_batch_size:
+            # warmup: no update ran, so weights are unchanged — skip the
+            # (potentially multi-actor) re-broadcast
+            result["learner"] = {"num_updates": 0}
+            result["epsilon"] = eps
+            return result
+
+        num_updates = max(1, int(new_steps * cfg.train_intensity / cfg.train_batch_size))
+        metrics = {}
+        for _ in range(num_updates):
+            batch = self.replay.sample(cfg.train_batch_size)
+            metrics, td_abs = self._learner.update_dqn(batch)
+            if cfg.prioritized_replay:
+                self.replay.update_priorities(batch["batch_indices"], td_abs)
+        if self._steps_since_target_sync >= cfg.target_network_update_freq:
+            self._learner.sync_target()
+            self._steps_since_target_sync = 0
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        result["learner"] = {"num_updates": num_updates, **metrics}
+        result["num_env_steps_sampled_lifetime"] = self._total_env_steps
+        result["epsilon"] = eps
+        return result

@@ -69,6 +69,15 @@ class SingleAgentEnvRunner:
     def set_weights(self, params):
         self.params = jax.tree.map(self._put, params)
 
+    def set_exploration(self, **kw):
+        """Push exploration knobs (e.g. an annealed epsilon) onto the
+        module's action-distribution class (reference: exploration config
+        updates pushed to workers)."""
+        cls = self.module.action_dist_cls
+        for k, v in kw.items():
+            if hasattr(cls, k):
+                setattr(cls, k, v)
+
     def get_spaces(self):
         return self.envs.single_observation_space, self.envs.single_action_space
 
@@ -175,6 +184,12 @@ class EnvRunnerGroup:
             self._local.set_weights(params)
         else:
             ray_tpu.get([a.set_weights.remote(params) for a in self._actors])
+
+    def set_exploration(self, **kw):
+        if self._local is not None:
+            self._local.set_exploration(**kw)
+        else:
+            ray_tpu.get([a.set_exploration.remote(**kw) for a in self._actors])
 
     def sample(self, num_steps: int, explore: bool = True):
         """Returns (all segment batches, per-runner metrics list)."""

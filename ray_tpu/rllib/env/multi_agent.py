@@ -1,0 +1,179 @@
+"""Multi-agent environments + per-policy rollout collection.
+
+Reference parity: rllib/env/multi_agent_env.py (the dict-keyed env
+protocol with the "__all__" done flag) and
+rllib/env/multi_agent_env_runner.py (one runner stepping all agents,
+routing each agent's experience to its policy's module via
+policy_mapping_fn). Per-policy batches feed independent jitted learners —
+the TPU-native analogue of the reference's MultiRLModule update.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env.episode import Episode
+
+
+class MultiAgentEnv:
+    """Dict-keyed env protocol (reference: multi_agent_env.py):
+
+    reset() -> (obs_dict, info_dict)
+    step(action_dict) -> (obs, rewards, terminateds, truncateds, infos),
+    each keyed by agent id; terminateds/truncateds carry "__all__".
+    Agents may appear/disappear between steps (only act for present ids).
+    """
+
+    possible_agents: list = []
+    observation_spaces: dict = {}
+    action_spaces: dict = {}
+
+    def reset(self, *, seed=None, options=None):
+        raise NotImplementedError
+
+    def step(self, action_dict: dict):
+        raise NotImplementedError
+
+
+class MultiAgentEnvRunner:
+    """Steps one multi-agent env; each agent's actions come from the
+    module of the policy its id maps to; experience is routed back into
+    per-policy episode segments (reference: multi_agent_env_runner.py
+    sample())."""
+
+    def __init__(self, env_factory, module_specs: dict, policy_mapping_fn=None, seed: int = 0, worker_idx: int = 0):
+        self.env = env_factory() if callable(env_factory) else env_factory
+        self.modules = {pid: spec.build() for pid, spec in module_specs.items()}
+        self.policy_mapping_fn = policy_mapping_fn or (lambda agent_id: agent_id)
+        self.params: dict = {}
+        try:
+            self._device = jax.local_devices(backend="cpu")[0]
+        except Exception:
+            self._device = None
+        self._key = self._put(jax.random.PRNGKey(seed + 10_000 * worker_idx))
+        self._fwd = {pid: jax.jit(m.forward_exploration) for pid, m in self.modules.items()}
+        self._seed = seed + 10_000 * worker_idx
+        self._obs, _ = self.env.reset(seed=self._seed)
+        self._building: dict = {}  # agent_id -> Episode
+        self._done_agents: set = set()  # terminated before __all__: no more actions
+        self._episode_returns: list = []
+        for aid, o in self._obs.items():
+            ep = Episode()
+            ep.obs.append(np.asarray(o))
+            self._building[aid] = ep
+
+    def _put(self, x):
+        return jax.device_put(x, self._device) if self._device is not None else jnp.asarray(x)
+
+    def _on_device(self):
+        import contextlib
+
+        return jax.default_device(self._device) if self._device is not None else contextlib.nullcontext()
+
+    def set_weights(self, params_by_policy: dict):
+        self.params = {pid: jax.tree.map(self._put, p) for pid, p in params_by_policy.items()}
+
+    def sample(self, num_steps: int, explore: bool = True) -> tuple[dict, dict]:
+        """Collect ~num_steps env steps. Returns
+        ({policy_id: [episode batches]}, metrics). Rollout math is pinned
+        to the CPU device (a remote-TPU default would turn each env step
+        into a network round trip)."""
+        with self._on_device():
+            return self._sample(num_steps, explore)
+
+    def _sample(self, num_steps: int, explore: bool = True) -> tuple[dict, dict]:
+        assert self.params, "set_weights before sample"
+        out_segments: dict[str, list] = defaultdict(list)
+        episodes_done = 0
+        returns: list[float] = []
+        for _ in range(num_steps):
+            # group LIVE agents by policy for batched forwards (an agent
+            # terminated before __all__ takes no further actions)
+            by_policy: dict[str, list] = defaultdict(list)
+            for aid in self._obs:
+                if aid not in self._done_agents:
+                    by_policy[self.policy_mapping_fn(aid)].append(aid)
+            if not by_policy:
+                # everyone done but env never raised __all__: reset
+                self._seed += 1
+                self._obs, _ = self.env.reset(seed=self._seed)
+                self._done_agents.clear()
+                self._building = {}
+                for aid, o in self._obs.items():
+                    ep = Episode()
+                    ep.obs.append(np.asarray(o))
+                    self._building[aid] = ep
+                continue
+            actions: dict = {}
+            step_info: dict = {}
+            for pid, aids in by_policy.items():
+                obs_arr = jnp.asarray(np.stack([np.asarray(self._obs[a], np.float32) for a in aids]))
+                fwd = self._fwd[pid](self.params[pid], obs_arr)
+                dist = self.modules[pid].action_dist_cls
+                inputs = fwd["action_dist_inputs"]
+                if explore:
+                    self._key, k = jax.random.split(self._key)
+                    acts = dist.sample(k, inputs)
+                else:
+                    acts = dist.deterministic(inputs)
+                logp = np.asarray(dist.logp(inputs, acts))
+                vf = np.asarray(fwd["vf"])
+                acts = np.asarray(acts)
+                for i, a in enumerate(aids):
+                    actions[a] = acts[i]
+                    step_info[a] = (float(logp[i]), float(vf[i]))
+            obs, rewards, terms, truncs, _ = self.env.step(actions)
+            done_all = bool(terms.get("__all__", False) or truncs.get("__all__", False))
+            for aid, act in actions.items():
+                ep = self._building.get(aid)
+                if ep is None:
+                    continue
+                lp, v = step_info[aid]
+                ep.actions.append(act)
+                ep.rewards.append(float(rewards.get(aid, 0.0)))
+                ep.logp.append(lp)
+                ep.vf_preds.append(v)
+                nxt = obs.get(aid, ep.obs[-1])
+                ep.obs.append(np.asarray(nxt))
+                if terms.get(aid, False) or truncs.get(aid, False) or done_all:
+                    ep.is_terminated = bool(terms.get(aid, False) or terms.get("__all__", False))
+                    out_segments[self.policy_mapping_fn(aid)].append(ep)
+                    returns.append(ep.total_reward)
+                    self._building.pop(aid, None)
+                    if not done_all:
+                        self._done_agents.add(aid)  # dead until the episode ends
+            if done_all:
+                episodes_done += 1
+                self._seed += 1
+                self._obs, _ = self.env.reset(seed=self._seed)
+                self._building = {}
+                self._done_agents.clear()
+            else:
+                self._obs = obs
+            for aid, o in self._obs.items():
+                if aid not in self._building and aid not in self._done_agents:
+                    ep = Episode()
+                    ep.obs.append(np.asarray(o))
+                    self._building[aid] = ep
+        # cut still-running agent episodes (bootstrap from last obs)
+        for aid, ep in list(self._building.items()):
+            if len(ep) > 0:
+                out_segments[self.policy_mapping_fn(aid)].append(ep)
+                fresh = Episode()
+                fresh.obs.append(ep.obs[-1])
+                self._building[aid] = fresh
+        metrics = {
+            "episode_return_mean": float(np.mean(returns)) if returns else float("nan"),
+            "num_episodes": episodes_done,
+        }
+        return {pid: [s.to_batch() for s in segs] for pid, segs in out_segments.items()}, metrics
+
+
+@ray_tpu.remote
+class MultiAgentEnvRunnerActor(MultiAgentEnvRunner):
+    pass
