@@ -11,14 +11,18 @@ copy on ICI/DCN), and the whole (M + n - 1)-tick loop is a `lax.scan`
 that jax.grad differentiates into the reverse pipeline automatically.
 
 Design:
-- layer-stacked params [L, ...] are reshaped to [n_stages, L/n, ...] and
-  sharded `P('pp')` on the leading dim: each device materializes only its
-  own stage's weights (the pp memory win).
-- the batch is split into M microbatches. At tick t, stage 0 feeds
-  microbatch t (while t < M); every stage applies its L/n layers to its
-  current activation; the result hops to the next stage. After n-1 warmup
-  ticks the pipe is full; total ticks = M + n - 1, bubble fraction
-  (n-1)/(M+n-1).
+- layer-stacked params [L, ...] are reshaped to [n_stages, v, L/(n*v), ...]
+  (v = virtual_stages, 1 for GPipe) and sharded `P('pp')` on the leading
+  dim: each device materializes only its own chunks' weights (the pp
+  memory win). With v > 1 the chunks are placed round-robin: device d
+  owns model chunks d, d+n, ..., d+(v-1)n.
+- the batch is split into M microbatches. GPipe (v=1): at tick t, stage 0
+  feeds microbatch t; every stage applies its L/n layers; the result hops
+  to the next stage; total ticks = M + n - 1, bubble fraction
+  (n-1)/(M+n-1). Interleaved (v>1): the activation stream rides a RING
+  (wraparound n-1 -> 0 between chunk rounds); total ticks = M*v + n - 1
+  in 1/v-sized chunk-times, so the fill/drain bubble shrinks to
+  (n-1)/v stage-times — the Megatron-style virtual-pipeline schedule.
 - shard_map is manual ONLY over `pp` (`axes` arg) — dp/fsdp/tp stay
   auto, so XLA still shards batch/params inside each stage exactly as in
   the non-pp program.
@@ -44,21 +48,38 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def to_stage_stacked(layer_params, n_stages: int):
-    """[L, ...]-stacked layer params -> [n_stages, L/n, ...]."""
+def to_stage_stacked(layer_params, n_stages: int, virtual_stages: int = 1):
+    """[L, ...]-stacked layer params -> [n_stages, v, L/(n*v), ...].
+
+    With virtual_stages v > 1 (interleaved schedule), device d owns model
+    chunks d, d+n, ..., d+(v-1)n — round-robin layer placement, so chunk
+    r on device d covers layers [(r*n + d) * L/(nv), ...). Dim 0 shards
+    P('pp'); dim 1 indexes the device's local chunk round."""
 
     def reshape(leaf):
         L = leaf.shape[0]
-        if L % n_stages:
-            raise ValueError(f"num_layers {L} not divisible by pp={n_stages}")
-        return leaf.reshape(n_stages, L // n_stages, *leaf.shape[1:])
+        if L % (n_stages * virtual_stages):
+            raise ValueError(f"num_layers {L} not divisible by pp*virtual = {n_stages}*{virtual_stages}")
+        per = L // (n_stages * virtual_stages)
+        # chunk k covers layers [k*per, (k+1)*per); chunk k lives on
+        # device k % n as local round k // n
+        chunked = leaf.reshape(n_stages * virtual_stages, per, *leaf.shape[1:])
+        return (
+            chunked.reshape(virtual_stages, n_stages, per, *leaf.shape[1:])
+            .swapaxes(0, 1)  # [n, v, per, ...]
+        )
 
     return jax.tree.map(reshape, layer_params)
 
 
 def from_stage_stacked(layer_params):
-    """[n_stages, L/n, ...] -> [L, ...]."""
-    return jax.tree.map(lambda leaf: leaf.reshape(leaf.shape[0] * leaf.shape[1], *leaf.shape[2:]), layer_params)
+    """[n_stages, v, L/(n*v), ...] -> [L, ...] (inverse chunk layout)."""
+
+    def restore(leaf):
+        n, v, per = leaf.shape[:3]
+        return leaf.swapaxes(0, 1).reshape(n * v * per, *leaf.shape[3:])
+
+    return jax.tree.map(restore, layer_params)
 
 
 def pipeline_apply(
@@ -68,60 +89,88 @@ def pipeline_apply(
     mesh: Mesh,
     layer_fn: Callable,
     num_microbatches: int,
+    virtual_stages: int = 1,
     axis_name: str = "pp",
 ):
-    """Run stage-stacked layers over x with GPipe microbatch pipelining.
+    """Run stage-stacked layers over x with microbatch pipelining.
 
-    stage_params: pytree with leading [n_stages, L/n, ...] dims, sharded
-      P('pp') on dim 0. layer_fn(x, layer) applies ONE layer.
+    virtual_stages=1 is the GPipe schedule: M microbatches flow through n
+    device-stages; bubble fraction (n-1)/(M+n-1) in stage-time units.
+
+    virtual_stages=v>1 is the INTERLEAVED schedule (Megatron-style virtual
+    pipeline, reference capability: compiled multi-stage pipelines in
+    dag/compiled_dag_node.py): device d owns model chunks d, d+n, ...,
+    d+(v-1)n, each 1/v of a stage. Microbatch m of group g runs chunk
+    round r on device d at tick d + g*v*n + r*n + m; the activation ring
+    (ppermute with wraparound n-1 -> 0) hands off with zero idle ticks,
+    so total ticks = M*v + (n-1) CHUNK-times — the pipeline fill/drain
+    costs (n-1)/v stage-times instead of GPipe's (n-1): the bubble
+    shrinks by the virtual-stage factor. Requires M % n == 0 (microbatch
+    groups of n keep every device on exactly one chunk per tick).
+
+    stage_params: pytree with leading [n_stages, v, L/(n*v), ...] dims,
+      sharded P('pp') on dim 0. layer_fn(x, layer) applies ONE layer.
     x: [B, ...] activations (NOT sharded over pp).
-    Returns [B, ...] outputs (replicated over pp, identical on every
-    stage after the closing psum).
+    Returns [B, ...] outputs (replicated over pp after the closing psum).
     """
     n = mesh.shape[axis_name]
     B = x.shape[0]
     M = num_microbatches
+    v = int(virtual_stages)
     if B % M:
         raise ValueError(f"batch {B} not divisible by num_microbatches {M}")
+    if v > 1 and M % n:
+        raise ValueError(f"interleaved schedule needs num_microbatches ({M}) divisible by pp ({n})")
     mb = B // M
     x_mb = x.reshape(M, mb, *x.shape[1:])
 
     def local(stage_p, xs):
-        # stage_p: [1, L/n, ...] (this device's stage); xs: [M, mb, ...]
+        # stage_p: [1, v, L/(n*v), ...] (this device's chunks); xs: [M, mb, ...]
         my = lax.axis_index(axis_name)
-        stage_p = jax.tree.map(lambda t: t[0], stage_p)
+        stage_p = jax.tree.map(lambda t: t[0], stage_p)  # [v, per, ...]
 
-        def apply_stage(act):
+        def apply_chunk(act, r):
+            chunk = jax.tree.map(lambda t: lax.dynamic_index_in_dim(t, r, axis=0, keepdims=False), stage_p)
+
             def body(carry, layer):
                 return layer_fn(carry, layer), None
 
-            out, _ = lax.scan(body, act, stage_p)
+            out, _ = lax.scan(body, act, chunk)
             return out
 
-        shift_perm = [(i, i + 1) for i in range(n - 1)]  # a shift, not a ring
+        # interleaved: a ring — device n-1's output wraps to device 0 as
+        # the next chunk round's input. GPipe (v=1) never reads the
+        # wrapped value, so drop that edge and save the hop.
+        ring_perm = [(i, (i + 1) % n) for i in range(n if v > 1 else n - 1)]
+        jobs = M * v  # chunk applications per device
 
         def tick(carry, t):
             state, outputs = carry
-            # stage 0 ingests microbatch t (clamped once the feed is done);
-            # later stages consume what the previous stage sent last tick
-            feed = lax.dynamic_index_in_dim(xs, jnp.minimum(t, M - 1), axis=0, keepdims=False)
-            inp = jnp.where(my == 0, feed, state)
-            out = apply_stage(inp)
-            # last stage banks microbatch t-(n-1) once the pipe is primed
-            oidx = jnp.clip(t - (n - 1), 0, M - 1)
-            bank = jnp.logical_and(my == n - 1, t >= n - 1)
-            cur = lax.dynamic_index_in_dim(outputs, oidx, axis=0, keepdims=False)
+            j = jnp.clip(t - my, 0, jobs - 1)  # this device's job index
+            active = jnp.logical_and(t >= my, t - my < jobs)
+            g = j // (v * n)  # microbatch group
+            jj = j % (v * n)
+            r = jj // n  # chunk round
+            m = jj % n  # member within the group
+            mb_idx = jnp.minimum(g * n + m, M - 1)
+            feed = lax.dynamic_index_in_dim(xs, mb_idx, axis=0, keepdims=False)
+            inp = jnp.where(jnp.logical_and(my == 0, r == 0), feed, state)
+            out = apply_chunk(inp, r)
+            # the final logical stage (chunk round v-1 on device n-1)
+            # banks its microbatch's output
+            bank = jnp.logical_and(jnp.logical_and(my == n - 1, r == v - 1), active)
+            cur = lax.dynamic_index_in_dim(outputs, mb_idx, axis=0, keepdims=False)
             outputs = lax.dynamic_update_index_in_dim(
-                outputs, jnp.where(bank, out, cur), oidx, axis=0
+                outputs, jnp.where(bank, out, cur), mb_idx, axis=0
             )
-            state = lax.ppermute(out, axis_name, shift_perm) if n > 1 else out
+            state = lax.ppermute(out, axis_name, ring_perm) if n > 1 else out
             return (state, outputs), None
 
         init = jax.tree.map(
             lambda t: lax.pvary(t, (axis_name,)),
             (jnp.zeros_like(xs[0]), jnp.zeros_like(xs)),
         )
-        (_, outputs), _ = lax.scan(tick, init, jnp.arange(M + n - 1))
+        (_, outputs), _ = lax.scan(tick, init, jnp.arange(M * v + n - 1))
         # only the last stage holds real outputs; psum broadcasts them so
         # the (auto-sharded) unembed/loss outside sees one consistent value.
         # f32 for the wire: XLA's bf16 all-reduce promotion pass crashes on
@@ -143,28 +192,28 @@ def pipeline_apply(
 # ----------------------------------------------------------------------
 # Llama integration: pipelined forward/loss drop-ins
 # ----------------------------------------------------------------------
-def pp_param_logical_axes(config, n_stages: int):
-    """param_logical_axes for pp: layer leaves are [n_stages, L/n, *dims],
-    logical axes ('stage', None, *per-layer axes)."""
+def pp_param_logical_axes(config, n_stages: int, virtual_stages: int = 1):
+    """param_logical_axes for pp: layer leaves are [n_stages, v, L/(n*v),
+    *dims], logical axes ('stage', None, None, *per-layer axes)."""
     from ray_tpu.models.llama import PARAM_AXES, param_logical_axes
 
     axes = param_logical_axes(config)
     axes["layers"] = {
-        k: ("stage", None) + tuple(v[1:]) for k, v in PARAM_AXES["layers"].items()
+        k: ("stage", None, None) + tuple(v[1:]) for k, v in PARAM_AXES["layers"].items()
     }
     return axes
 
 
-def pp_init_params(config, key, n_stages: int):
-    """init_params with the layer stack reshaped to [n_stages, L/n, ...]."""
+def pp_init_params(config, key, n_stages: int, virtual_stages: int = 1):
+    """init_params with the layer stack reshaped to [n_stages, v, L/(n*v), ...]."""
     from ray_tpu.models.llama import init_params
 
     params = init_params(config, key)
-    params["layers"] = to_stage_stacked(params["layers"], n_stages)
+    params["layers"] = to_stage_stacked(params["layers"], n_stages, virtual_stages)
     return params
 
 
-def pp_forward(params, tokens, config, mesh: Mesh, num_microbatches: int):
+def pp_forward(params, tokens, config, mesh: Mesh, num_microbatches: int, virtual_stages: int = 1):
     """Pipelined llama forward: embed -> pp pipeline over layers -> unembed."""
     from ray_tpu.models.llama import _layer_fn
     from ray_tpu.ops.layers import rms_norm, rotary_embedding
@@ -180,15 +229,16 @@ def pp_forward(params, tokens, config, mesh: Mesh, num_microbatches: int):
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
     x = pipeline_apply(
-        params["layers"], x, mesh=mesh, layer_fn=layer_fn, num_microbatches=num_microbatches
+        params["layers"], x, mesh=mesh, layer_fn=layer_fn,
+        num_microbatches=num_microbatches, virtual_stages=virtual_stages,
     )
     x = rms_norm(x, params["final_norm"], config.rms_eps)
     unembed = params["embed"].T if config.tie_embeddings else params["unembed"]
     return jnp.dot(x, unembed, preferred_element_type=jnp.float32)
 
 
-def pp_loss_fn(params, batch, config, mesh: Mesh, num_microbatches: int):
+def pp_loss_fn(params, batch, config, mesh: Mesh, num_microbatches: int, virtual_stages: int = 1):
     from ray_tpu.ops.layers import cross_entropy_loss
 
-    logits = pp_forward(params, batch["tokens"], config, mesh, num_microbatches)
+    logits = pp_forward(params, batch["tokens"], config, mesh, num_microbatches, virtual_stages)
     return cross_entropy_loss(logits, batch["targets"])

@@ -262,6 +262,113 @@ def test_pipeline_parallel_parity_and_training():
     assert wq.addressable_shards[0].data.nbytes * 4 == wq.nbytes  # 1/pp per device
 
 
+def test_interleaved_pipeline_parity_and_training():
+    """Interleaved (virtual-stage) schedule: device d owns chunks d, d+n,
+    ...; activation ring with zero-idle handoffs cuts the pipeline
+    fill/drain bubble by the virtual factor ((n-1)/v stage-times vs
+    GPipe's (n-1)). Logits and grads must match the plain model AND the
+    GPipe schedule exactly."""
+    import optax
+
+    from ray_tpu.parallel.pipeline import (
+        from_stage_stacked,
+        pp_forward,
+        pp_init_params,
+        pp_loss_fn,
+        pp_param_logical_axes,
+        to_stage_stacked,
+    )
+
+    cfg = LlamaConfig.tiny(num_layers=8, dtype="float32")
+    mesh = create_mesh(pp=2, dp=4)
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    v = 2  # 2 virtual stages x 2 devices = 4 chunks of 2 layers
+    pp_params = {**params, "layers": to_stage_stacked(params["layers"], 2, v)}
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 16)), jnp.int32)
+    targets = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 16)), jnp.int32)
+    batch = {"tokens": tokens, "targets": targets}
+
+    # round-robin layout roundtrip is lossless
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        from_stage_stacked(pp_params["layers"]),
+        params["layers"],
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(pp_forward(pp_params, tokens, cfg, mesh, num_microbatches=4, virtual_stages=v)),
+        np.asarray(forward(params, tokens, cfg)),
+        atol=1e-5,
+    )
+    g_ref = jax.grad(lambda p: loss_fn(p, batch, cfg))(params)
+    g_pp = jax.grad(lambda p: pp_loss_fn(p, batch, cfg, mesh, num_microbatches=4, virtual_stages=v))(pp_params)
+    g_pp = {**g_pp, "layers": from_stage_stacked(g_pp["layers"])}
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5),
+        g_ref,
+        g_pp,
+    )
+
+    # a full sharded train step converges under the interleaved schedule
+    init_fn, compile_step, _ = make_train_step(
+        partial(pp_loss_fn, config=cfg, mesh=mesh, num_microbatches=4, virtual_stages=v),
+        optax.adamw(1e-3),
+        mesh,
+        pp_param_logical_axes(cfg, 2, v),
+    )
+    state, shardings = init_fn(
+        jax.random.PRNGKey(0), partial(pp_init_params, cfg, n_stages=2, virtual_stages=v)
+    )
+    step = compile_step(shardings)
+    from ray_tpu.parallel.train_step import shard_batch as _sb
+
+    sbatch = _sb({"tokens": np.asarray(tokens), "targets": np.asarray(targets)}, mesh)
+    state, m0 = step(state, sbatch)
+    for _ in range(4):
+        state, m = step(state, sbatch)
+    assert float(m["loss"]) < float(m0["loss"])
+
+    # microbatch count must group by pp size under interleaving
+    with pytest.raises(ValueError, match="divisible by pp"):
+        pp_forward(pp_params, tokens, cfg, mesh, num_microbatches=1, virtual_stages=v)
+
+
+def test_pp_tp_long_sequence_head_sharded_attention():
+    """pp x sp is unsupported (ring attention owns its own manual region);
+    the documented fallback for long sequences in pipelined configs is
+    head sharding over tp (Ulysses-style resharding is what GSPMD inserts
+    for the sharded attention). End-to-end: a pp=2 x tp=2 x dp=2 train
+    step at a long-for-tests sequence length runs and converges."""
+    import optax
+
+    from ray_tpu.parallel.pipeline import pp_init_params, pp_loss_fn, pp_param_logical_axes
+
+    cfg = LlamaConfig.tiny(num_layers=4, dtype="float32", max_seq_len=512)
+    mesh = create_mesh(pp=2, dp=2, tp=2)
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(0, cfg.vocab_size, (4, 512)).astype(np.int32)
+    targets = rng.integers(0, cfg.vocab_size, (4, 512)).astype(np.int32)
+
+    init_fn, compile_step, _ = make_train_step(
+        partial(pp_loss_fn, config=cfg, mesh=mesh, num_microbatches=2),
+        optax.adamw(1e-3),
+        mesh,
+        pp_param_logical_axes(cfg, 2),
+    )
+    state, shardings = init_fn(jax.random.PRNGKey(0), partial(pp_init_params, cfg, n_stages=2))
+    step = compile_step(shardings)
+    from ray_tpu.parallel.train_step import shard_batch as _sb
+
+    sbatch = _sb({"tokens": tokens, "targets": targets}, mesh)
+    state, m0 = step(state, sbatch)
+    state, m1 = step(state, sbatch)
+    assert np.isfinite(float(m1["loss"])) and float(m1["loss"]) < float(m0["loss"])
+    # attention weights genuinely head-sharded over tp (1/(pp*tp) bytes per device)
+    wq = state.params["layers"]["wq"]
+    assert wq.addressable_shards[0].data.nbytes * 4 == wq.nbytes
+
+
 def test_fsdp_actually_shards_params():
     cfg = LlamaConfig.tiny()
     mesh = create_mesh(fsdp=8)
