@@ -191,6 +191,7 @@ class Runtime:
         self.nodes: dict[NodeID, Node] = {}
         self.actors: dict[ActorID, ActorState] = {}
         self.placement_groups: dict[PlacementGroupID, PlacementGroupState] = {}
+        self._pending_pgs: set = set()  # PENDING pg ids (re-place kicks scan only these)
         self.generators: dict[ObjectID, GenState] = {}
         self._gen_tombstones: collections.deque[ObjectID] = collections.deque()
         self._gen_cond = threading.Condition()
@@ -871,11 +872,15 @@ class Runtime:
         pg_id = PlacementGroupID.from_random()
         pgs = PlacementGroupState(pg_id, bundles, strategy, name)
         self.placement_groups[pg_id] = pgs
+        self._pending_pgs.add(pg_id)
         self._try_place_pg(pgs)
         return pg_id
 
     def _try_place_pg(self, pgs: PlacementGroupState) -> bool:
         with self._nodes_lock:
+            with pgs.cond:
+                if pgs.state != "PENDING":
+                    return pgs.state == "CREATED"
             nodes = self.node_list()
             plan = _plan_pg(pgs.bundles, pgs.strategy, nodes)
             if plan is None:
@@ -892,10 +897,17 @@ class Runtime:
                 for node, idx in reserved:
                     node.return_bundle(pgs.pg_id, idx)
                 return False
-        with pgs.cond:
-            pgs.placements = [n.node_id for n in plan]
-            pgs.state = "CREATED"
-            pgs.cond.notify_all()
+            with pgs.cond:
+                if pgs.state != "PENDING":
+                    # removed while we were reserving: roll back, don't
+                    # let a dead group consume capacity
+                    for node, idx in reserved:
+                        node.return_bundle(pgs.pg_id, idx)
+                    return False
+                pgs.placements = [n.node_id for n in plan]
+                pgs.state = "CREATED"
+                pgs.cond.notify_all()
+        self._pending_pgs.discard(pgs.pg_id)
         from ray_tpu.util.placement_group import _pg_ready_oid
 
         self.store.put_serialized(_pg_ready_oid(pgs.pg_id), _to_serialized(True))
@@ -924,14 +936,32 @@ class Runtime:
         pgs = self.placement_groups.get(pg_id)
         if pgs is None:
             return
+        # flip REMOVED first (under the cond): a concurrent _try_place_pg
+        # commit sees it and rolls its reservation back
+        with pgs.cond:
+            pgs.state = "REMOVED"
+            pgs.cond.notify_all()
+        self._pending_pgs.discard(pg_id)
+        # reference semantics: actors scheduled into the group die with it
+        # (their bundles are about to be reclaimed — letting them run
+        # would oversubscribe the freed capacity)
+        for astate in list(self.actors.values()):
+            if astate.info.placement_group == pg_id and astate.info.state != "DEAD":
+                try:
+                    self.kill_actor(astate.info.actor_id, no_restart=True)
+                except Exception:
+                    pass
         with self._nodes_lock:
             for node in self.node_list():
                 for idx in list(node.pg_bundles.get(pg_id, {})):
                     node.return_bundle(pg_id, idx)
-        with pgs.cond:
-            pgs.state = "REMOVED"
-            pgs.cond.notify_all()
         self.gcs.events.record("pg_removed", pg_id=pg_id.hex())
+        # freed capacity may satisfy queued gang reservations (reference:
+        # pending PG queue re-scheduled on resource release)
+        for other_id in list(self._pending_pgs):
+            other = self.placement_groups.get(other_id)
+            if other is not None:
+                self._try_place_pg(other)
 
     def placement_group_table(self) -> list[dict]:
         return [

@@ -130,6 +130,33 @@ class WorkerGroup:
     def __len__(self):
         return len(self.workers)
 
+    def _trial_placement_group(self):
+        """The enclosing Tune trial's gang reservation, when its bundle
+        count covers this group's workers (bundle 0 is the trial driver)."""
+        import os
+
+        pg_hex = os.environ.get("RT_TRIAL_PG")
+        if not pg_hex:
+            return None
+        from ray_tpu.core.ids import PlacementGroupID
+        from ray_tpu.util.placement_group import PlacementGroup
+
+        pg = PlacementGroup(PlacementGroupID.from_hex(pg_hex))
+        specs = pg.bundle_specs
+        if len(specs) < self.num_workers + 1:
+            return None  # too few bundles: fall back to an own group
+        res = self.scaling._worker_resources
+        for b in specs[1 : self.num_workers + 1]:
+            if any(b.get(k, 0) < v for k, v in res.items() if v > 0):
+                # a too-small bundle would leave the worker unschedulable
+                # forever (bundle allocation never succeeds): fail fast
+                raise ValueError(
+                    f"trial placement group bundle {b} cannot fit worker resources {res}; "
+                    "size the PlacementGroupFactory worker bundles to the trainer's "
+                    "resources_per_worker"
+                )
+        return pg
+
     # ---------------- lifecycle ----------------
     def start(self, latest_checkpoint_path: str | None = None, dataset_split_fn=None):
         sc = self.scaling
@@ -152,14 +179,23 @@ class WorkerGroup:
             res = sc._worker_resources
             from ray_tpu.util.placement_group import placement_group
 
-            bundles = [dict(res) for _ in range(self.num_workers)]
-            self._pg = placement_group(bundles, strategy=sc.placement_strategy)
-            self._pg.wait()
+            trial_pg = self._trial_placement_group()
+            if trial_pg is not None:
+                # running inside a Tune trial with a gang reservation:
+                # workers go into the trial PG's bundles 1..N instead of
+                # reserving a second group (reference: tune trials as
+                # PlacementGroupFactory with trainer worker bundles)
+                pg, owned = trial_pg, False
+            else:
+                bundles = [dict(res) for _ in range(self.num_workers)]
+                pg, owned = placement_group(bundles, strategy=sc.placement_strategy), True
+                pg.wait()
+            self._pg = pg if owned else None  # only owned groups are removed at stop
             for i in range(self.num_workers):
                 opts = dict(
                     num_cpus=res.get("CPU", 1),
-                    placement_group=self._pg,
-                    placement_group_bundle_index=i,
+                    placement_group=pg,
+                    placement_group_bundle_index=i + (0 if owned else 1),
                 )
                 if res.get("TPU"):
                     opts["num_tpus"] = res["TPU"]

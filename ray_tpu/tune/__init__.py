@@ -37,6 +37,7 @@ from ray_tpu.tune.callbacks import (  # noqa: F401
     TensorBoardLoggerCallback,
     WandbLoggerCallback,
 )
+from ray_tpu.tune.resources import PlacementGroupFactory, with_resources
 from ray_tpu.tune.tuner import TuneConfig, Tuner, run, with_parameters
 
 __all__ = [
@@ -54,6 +55,8 @@ __all__ = [
     "TuneConfig",
     "Tuner",
     "Callback",
+    "PlacementGroupFactory",
+    "with_resources",
     "CSVLoggerCallback",
     "JsonLoggerCallback",
     "MLflowLoggerCallback",

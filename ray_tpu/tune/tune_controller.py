@@ -26,6 +26,13 @@ from ray_tpu.tune.trial import ERROR, PAUSED, PENDING, RUNNING, TERMINATED, Tria
 POLL_INTERVAL_S = float(os.environ.get("RT_TUNE_POLL_INTERVAL_S", "0.05"))
 
 
+def _stage_root() -> str:
+    """Session-scoped checkpoint staging dir: concurrent experiments (or
+    other users' leftovers) can never collide on trial ids (ADVICE fix)."""
+    pid = os.environ.get("RT_SESSION_PID", str(os.getpid()))
+    return os.path.join("/tmp", "ray_tpu", f"session_{pid}", "trial_stage")
+
+
 @ray_tpu.remote(max_concurrency=4)
 class TrialActor:
     """Runs one trial's function in a thread; reports stream out via poll
@@ -37,7 +44,12 @@ class TrialActor:
         self._reports: queue.Queue = queue.Queue()
         self._status = "idle"
 
-    def run(self, fn, config: dict, latest_checkpoint_path: str | None):
+    def run(self, fn, config: dict, latest_checkpoint_path: str | None, trial_pg_hex: str | None = None):
+        if trial_pg_hex:
+            # the trial's gang reservation: a WorkerGroup spawned inside
+            # this trial schedules its workers into bundles 1..N instead
+            # of reserving a second placement group
+            os.environ["RT_TRIAL_PG"] = trial_pg_hex
         ckpt = Checkpoint(latest_checkpoint_path) if latest_checkpoint_path else None
         ctx = _train_ctx.TrainContext(
             world_size=1,
@@ -67,7 +79,7 @@ class TrialActor:
         # TemporaryDirectory) long before the controller polls
         staged = None
         if checkpoint is not None and os.path.isdir(checkpoint.path):
-            staged = os.path.join("/tmp", "ray_tpu", "trial_stage", self.trial_id, f"seq{seq}")
+            staged = os.path.join(_stage_root(), self.trial_id, f"seq{seq}")
             shutil.copytree(checkpoint.path, staged, dirs_exist_ok=True)
         self._reports.put({"seq": seq, "metrics": metrics, "checkpoint_path": staged})
 
@@ -110,6 +122,10 @@ class TuneController:
         self.trials: list[Trial] = []
         self._actors: dict[str, object] = {}
         self._run_refs: dict[str, object] = {}
+        # PG-backed trials: trial_id -> PlacementGroup; trials whose gang
+        # reservation is still PENDING wait here, not in RUNNING
+        self._trial_pgs: dict[str, object] = {}
+        self._awaiting_pg: list[Trial] = []
         self._failures: dict[str, int] = {}
         self._pending: dict[str, list] = {}  # undelivered reports per trial
         self._exhausted = False
@@ -164,8 +180,11 @@ class TuneController:
         self.max_concurrent = state.get("max_concurrent", self.max_concurrent)
         self.max_failures = state.get("max_failures", self.max_failures)
         for t in self.trials:
-            if t.status == RUNNING:
-                t.status = PAUSED  # was in flight when the snapshot landed
+            if t.status in (RUNNING, PENDING):
+                # RUNNING was in flight when the snapshot landed; PENDING
+                # was queued for a gang reservation that died with the old
+                # controller — both resume via the paused path
+                t.status = PAUSED
             elif t.status == ERROR and restart_errored:
                 t.status = PAUSED
                 t.checkpoint_path = None
@@ -208,12 +227,14 @@ class TuneController:
             # paused trials (PBT exploits, failure retries) get freed slots
             # BEFORE new suggestions — the population keeps training
             self._resume_paused()
+            self._poll_awaiting_pg()
             self._maybe_launch()
             running = [t for t in self.trials if t.status == RUNNING]
             paused = [t for t in self.trials if t.status == PAUSED]
-            if not running and not paused and self._exhausted:
+            waiting = self._awaiting_pg
+            if not running and not paused and not waiting and self._exhausted:
                 break
-            if not running and not paused and not self._exhausted and not self._maybe_launch():
+            if not running and not paused and not waiting and not self._exhausted and not self._maybe_launch():
                 break
             self._poll_running()
             if self._dirty:
@@ -224,7 +245,7 @@ class TuneController:
 
     def _maybe_launch(self) -> bool:
         launched = False
-        while sum(t.status == RUNNING for t in self.trials) < self.max_concurrent and not self._exhausted:
+        while self._active_count() < self.max_concurrent and not self._exhausted:
             tid = uuid.uuid4().hex[:8]
             cfg = self.searcher.suggest(tid)
             if cfg == "__WAIT__":
@@ -239,21 +260,69 @@ class TuneController:
         return launched
 
     def _start_trial(self, trial: Trial):
-        opts = {"num_cpus": self.resources.get("CPU", 1)}
-        if self.resources.get("TPU"):
-            opts["num_tpus"] = self.resources["TPU"]
+        from ray_tpu.tune.resources import PlacementGroupFactory
+
+        if isinstance(self.resources, PlacementGroupFactory):
+            # gang-reserve the trial's WHOLE footprint (driver + workers)
+            # atomically (reference: tune/execution/placement_groups.py);
+            # a trial that doesn't fit stays PENDING, never oversubscribes
+            pg = self._trial_pgs.get(trial.trial_id)
+            if pg is None:
+                pg = self.resources.create(name=f"trial-{trial.trial_id}")
+                self._trial_pgs[trial.trial_id] = pg
+            if not pg.wait(timeout_seconds=0.05):
+                trial.status = PENDING
+                if trial not in self._awaiting_pg:
+                    self._awaiting_pg.append(trial)
+                return
+            head = self.resources.head_bundle
+            opts = {
+                "num_cpus": head.get("CPU", 1),
+                "placement_group": pg,
+                "placement_group_bundle_index": 0,
+            }
+            if head.get("TPU"):
+                opts["num_tpus"] = head["TPU"]
+            pg_hex = pg.id.hex()
+        else:
+            opts = {"num_cpus": self.resources.get("CPU", 1)}
+            if self.resources.get("TPU"):
+                opts["num_tpus"] = self.resources["TPU"]
+            pg_hex = None
         actor = TrialActor.options(**opts).remote(trial.trial_id, self.experiment_name)
         config = trial.restore_config if trial.restore_config else trial.config
         trial.config = config
         trial.restore_config = None
-        ref = actor.run.remote(self.trainable, config, trial.checkpoint_path)
+        ref = actor.run.remote(self.trainable, config, trial.checkpoint_path, pg_hex)
         self._actors[trial.trial_id] = actor
         self._run_refs[trial.trial_id] = ref
         trial.status = RUNNING
 
+    def _poll_awaiting_pg(self):
+        """Retry PENDING gang reservations (capacity frees when finished
+        trials return their placement groups)."""
+        for trial in list(self._awaiting_pg):
+            pg = self._trial_pgs.get(trial.trial_id)
+            if pg is not None and pg.wait(timeout_seconds=0.05):
+                self._awaiting_pg.remove(trial)
+                self._start_trial(trial)
+
     def _stop_trial(self, trial: Trial, status: str):
         actor = self._actors.pop(trial.trial_id, None)
         self._run_refs.pop(trial.trial_id, None)
+        if trial in self._awaiting_pg:
+            self._awaiting_pg.remove(trial)
+        pg = self._trial_pgs.pop(trial.trial_id, None)
+        if pg is not None:
+            # return the gang reservation (paused trials re-reserve on
+            # resume — holding bundles while paused would starve the
+            # population, reference releases on pause too)
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(pg)
+            except Exception:
+                pass
         # stale reports die with the run — including their staged
         # checkpoint copies (otherwise /tmp accumulates one per dropped
         # report on STOP/PAUSE decisions)
@@ -262,7 +331,7 @@ class TuneController:
             if src and "/trial_stage/" in src:
                 shutil.rmtree(src, ignore_errors=True)
         if trial.is_finished or status in (TERMINATED, ERROR):
-            shutil.rmtree(os.path.join("/tmp", "ray_tpu", "trial_stage", trial.trial_id), ignore_errors=True)
+            shutil.rmtree(os.path.join(_stage_root(), trial.trial_id), ignore_errors=True)
         if actor is not None:
             try:
                 ray_tpu.kill(actor)
@@ -275,9 +344,15 @@ class TuneController:
             self.scheduler.on_trial_complete(self, trial)
             self._notify("log_trial_end", trial)
 
+    def _active_count(self) -> int:
+        """Trials consuming a concurrency slot: RUNNING plus those whose
+        gang reservation is queued (they hold a slot so max_concurrent
+        bounds total admission, not just placed trials)."""
+        return sum(t.status == RUNNING for t in self.trials) + len(self._awaiting_pg)
+
     def _resume_paused(self):
         for trial in self.trials:
-            if trial.status == PAUSED and sum(t.status == RUNNING for t in self.trials) < self.max_concurrent:
+            if trial.status == PAUSED and self._active_count() < self.max_concurrent:
                 self._start_trial(trial)
 
     def _poll_running(self):

@@ -157,9 +157,25 @@ def _normalize_trainable(trainable):
             for m in result.metrics_history:
                 report(dict(m))
 
-        return fit_trainer, {"CPU": 0.5}  # controller-only actor; workers hold resources
+        explicit = getattr(base, "_tune_resources", None)
+        if explicit is not None:
+            return fit_trainer, explicit
+        sc = base.scaling_config
+        if sc.use_tpu and sc.topology:
+            # slice trainers gang-reserve through their SlicePlacementGroup
+            # (util/tpu.py); a CPU trial PG would double-book and gate
+            # admission on the wrong footprint
+            return fit_trainer, {"CPU": 0.5}
+        # gang-reserve the trainer's WHOLE footprint per trial: driver
+        # bundle + one bundle per train worker (reference:
+        # tune/execution/placement_groups.py resource_dict_to_pg_factory;
+        # flat driver-only CPUs let N-worker trials oversubscribe)
+        from ray_tpu.tune.resources import PlacementGroupFactory
+
+        bundles = [{"CPU": 0.5}] + [dict(sc._worker_resources) for _ in range(sc.num_workers)]
+        return fit_trainer, PlacementGroupFactory(bundles)
     if callable(trainable):
-        return trainable, {"CPU": 1}
+        return trainable, getattr(trainable, "_tune_resources", {"CPU": 1})
     raise TypeError(f"unsupported trainable: {type(trainable)}")
 
 
