@@ -18,6 +18,13 @@ from ray_tpu.train.config import (
 from ray_tpu.train.context import TrainContext, get_context
 from ray_tpu.train.result import Result
 from ray_tpu.train.session import get_checkpoint, get_dataset_shard, report
+from ray_tpu.train.scaling_policy import (
+    ElasticScalingPolicy,
+    FixedScalingPolicy,
+    NoopDecision,
+    ResizeDecision,
+    ScalingPolicy,
+)
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, TorchTrainer
 from ray_tpu.train.errors import TrainingFailedError
 

@@ -26,6 +26,15 @@ logger = logging.getLogger(__name__)
 POLL_INTERVAL_S = float(os.environ.get("RT_TRAIN_POLL_INTERVAL_S", "0.05"))
 
 
+class _ResizeRestart(Exception):
+    """Internal signal: the scaling policy requested a new group size —
+    restart at the boundary (checkpoint-resume), not a failure."""
+
+    def __init__(self, num_workers: int, reason: str):
+        super().__init__(f"resize to {num_workers} workers: {reason}")
+        self.num_workers = num_workers
+
+
 class TrainController:
     def __init__(
         self,
@@ -35,10 +44,14 @@ class TrainController:
         run_config,
         backend_config,
         datasets: dict | None = None,
+        scaling_policy=None,
     ):
+        from ray_tpu.train.scaling_policy import FixedScalingPolicy
+
         self.train_fn = train_fn
         self.train_fn_config = train_fn_config
         self.scaling = scaling_config
+        self.scaling_policy = scaling_policy or FixedScalingPolicy(scaling_config)
         self.run_config = run_config
         self.backend_config = backend_config
         self.backend = backend_config.backend_cls()
@@ -63,10 +76,18 @@ class TrainController:
 
     # ---------------- main entry ----------------
     def run(self) -> Result:
+        import dataclasses
+
         max_failures = self.run_config.failure_config.max_failures
         while True:
+            # the scaling policy sizes each attempt (elastic policies fit
+            # the current cluster; reference: scaling_policy.py:29)
+            n = self.scaling_policy.workers_for_attempt()
+            attempt_scaling = (
+                dataclasses.replace(self.scaling, num_workers=n) if n != self.scaling.num_workers else self.scaling
+            )
             group = WorkerGroup(
-                self.scaling,
+                attempt_scaling,
                 self.run_config.name,
                 env_vars=getattr(self.backend_config, "env_vars", None),
             )
@@ -84,6 +105,12 @@ class TrainController:
                     from ray_tpu.train.collective import group_name_for_attempt
 
                     cleanup_group_actor(group_name_for_attempt(self.run_config.name, group.attempt_uid))
+            if isinstance(error, _ResizeRestart):
+                # elastic boundary: recompile against the new topology and
+                # resume from the latest committed checkpoint. Not a
+                # failure — doesn't consume the restart budget.
+                logger.info("elastic resize: %s", error)
+                continue
             if error is None:
                 self._finish_callbacks()
                 latest = self.ckpt_manager.latest_checkpoint
@@ -129,6 +156,8 @@ class TrainController:
         state = {"committed": 0}
         done = [False] * len(group)
 
+        from ray_tpu.train.scaling_policy import ResizeDecision
+
         while not all(done):
             ready, _ = ray_tpu.wait(run_refs, num_returns=len(run_refs), timeout=POLL_INTERVAL_S)
             try:
@@ -143,6 +172,13 @@ class TrainController:
                         done[i] = True
                     except Exception as e:
                         return e
+            # resize only between rounds of a still-running group: a
+            # decision landing after completion must not discard the
+            # finished attempt
+            if not all(done):
+                decision = self.scaling_policy.poll_running(len(group))
+                if isinstance(decision, ResizeDecision) and decision.num_workers != len(group):
+                    return _ResizeRestart(decision.num_workers, decision.reason)
         # drain any reports that landed after the loop observed completion
         try:
             self._drain_and_commit(group, pending_rounds, state)

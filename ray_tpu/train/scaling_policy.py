@@ -1,0 +1,99 @@
+"""Elastic training: worker-group sizing decisions.
+
+Reference parity: train/v2/_internal/execution/scaling_policy/
+scaling_policy.py:29 — the ScalingPolicy decision API (NoopDecision /
+ResizeDecision) consulted when a worker group is (re)created and while it
+runs. TPU-native semantics: a resize is a RESTART BOUNDARY — the jitted
+SPMD program is compiled for a fixed mesh, so growing or shrinking the
+group means recompiling against the new topology and resuming from the
+latest committed checkpoint (orbax shards re-load under the new
+sharding). The controller therefore applies resize decisions by tearing
+the group down exactly like a failure restart, minus the failure count.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class NoopDecision:
+    reason: str = ""
+
+
+@dataclass
+class ResizeDecision:
+    num_workers: int
+    reason: str = ""
+
+
+class ScalingPolicy:
+    """Decision hooks (reference: scaling_policy.py:29).
+
+    - workers_for_attempt(): group size for the NEXT worker-group start.
+    - poll_running(): consulted periodically while a group trains; a
+      ResizeDecision triggers a checkpoint-resume restart at the new size.
+    """
+
+    def __init__(self, scaling_config):
+        self.scaling_config = scaling_config
+
+    def workers_for_attempt(self) -> int:
+        return self.scaling_config.num_workers
+
+    def poll_running(self, group_size: int):
+        return NoopDecision()
+
+
+class FixedScalingPolicy(ScalingPolicy):
+    """Always the configured size (the reference default)."""
+
+
+class ElasticScalingPolicy(ScalingPolicy):
+    """Fit the group to cluster capacity within [min_workers, max_workers].
+
+    On each attempt start, size = clamp(workers that fit the cluster's
+    TOTAL resources). While running, poll the cluster: if capacity for
+    more workers appeared (a node joined) and we're below max, request an
+    upscale; if the cluster can no longer hold the current group (a node
+    died — the failure path usually fires first), request a downscale.
+    min_upscale_headroom_s throttles flapping."""
+
+    def __init__(self, scaling_config, min_workers: int = 1, max_workers: int | None = None, poll_interval_s: float = 1.0):
+        super().__init__(scaling_config)
+        self.min_workers = max(1, int(min_workers))
+        self.max_workers = int(max_workers) if max_workers else max(scaling_config.num_workers, self.min_workers)
+        self.poll_interval_s = poll_interval_s
+        self._last_poll = 0.0
+
+    def _workers_fitting_cluster(self) -> int:
+        import ray_tpu
+
+        total = ray_tpu.cluster_resources()
+        res = self.scaling_config._worker_resources
+        fit = None
+        for k, per in res.items():
+            if per > 0:
+                fit_k = int(total.get(k, 0) // per)
+                fit = fit_k if fit is None else min(fit, fit_k)
+        return self.max_workers if fit is None else fit
+
+    def _clamp(self, n: int) -> int:
+        return max(self.min_workers, min(self.max_workers, n))
+
+    def workers_for_attempt(self) -> int:
+        return self._clamp(self._workers_fitting_cluster())
+
+    def poll_running(self, group_size: int):
+        import time
+
+        now = time.monotonic()
+        if now - self._last_poll < self.poll_interval_s:
+            return NoopDecision()
+        self._last_poll = now
+        target = self._clamp(self._workers_fitting_cluster())
+        if target > group_size:
+            return ResizeDecision(target, reason=f"capacity for {target} workers (group has {group_size})")
+        if target < group_size:
+            return ResizeDecision(target, reason=f"cluster only fits {target} workers (group has {group_size})")
+        return NoopDecision()

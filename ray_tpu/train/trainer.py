@@ -29,6 +29,7 @@ class DataParallelTrainer:
         backend_config: BackendConfig | None = None,
         datasets: dict | None = None,
         resume_from_checkpoint=None,
+        scaling_policy=None,
     ):
         self.train_loop_per_worker = train_loop_per_worker
         self.train_loop_config = train_loop_config
@@ -37,6 +38,9 @@ class DataParallelTrainer:
         self.backend_config = backend_config or self._default_backend_config_cls()
         self.datasets = datasets
         self.resume_from_checkpoint = resume_from_checkpoint
+        # elastic training (reference: scaling_policy.py:29): resize the
+        # worker group at restart boundaries as cluster capacity changes
+        self.scaling_policy = scaling_policy
 
     def fit(self, raise_on_error: bool = True) -> Result:
         import ray_tpu
@@ -50,6 +54,7 @@ class DataParallelTrainer:
             self.run_config,
             self.backend_config,
             datasets=self.datasets,
+            scaling_policy=self.scaling_policy,
         )
         if self.resume_from_checkpoint is not None:
             # seed only — never registered with the manager, so top-k

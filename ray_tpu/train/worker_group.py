@@ -144,7 +144,14 @@ class WorkerGroup:
         pg = PlacementGroup(PlacementGroupID.from_hex(pg_hex))
         specs = pg.bundle_specs
         if len(specs) < self.num_workers + 1:
-            return None  # too few bundles: fall back to an own group
+            # falling back to an own group here would double-book: the
+            # trial's gang stays reserved while a second group queues —
+            # deadlock on a trial-sized cluster. Fail fast instead.
+            raise ValueError(
+                f"trial placement group has {len(specs)} bundles but the worker group needs "
+                f"{self.num_workers + 1} (driver + workers); size the PlacementGroupFactory "
+                "to the trainer's maximum worker count"
+            )
         res = self.scaling._worker_resources
         for b in specs[1 : self.num_workers + 1]:
             if any(b.get(k, 0) < v for k, v in res.items() if v > 0):

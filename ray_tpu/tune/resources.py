@@ -47,7 +47,20 @@ class PlacementGroupFactory:
 
 
 def with_resources(trainable, resources):
-    """Attach a resource request (dict or PlacementGroupFactory) to a
-    trainable (reference: tune.with_resources)."""
-    trainable._tune_resources = resources
-    return trainable
+    """Return a copy of the trainable carrying a resource request (dict
+    or PlacementGroupFactory); the original is untouched so it can be
+    reused with different resources (reference: tune.with_resources)."""
+    import copy
+    import functools
+
+    if callable(trainable) and not hasattr(trainable, "fit"):
+
+        @functools.wraps(trainable)
+        def wrapped(*a, **kw):
+            return trainable(*a, **kw)
+
+        wrapped._tune_resources = resources
+        return wrapped
+    clone = copy.copy(trainable)  # trainers: shallow copy, new attr only
+    clone._tune_resources = resources
+    return clone

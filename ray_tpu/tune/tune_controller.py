@@ -300,12 +300,35 @@ class TuneController:
 
     def _poll_awaiting_pg(self):
         """Retry PENDING gang reservations (capacity frees when finished
-        trials return their placement groups)."""
+        trials return their placement groups). A reservation the CLUSTER
+        cannot hold at all fails the trial instead of hanging the
+        experiment silently (the autoscaler may still grow the cluster —
+        infeasibility is judged against current total capacity)."""
+        import time as _time
+
         for trial in list(self._awaiting_pg):
             pg = self._trial_pgs.get(trial.trial_id)
             if pg is not None and pg.wait(timeout_seconds=0.05):
                 self._awaiting_pg.remove(trial)
                 self._start_trial(trial)
+                continue
+            first = getattr(trial, "_pg_wait_since", None)
+            if first is None:
+                trial._pg_wait_since = _time.monotonic()
+                continue
+            if _time.monotonic() - first > 5.0 and self._pg_infeasible():
+                trial.error = (
+                    f"trial placement group {self.resources!r} exceeds total cluster "
+                    "capacity; it can never be placed"
+                )
+                self._stop_trial(trial, ERROR)
+
+    def _pg_infeasible(self) -> bool:
+        import ray_tpu
+
+        total = ray_tpu.cluster_resources()
+        need = self.resources.required_resources()
+        return any(total.get(k, 0) < v for k, v in need.items() if v > 0)
 
     def _stop_trial(self, trial: Trial, status: str):
         actor = self._actors.pop(trial.trial_id, None)

@@ -211,3 +211,57 @@ def test_jax_trainer_single_worker_mesh(rt_start, tmp_path):
         backend_config=JaxConfig(distributed="never"),
     ).fit()
     assert result.metrics["last_loss"] < result.metrics["first_loss"]
+
+
+def test_elastic_scaling_grows_group_when_node_joins(tmp_path):
+    """VERDICT done-criterion: a node added mid-run makes the worker group
+    grow at the next restart boundary (checkpoint-resume recompile;
+    reference: train/v2 scaling_policy.py:29 ResizeDecision)."""
+    import json
+    import tempfile
+    import threading
+    import time as _time
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        from ray_tpu.core import context as _core_ctx
+        from ray_tpu.train import ElasticScalingPolicy
+
+        def loop(config):
+            ckpt = train.get_checkpoint()
+            start = 0
+            if ckpt is not None:
+                with open(os.path.join(ckpt.path, "state.json")) as f:
+                    start = json.load(f)["step"] + 1
+            ws = train.get_context().get_world_size()
+            for step in range(start, 10):
+                d = tempfile.mkdtemp()
+                with open(os.path.join(d, "state.json"), "w") as f:
+                    json.dump({"step": step}, f)
+                train.report({"step": step, "world_size": ws}, checkpoint=Checkpoint.from_directory(d))
+                _time.sleep(0.4)
+
+        def add_node_later():
+            _time.sleep(2.5)
+            _core_ctx.get_client().add_node({"CPU": 2.0})
+
+        threading.Thread(target=add_node_later, daemon=True).start()
+
+        scaling = ScalingConfig(num_workers=2, resources_per_worker={"CPU": 2})
+        trainer = DataParallelTrainer(
+            loop,
+            scaling_config=scaling,
+            run_config=_run_cfg(tmp_path),
+            scaling_policy=ElasticScalingPolicy(scaling, min_workers=1, max_workers=2),
+        )
+        result = trainer.fit()
+        assert result.error is None
+        sizes = [m["world_size"] for m in result.metrics_history]
+        steps = [m["step"] for m in result.metrics_history]
+        assert sizes[0] == 1, f"should start at 1 worker (only 2 CPUs): {sizes}"
+        assert sizes[-1] == 2, f"group never grew after the node joined: {sizes}"
+        # every step committed exactly once, in order, across the resize
+        assert steps == sorted(set(steps)) and steps[-1] == 9, steps
+    finally:
+        ray_tpu.shutdown()

@@ -297,3 +297,16 @@ def test_two_worker_trainer_trials_serialize_on_small_cluster(tmp_path):
         assert a1 <= b0, f"trials overlapped: {windows} — gang reservation failed to serialize them"
     finally:
         ray_tpu.shutdown()
+
+
+def test_infeasible_trial_pg_errors_instead_of_hanging(rt_start, tmp_path):
+    def trainable(config):
+        tune.report({"x": 1})
+
+    grid = tune.Tuner(
+        tune.with_resources(trainable, tune.PlacementGroupFactory([{"CPU": 64}])),
+        param_space={"v": tune.grid_search([1])},
+        tune_config=tune.TuneConfig(metric="x", mode="max"),
+        run_config=_run_cfg(tmp_path),
+    ).fit()
+    assert grid.num_errors == 1
