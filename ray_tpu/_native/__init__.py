@@ -125,20 +125,29 @@ def hash_column(col) -> np.ndarray:
             )
         col = np.asarray(col)
     col = np.asarray(col)
-    if col.dtype.kind in "iuf":
+    if col.dtype.kind == "f":
+        # hash the FLOAT BIT PATTERN (hashing.cpp's contract): astype(int64)
+        # would truncate every fractional float in [n, n+1) onto one hash.
+        # Normalize -0.0 -> +0.0 (they compare equal) and NaN payloads to
+        # one canonical NaN so equal keys hash equally.
+        f = np.ascontiguousarray(col).astype(np.float64, copy=False) + 0.0
+        f = np.where(np.isnan(f), np.float64("nan"), f)
+        keys = f.view(np.uint64)
+    elif col.dtype.kind in "iu":
         keys = np.ascontiguousarray(col).astype(np.int64, copy=False).view(np.uint64)
-        if lib is not None:
-            out = np.empty(len(keys), np.uint64)
-            lib.hash_u64(_ptr(np.ascontiguousarray(keys)), len(keys), _ptr(out))
-            return out
-        # numpy splitmix64
-        x = keys + np.uint64(0x9E3779B97F4A7C15)
-        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-        return x ^ (x >> np.uint64(31))
-    # generic objects: FNV-1a over the str form — deterministic across
-    # processes (unlike builtin hash(), which is salted per process)
-    return np.asarray([_fnv1a_py(str(v).encode()) for v in col.tolist()], np.uint64)
+    else:
+        # generic objects: FNV-1a over the str form — deterministic across
+        # processes (unlike builtin hash(), which is salted per process)
+        return np.asarray([_fnv1a_py(str(v).encode()) for v in col.tolist()], np.uint64)
+    if lib is not None:
+        out = np.empty(len(keys), np.uint64)
+        lib.hash_u64(_ptr(np.ascontiguousarray(keys)), len(keys), _ptr(out))
+        return out
+    # numpy splitmix64
+    x = keys + np.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
 
 
 def combine_hashes(a: np.ndarray, b: np.ndarray) -> np.ndarray:

@@ -512,6 +512,25 @@ class Runtime:
         for oid in obj_ids:
             self.store.delete(oid)
 
+    def object_locations(self, obj_ids) -> dict:
+        """Primary-copy node per object (reference:
+        ownership_object_directory.h lookups / ray.experimental.
+        get_object_locations). The shm namespace tag IS the location
+        record: a descriptor's ns maps to the node holding the bytes;
+        inline/spilled values live with the head. None = unknown/unsealed."""
+        out = {}
+        head_hex = self.node_id.hex()
+        for oid in obj_ids:
+            entry = self.store.try_get_entry(oid)
+            if entry is None:
+                out[oid.hex()] = None
+            elif entry.shm is None or not entry.shm.ns or entry.shm.ns == self._head_ns:
+                out[oid.hex()] = head_hex
+            else:
+                nid = self._ns_nodes.get(entry.shm.ns)
+                out[oid.hex()] = nid.hex() if nid is not None else None
+        return out
+
     def _on_sealed(self, obj_id: ObjectID):
         self.scheduler.on_object_sealed(obj_id)
         with self._dc_lock:
@@ -1764,6 +1783,9 @@ class Runtime:
                 w.send({"type": "resp", "req_id": msg["req_id"], "ok": False, "error": _picklable_error(e)})
             except Exception:
                 logger.exception("failed to send error response")
+
+    def _rpc_object_locations(self, obj_ids):
+        return self.object_locations(obj_ids)
 
     def _rpc_get_object(self, obj_id, timeout_s=None):
         deadline = None if timeout_s is None else time.monotonic() + timeout_s

@@ -182,6 +182,9 @@ class WorkerClient:
         except Exception:
             pass
 
+    def object_locations(self, obj_ids) -> dict:
+        return self.call("object_locations", obj_ids=list(obj_ids))
+
     def cluster_info(self, kind: str):
         return self.call("cluster_info", kind=kind)
 

@@ -265,7 +265,10 @@ class Dataset:
         return [MaterializedDataset(o) for o in outs]
 
     def streaming_split(self, n: int, *, equal: bool = False, locality_hints=None) -> list[DataIterator]:
-        coord = SplitCoordinator.remote(self, n, equal)
+        """locality_hints: one node-id hex per split — each block routes
+        to the split whose hinted node holds its primary copy (reference:
+        streaming_split locality_hints -> output_splitter routing)."""
+        coord = SplitCoordinator.remote(self, n, equal, locality_hints)
         return [SplitIterator(coord, i) for i in builtins.range(n)]
 
     def train_test_split(self, test_size: float, *, shuffle: bool = False, seed=None):

@@ -117,7 +117,7 @@ class SplitCoordinator:
 
     EQUAL_CHUNK_ROWS = 256
 
-    def __init__(self, dataset, n: int, equal: bool):
+    def __init__(self, dataset, n: int, equal: bool, locality_hints=None):
         self.n = n
         self.equal = equal
         self.queues = [collections.deque() for _ in range(n)]
@@ -125,9 +125,56 @@ class SplitCoordinator:
         self._exhausted = False
         self._next = 0
         self._carry = None  # equal mode: residual rows awaiting a full chunk
+        # locality_hints: node-id hex per consumer (reference:
+        # output_splitter.py locality-aware bundle routing) — blocks whose
+        # primary copy lives on a consumer's hinted node go to that
+        # consumer, under a BALANCE BOUND: locality is a preference, so a
+        # split whose hinted node holds every block cannot starve the
+        # others (unmatched / over-budget blocks round-robin). Stats back
+        # the majority-local assertion in tests.
+        if locality_hints is not None:
+            if equal:
+                raise ValueError("locality_hints are not supported with equal=True (re-chunked rows have no single home node)")
+            if len(locality_hints) != n:
+                raise ValueError(f"need one locality hint per split: got {len(locality_hints)} for n={n}")
+        self._hints = list(locality_hints) if locality_hints else None
+        self._assigned = [0] * n
+        self.stats = [{"local": 0, "remote": 0} for _ in range(n)]
         import threading
 
         self._lock = threading.Lock()
+
+    LOCALITY_SKEW_BOUND = 4  # max extra blocks a hinted split may run ahead
+
+    def _route(self, ref) -> int:
+        """Pick the consumer for a freshly pulled block ref. One location
+        lookup per block (each block is routed exactly once; the
+        coordinator actor serializes calls anyway, so the RPC adds no
+        extra contention)."""
+        if self._hints:
+            from ray_tpu.core import context as _ctx
+
+            loc = _ctx.get_client().object_locations([ref.id]).get(ref.id.hex())
+            if loc is not None:
+                floor = min(self._assigned)
+                matches = [
+                    i
+                    for i, h in enumerate(self._hints)
+                    if h == loc and self._assigned[i] - floor < self.LOCALITY_SKEW_BOUND
+                ]
+                if matches:
+                    target = min(matches, key=lambda i: self._assigned[i])
+                    self._assigned[target] += 1
+                    self.stats[target]["local"] += 1
+                    return target
+        target = self._next % self.n
+        self._next += 1
+        self._assigned[target] += 1
+        self.stats[target]["remote"] += 1
+        return target
+
+    def locality_stats(self):
+        return self.stats
 
     def _pump_equal(self):
         """Pull source blocks until one full round of n chunks is queued, or
@@ -178,8 +225,7 @@ class SplitCoordinator:
                 except StopIteration:
                     self._exhausted = True
                     break
-                target = self._next % self.n
-                self._next += 1
+                target = self._route(ref)
                 if target == split:
                     return ref
                 self.queues[target].append(ref)

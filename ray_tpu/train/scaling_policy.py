@@ -52,12 +52,15 @@ class FixedScalingPolicy(ScalingPolicy):
 class ElasticScalingPolicy(ScalingPolicy):
     """Fit the group to cluster capacity within [min_workers, max_workers].
 
-    On each attempt start, size = clamp(workers that fit the cluster's
-    TOTAL resources). While running, poll the cluster: if capacity for
-    more workers appeared (a node joined) and we're below max, request an
-    upscale; if the cluster can no longer hold the current group (a node
-    died — the failure path usually fires first), request a downscale.
-    min_upscale_headroom_s throttles flapping."""
+    Sizing uses AVAILABLE capacity, never the cluster total: co-tenant
+    jobs hold resources too, and a resize targeting capacity someone else
+    owns would tear down a working group for a placement that can never
+    succeed. At attempt start the previous group has already released its
+    bundles, so available reflects what this job can actually reserve.
+    While running, upscale when the AVAILABLE headroom fits extra workers
+    (a node joined / a tenant left); downscale only when the cluster
+    TOTAL can no longer hold the current group (a node died — the failure
+    path usually fires first). poll_interval_s throttles the checks."""
 
     def __init__(self, scaling_config, min_workers: int = 1, max_workers: int | None = None, poll_interval_s: float = 1.0):
         super().__init__(scaling_config)
@@ -66,15 +69,12 @@ class ElasticScalingPolicy(ScalingPolicy):
         self.poll_interval_s = poll_interval_s
         self._last_poll = 0.0
 
-    def _workers_fitting_cluster(self) -> int:
-        import ray_tpu
-
-        total = ray_tpu.cluster_resources()
+    def _fit(self, resources: dict) -> int:
         res = self.scaling_config._worker_resources
         fit = None
         for k, per in res.items():
             if per > 0:
-                fit_k = int(total.get(k, 0) // per)
+                fit_k = int(resources.get(k, 0) // per)
                 fit = fit_k if fit is None else min(fit, fit_k)
         return self.max_workers if fit is None else fit
 
@@ -82,18 +82,24 @@ class ElasticScalingPolicy(ScalingPolicy):
         return max(self.min_workers, min(self.max_workers, n))
 
     def workers_for_attempt(self) -> int:
-        return self._clamp(self._workers_fitting_cluster())
+        import ray_tpu
+
+        return self._clamp(self._fit(ray_tpu.available_resources()))
 
     def poll_running(self, group_size: int):
         import time
+
+        import ray_tpu
 
         now = time.monotonic()
         if now - self._last_poll < self.poll_interval_s:
             return NoopDecision()
         self._last_poll = now
-        target = self._clamp(self._workers_fitting_cluster())
+        headroom = self._fit(ray_tpu.available_resources())
+        target = self._clamp(group_size + headroom)
         if target > group_size:
-            return ResizeDecision(target, reason=f"capacity for {target} workers (group has {group_size})")
-        if target < group_size:
-            return ResizeDecision(target, reason=f"cluster only fits {target} workers (group has {group_size})")
+            return ResizeDecision(target, reason=f"headroom for {target - group_size} more workers")
+        total_fit = self._clamp(self._fit(ray_tpu.cluster_resources()))
+        if total_fit < group_size:
+            return ResizeDecision(total_fit, reason=f"cluster now fits only {total_fit} workers")
         return NoopDecision()
