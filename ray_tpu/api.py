@@ -155,6 +155,24 @@ _VALID_OPTIONS = {
 }
 
 
+def _with_trace(options: dict, name: str) -> dict:
+    """Attach the caller's trace context to an outgoing submission and
+    record the client-side span (reference: tracing_helper.py wrapping
+    every .remote); a no-op boolean check when tracing is off."""
+    from ray_tpu.util import tracing
+
+    if not tracing.enabled():
+        return options
+    import time as _time
+    import uuid as _uuid
+
+    ctx = tracing.child_context()
+    span_id = _uuid.uuid4().hex[:16]
+    now = _time.time_ns()
+    tracing.record_span(f"submit::{name}", "client", ctx[0], span_id, ctx[1], now, now, {})
+    return {**(options or {}), "_trace_ctx": (ctx[0], span_id)}
+
+
 def _check_options(opts: dict):
     unknown = set(opts) - _VALID_OPTIONS
     if unknown:
@@ -230,7 +248,7 @@ class RemoteFunction:
             num_returns=num_returns,
             streaming=streaming,
             func_blob=blob,
-            options=self._options,
+            options=_with_trace(self._options, getattr(self._fn, "__name__", "task")),
         )
         if hasattr(client, "mark_function_sent"):
             client.mark_function_sent(self._func_id)
@@ -271,7 +289,7 @@ class ActorMethod:
             kwargs=kw_specs,
             num_returns=num_returns,
             streaming=streaming,
-            options=self._options,
+            options=_with_trace(self._options, self._name),
         )
         if streaming:
             return ObjectRefGenerator(ids[0])

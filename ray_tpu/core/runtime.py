@@ -230,7 +230,7 @@ class Runtime:
         for k, v in TPUAcceleratorManager.get_current_node_additional_resources().items():
             base_res.setdefault(k, v)
         node_labels = {"ray_tpu.io/node-type": "head", **TPUAcceleratorManager.get_current_node_labels(), **(labels or {})}
-        head = Node(None, base_res, labels=node_labels)
+        head = Node(None, base_res, labels=node_labels, env=self._base_worker_env())
         self.head_node = head
         self.node_id = head.node_id
         self.nodes[head.node_id] = head
@@ -302,7 +302,7 @@ class Runtime:
         if remote and not self.local_mode:
             from ray_tpu.core.node import RemoteNode
 
-            env = dict(env or {})
+            env = {**self._base_worker_env(), **(env or {})}
             if shm_isolation:
                 self._shm_ns_counter += 1
                 env["RT_SHM_NS"] = f"{self._head_ns.split('n')[0]}n{self._shm_ns_counter}"
@@ -330,6 +330,17 @@ class Runtime:
             key = os.urandom(16)
             self.gcs.store.put("cluster_secrets", name, key)
         return key
+
+    def _base_worker_env(self) -> dict:
+        """Env every worker must see explicitly: the forkserver freezes
+        os.environ at ITS boot, so driver-side settings made later (e.g.
+        enabling tracing) only reach workers through the per-worker env."""
+        env = {}
+        from ray_tpu.util import tracing
+
+        if tracing.enabled():
+            env["RT_TRACING"] = "1"
+        return env
 
     def _register_node_transfer(self, node):
         ns = getattr(node, "shm_ns", "")
@@ -584,6 +595,7 @@ class Runtime:
             max_retries=opts.get("max_retries", self.cfg.default_max_retries),
             retry_exceptions=opts.get("retry_exceptions", False),
             runtime_env=self._prepare_runtime_env(opts.get("runtime_env")),
+            trace_ctx=opts.get("_trace_ctx"),
         )
         spec._kwargs = kwargs or {}
         self.task_manager.register(spec)
@@ -796,6 +808,7 @@ class Runtime:
                 method_name=method_name,
                 seq_no=astate.seq,
                 max_retries=astate.info.max_task_retries,
+                trace_ctx=(options or {}).get("_trace_ctx"),
             )
             spec._kwargs = kwargs or {}
             self.task_manager.register(spec)

@@ -72,6 +72,9 @@ class TaskSpec:
     # bookkeeping
     attempt: int = 0
     submitter: str = "driver"
+    # tracing (reference: util/tracing/tracing_helper.py context
+    # propagation): (trace_id, parent_span_id) from the submitting side
+    trace_ctx: tuple | None = None
 
     def return_ids(self) -> list[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]

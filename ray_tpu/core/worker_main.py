@@ -244,6 +244,21 @@ class WorkerClient:
 
     def _execute(self, msg):
         spec = msg["spec"]
+        if getattr(spec, "trace_ctx", None) is not None:
+            from ray_tpu.util import tracing
+
+            # server span under the caller's submit span; nested .remote
+            # calls inside the task inherit this context (one trace id
+            # stitches the whole cross-process call tree)
+            with tracing.span(
+                f"task::{spec.name}", kind="server", parent_ctx=tuple(spec.trace_ctx),
+                task_id=spec.task_id.hex(), actor=spec.actor_id.hex() if spec.actor_id else None,
+            ):
+                return self._execute_inner(msg)
+        return self._execute_inner(msg)
+
+    def _execute_inner(self, msg):
+        spec = msg["spec"]
         self.current_task_id = spec.task_id
         self.assigned_resources = msg.get("resources", {})
         self._apply_env(msg.get("env"))
@@ -324,7 +339,20 @@ class WorkerClient:
     def _complete_async(self, spec, coro):
         """Run an async actor method on the actor event loop; send the done
         message from the loop's completion callback (reference: async-actor
-        fibers, task_execution/fiber.h)."""
+        fibers, task_execution/fiber.h). The dispatcher's server span
+        closes at handoff (its duration covers dispatch only), but its
+        trace CONTEXT rides into the coroutine so nested .remote calls
+        stay on the caller's trace."""
+        if getattr(spec, "trace_ctx", None) is not None:
+            from ray_tpu.util import tracing
+
+            ctx = tracing._ctx()
+            if ctx is not None:
+                async def _with_ctx(c=coro, ctx=ctx):
+                    tracing.set_context(ctx)
+                    return await c
+
+                coro = _with_ctx()
         fut = asyncio.run_coroutine_threadsafe(coro, self._get_actor_loop())
 
         def _cb(f):

@@ -50,6 +50,7 @@ class RequestOutput:
     finished: bool
     finish_reason: str | None = None
     logprobs: list | None = None
+    streamed: bool = False  # consumer reads an out_queue, not this output
 
 
 def _bucket(n: int, buckets) -> int:
@@ -172,7 +173,18 @@ class LLMEngine:
 
     # ------------------------------------------------------------- admission
 
-    def add_request(self, prompt_token_ids, params: SamplingParams | None = None, request_id: str | None = None, stream: bool = False) -> str:
+    def add_request(
+        self,
+        prompt_token_ids,
+        params: SamplingParams | None = None,
+        request_id: str | None = None,
+        stream: bool = False,
+        out_queue=None,
+    ) -> str:
+        """``out_queue`` lets a streaming caller supply its own queue and
+        hold a reference BEFORE admission — the request may finish (and be
+        dropped from the registry) before add_request even returns to a
+        caller racing the stepping thread."""
         params = params or SamplingParams()
         with self._lock:
             if request_id is None:
@@ -184,8 +196,8 @@ class LLMEngine:
                     f"exceeds max_seq_len ({self.max_seq_len})"
                 )
             st = RequestState(request_id, list(prompt_token_ids), params)
-            if stream:
-                st.out_queue = queue.SimpleQueue()
+            if stream or out_queue is not None:
+                st.out_queue = out_queue if out_queue is not None else queue.SimpleQueue()
             self._requests[request_id] = st
             self._waiting.append(st)
             return request_id
@@ -303,6 +315,7 @@ class LLMEngine:
                         finished=st.finished,
                         finish_reason=st.finish_reason,
                         logprobs=list(st.logprobs) if st.params.logprobs else None,
+                        streamed=st.out_queue is not None,
                     )
                 )
             # also report requests finished during this step's admission
@@ -318,6 +331,7 @@ class LLMEngine:
                             finished=True,
                             finish_reason=st.finish_reason,
                             logprobs=list(st.logprobs) if st.params.logprobs else None,
+                            streamed=st.out_queue is not None,
                         )
                     )
                     del self._requests[st.request_id]

@@ -130,7 +130,18 @@ class HTTPProxy:
 
             def _wants_stream(self, req: Request) -> bool:
                 accept = req.headers.get("Accept", "") or req.headers.get("accept", "")
-                return "text/event-stream" in accept or req.headers.get("X-Serve-Stream") == "1"
+                if "text/event-stream" in accept or req.headers.get("X-Serve-Stream") == "1":
+                    return True
+                # OpenAI-style bodies signal streaming in JSON, not
+                # headers — but only sniff on the OpenAI endpoints, so an
+                # unrelated deployment whose schema has a top-level
+                # "stream" field keeps its unary framing
+                if req.path.endswith(("/completions", "/chat/completions")) and req.body[:1] == b"{" and b'"stream"' in req.body:
+                    try:
+                        return req.json().get("stream") is True
+                    except ValueError:
+                        return False
+                return False
 
             def _stream(self, gen, timeout):
                 """Chunked transfer: one chunk per yielded item (reference:

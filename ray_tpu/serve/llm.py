@@ -29,6 +29,12 @@ class LLMConfig:
     model_config: object = None  # ray_tpu.models.llama.LlamaConfig
     params: object = None  # optional pretrained pytree
     engine_kwargs: dict = field(default_factory=dict)  # max_num_seqs, ...
+    # OpenAI-style API: model name echoed in responses, and an optional
+    # tokenizer with encode(str)->list[int] / decode(list[int])->str
+    # (e.g. transformers AutoTokenizer); without one, string prompts are
+    # rejected and token-id prompts/completions pass through.
+    model_id: str = "ray_tpu-llama"
+    tokenizer: object = None
     num_replicas: int = 1
     # -1 = auto: tensor_parallel_size chips when tp > 1, else none.
     # Explicit 0 opts out (CPU-mesh testing).
@@ -102,9 +108,17 @@ class LLMServer:
                     self._events.clear()
                 for ev in events:
                     ev.set()
+                # streaming consumers block on their queues, not events:
+                # push sentinels so they wake and re-check _stepper_error
+                with self.engine._lock:
+                    streams = [st.out_queue for st in self.engine._requests.values() if st.out_queue is not None]
+                for q in streams:
+                    q.put(None)
                 return
             for out in outs:
-                if out.finished:
+                # streamed requests deliver through their out_queue; putting
+                # them in _done would leak (no collector ever pops them)
+                if out.finished and not out.streamed:
                     with self._lock:
                         self._done[out.request_id] = out
                         ev = self._events.get(out.request_id)
@@ -156,9 +170,125 @@ class LLMServer:
         self._stopped = True
 
 
-def build_llm_deployment(llm_config: LLMConfig, *, name: str = "LLMServer"):
-    """-> a Serve Application running LLMServer replicas (reference:
-    llm/_internal/serve/builders.py build_llm_deployment)."""
+class OpenAIServer(LLMServer):
+    """OpenAI-compatible request surface over the engine (reference:
+    llm/_internal/serve/builders build_openai_app + the OpenAI-compatible
+    router): POST /v1/completions and /v1/chat/completions bodies map
+    onto engine requests; GET /v1/models lists the deployment. Streaming
+    responses use SSE `data:` lines when "stream": true."""
+
+    def __init__(self, llm_config: LLMConfig):
+        super().__init__(llm_config)
+        self.model_id = llm_config.model_id
+        self.tokenizer = llm_config.tokenizer
+
+    # -- token plumbing --
+    def _encode(self, prompt):
+        if isinstance(prompt, list):
+            return [int(t) for t in prompt]
+        if self.tokenizer is None:
+            raise ValueError("string prompts need LLMConfig.tokenizer (encode/decode); token-id lists work without one")
+        return list(self.tokenizer.encode(prompt))
+
+    def _decode(self, token_ids):
+        if self.tokenizer is None:
+            return token_ids
+        return self.tokenizer.decode(token_ids)
+
+    def _chat_to_prompt(self, messages):
+        if self.tokenizer is not None and hasattr(self.tokenizer, "apply_chat_template"):
+            return list(self.tokenizer.apply_chat_template(messages))
+        text = "\n".join(f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages) + "\nassistant:"
+        return self._encode(text)
+
+    def _sampling(self, body: dict) -> dict:
+        sp = {
+            "max_tokens": int(body.get("max_tokens", 64)),
+            "temperature": float(body.get("temperature", 0.0)),
+            "top_p": float(body.get("top_p", 1.0)),
+        }
+        if body.get("seed") is not None:
+            sp["seed"] = int(body["seed"])
+        if body.get("stop_token_ids"):
+            sp["stop_token_ids"] = tuple(body["stop_token_ids"])
+        return sp
+
+    # -- HTTP entry --
+    def __call__(self, request):
+        path = getattr(request, "path", "/")
+        if path.endswith("/models"):
+            return {"object": "list", "data": [{"id": self.model_id, "object": "model", "owned_by": "ray_tpu"}]}
+        body = request.json() if hasattr(request, "json") else dict(request)
+        chat = path.endswith("/chat/completions")
+        if chat:
+            prompt_ids = self._chat_to_prompt(body.get("messages", []))
+        else:
+            prompt_ids = self._encode(body.get("prompt", []))
+        if body.get("stream"):
+            return self._stream_completion(prompt_ids, body, chat)
+        out = self.generate(prompt_ids, self._sampling(body))
+        text = self._decode(out["token_ids"])
+        if chat:
+            choice = {"index": 0, "message": {"role": "assistant", "content": text}, "finish_reason": out["finish_reason"]}
+            obj = "chat.completion"
+        else:
+            choice = {"index": 0, "text": text, "finish_reason": out["finish_reason"]}
+            obj = "text_completion"
+        return {
+            "id": out["request_id"],
+            "object": obj,
+            "model": self.model_id,
+            "choices": [choice],
+            "usage": {
+                "prompt_tokens": len(out["prompt_token_ids"]),
+                "completion_tokens": len(out["token_ids"]),
+                "total_tokens": len(out["prompt_token_ids"]) + len(out["token_ids"]),
+            },
+        }
+
+    def _stream_completion(self, prompt_ids, body: dict, chat: bool):
+        """SSE chunks, one per generated token (reference: OpenAI
+        streaming format). Serve streams these through the chunked proxy."""
+        import json as _json
+        import queue as _queue
+        import time as _time
+
+        from ray_tpu.llm import SamplingParams
+
+        params = SamplingParams(**self._sampling(body))
+        # we own the queue: a tiny request can finish (and leave the
+        # engine registry) before add_request even returns, so the state
+        # must never be looked up there afterwards
+        out_q = _queue.SimpleQueue()
+        rid = self.engine.add_request(list(prompt_ids), params, out_queue=out_q)
+        self._work.set()
+        key = "delta" if chat else "text"
+        obj = "chat.completion.chunk" if chat else "text_completion"
+        deadline = _time.monotonic() + 300.0
+        while True:
+            if self._stepper_error is not None:
+                raise RuntimeError(f"llm stepper died:\n{self._stepper_error}")
+            try:
+                tok = out_q.get(timeout=min(5.0, max(0.1, deadline - _time.monotonic())))
+            except _queue.Empty:
+                if _time.monotonic() > deadline:
+                    self.engine.abort_request(rid)
+                    raise TimeoutError(f"stream {rid} produced no token for 300s")
+                continue
+            if tok is None:
+                if self._stepper_error is not None:
+                    raise RuntimeError(f"llm stepper died:\n{self._stepper_error}")
+                break
+            piece = self._decode([tok])
+            content = {"role": "assistant", "content": piece} if chat else piece
+            yield "data: " + _json.dumps(
+                {"id": rid, "object": obj, "model": self.model_id, "choices": [{"index": 0, key: content}]}
+            ) + "\n\n"
+        yield "data: [DONE]\n\n"
+
+
+def _build_app(llm_config: LLMConfig, cls, name: str):
+    """Shared deployment construction for both server surfaces."""
     from ray_tpu import serve
 
     opts = {
@@ -180,5 +310,18 @@ def build_llm_deployment(llm_config: LLMConfig, *, name: str = "LLMServer"):
         num_tpus = float(llm_config.tensor_parallel_size) if llm_config.tensor_parallel_size > 1 else 0.0
     if num_tpus:
         opts["num_tpus"] = num_tpus  # ReplicaConfig field
-    deployment = serve.deployment(**opts)(LLMServer)
+    deployment = serve.deployment(**opts)(cls)
     return deployment.bind(llm_config)
+
+
+def build_openai_app(llm_config: LLMConfig, *, name: str = "OpenAIServer"):
+    """-> a Serve Application exposing the OpenAI surface (reference:
+    llm/_internal/serve/builders.py build_openai_app). Mount it at
+    /v1 via serve.run(app, route_prefix="/v1") + serve.start(proxy=True)."""
+    return _build_app(llm_config, OpenAIServer, name)
+
+
+def build_llm_deployment(llm_config: LLMConfig, *, name: str = "LLMServer"):
+    """-> a Serve Application running LLMServer replicas (reference:
+    llm/_internal/serve/builders.py build_llm_deployment)."""
+    return _build_app(llm_config, LLMServer, name)

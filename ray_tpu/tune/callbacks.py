@@ -2,9 +2,11 @@
 integrations air/integrations/wandb.py, mlflow.py).
 
 File-based loggers work offline out of the box (JSON lines, CSV,
-TensorBoard via torch's SummaryWriter); network-backed integrations
-(wandb/mlflow) are gated imports with clear errors since this image has
-no egress.
+TensorBoard via torch's SummaryWriter). Wandb/MLflow run in FILE-BACKED
+modes only: WandbLoggerCallback writes wandb's offline run-directory
+layout (sync later with `wandb sync`), MLflowLoggerCallback writes the
+mlruns/ file-store layout (`mlflow ui --backend-store-uri file://...`);
+online modes / remote tracking URIs raise — this image has no egress.
 
     run_config = RunConfig(callbacks=[JsonLoggerCallback(),
                                       CSVLoggerCallback(),
@@ -118,24 +120,130 @@ class TensorBoardLoggerCallback(Callback):
 
 
 class WandbLoggerCallback(Callback):
-    """Gated: network-backed experiment tracking is not supported in this
-    deployment (zero egress) — raises unconditionally rather than ever
-    degrading into a silent no-op logger."""
+    """File-backed OFFLINE mode only (reference: air/integrations/wandb.py
+    WandbLoggerCallback with WANDB_MODE=offline): per-trial run
+    directories in the wandb offline layout — wandb-metadata.json,
+    config.json, and an append-only wandb-history.jsonl of results —
+    syncable later with `wandb sync <dir>` from a machine with egress.
+    Online mode is rejected explicitly: this deployment has none."""
 
-    def __init__(self, *a, **kw):
-        raise NotImplementedError(
-            "WandbLoggerCallback is not supported in this deployment (no "
-            "egress). Use JsonLoggerCallback/CSVLoggerCallback/"
-            "TensorBoardLoggerCallback."
-        )
+    def __init__(self, project: str = "ray_tpu", group: str | None = None, mode: str = "offline", dir: str | None = None, **kw):
+        if mode != "offline":
+            raise NotImplementedError(
+                "only mode='offline' is supported in this deployment (no egress); "
+                "sync the offline run directories later with `wandb sync`"
+            )
+        self.project = project
+        self.group = group
+        self.dir = dir
+        self._runs: dict[str, str] = {}
+
+    def setup(self, run_dir: str):
+        import os
+
+        self.root = self.dir or os.path.join(run_dir, "wandb")
+        os.makedirs(self.root, exist_ok=True)
+
+    def _run_dir(self, trial) -> str:
+        import json
+        import os
+        import time
+
+        d = self._runs.get(trial.trial_id)
+        if d is None:
+            stamp = time.strftime("%Y%m%d_%H%M%S")
+            d = self._runs[trial.trial_id] = os.path.join(self.root, f"offline-run-{stamp}-{trial.trial_id}")
+            os.makedirs(os.path.join(d, "files"), exist_ok=True)
+            with open(os.path.join(d, "files", "wandb-metadata.json"), "w") as f:
+                json.dump({"project": self.project, "group": self.group, "run_id": trial.trial_id, "mode": "offline"}, f)
+            with open(os.path.join(d, "files", "config.json"), "w") as f:
+                json.dump({k: {"value": v} for k, v in (trial.config or {}).items()}, f, default=str)
+        return d
+
+    def log_trial_result(self, trial, result: dict):
+        import json
+        import os
+
+        d = self._run_dir(trial)
+        row = {k: v for k, v in result.items() if isinstance(v, (int, float, str, bool))}
+        row["_step"] = int(result.get("training_iteration", 0))
+        with open(os.path.join(d, "files", "wandb-history.jsonl"), "a") as f:
+            f.write(json.dumps(row, default=str) + "\n")
+
+    def log_trial_end(self, trial):
+        import json
+        import os
+
+        d = self._runs.get(trial.trial_id)
+        if d:
+            with open(os.path.join(d, "files", "wandb-summary.json"), "w") as f:
+                json.dump({"state": "finished"}, f)
 
 
 class MLflowLoggerCallback(Callback):
-    """Gated like WandbLoggerCallback."""
+    """File-backed local tracking only (reference:
+    air/integrations/mlflow.py with a file:// tracking URI): the standard
+    mlruns/ directory layout — one run directory per trial with params/,
+    metrics/ (timestamped series files), and tags/ — readable by
+    `mlflow ui --backend-store-uri file://...`. Remote tracking URIs are
+    rejected: this deployment has no egress."""
 
-    def __init__(self, *a, **kw):
-        raise NotImplementedError(
-            "MLflowLoggerCallback is not supported in this deployment (no "
-            "egress). Use JsonLoggerCallback/CSVLoggerCallback/"
-            "TensorBoardLoggerCallback."
-        )
+    def __init__(self, tracking_uri: str | None = None, experiment_name: str = "ray_tpu", **kw):
+        if tracking_uri and not tracking_uri.startswith("file:"):
+            raise NotImplementedError(
+                "only file:// tracking URIs are supported in this deployment (no egress)"
+            )
+        self.tracking_uri = tracking_uri
+        self.experiment_name = experiment_name
+        self._runs: dict[str, str] = {}
+
+    def setup(self, run_dir: str):
+        import os
+
+        if self.tracking_uri:
+            from urllib.parse import urlparse
+
+            # handles both file:///abs and RFC 8089 file:/abs forms
+            base = urlparse(self.tracking_uri).path
+        else:
+            base = os.path.join(run_dir, "mlruns")
+        self.root = os.path.join(base, "0")  # experiment id 0
+        os.makedirs(self.root, exist_ok=True)
+        import json
+
+        with open(os.path.join(self.root, "meta.yaml"), "w") as f:
+            f.write(f"experiment_id: '0'\nname: {self.experiment_name}\nlifecycle_stage: active\n")
+
+    def _run_dir(self, trial) -> str:
+        import os
+
+        d = self._runs.get(trial.trial_id)
+        if d is None:
+            d = self._runs[trial.trial_id] = os.path.join(self.root, trial.trial_id)
+            for sub in ("params", "metrics", "tags"):
+                os.makedirs(os.path.join(d, sub), exist_ok=True)
+            for k, v in (trial.config or {}).items():
+                with open(os.path.join(d, "params", str(k)), "w") as f:
+                    f.write(str(v))
+        return d
+
+    def log_trial_result(self, trial, result: dict):
+        import os
+        import time
+
+        d = self._run_dir(trial)
+        ts = int(time.time() * 1000)
+        step = int(result.get("training_iteration", 0))
+        for k, v in result.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                safe = str(k).replace("/", "_")
+                with open(os.path.join(d, "metrics", safe), "a") as f:
+                    f.write(f"{ts} {v} {step}\n")
+
+    def log_trial_end(self, trial):
+        import os
+
+        d = self._runs.get(trial.trial_id)
+        if d:
+            with open(os.path.join(d, "tags", "mlflow.runStatus"), "w") as f:
+                f.write("FINISHED")

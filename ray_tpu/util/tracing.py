@@ -1,0 +1,148 @@
+"""Distributed tracing: spans at remote-call boundaries.
+
+Reference parity: the OpenTelemetry integration in
+python/ray/util/tracing/tracing_helper.py — every task/actor submission
+opens a client span, the executing worker opens a server span whose
+parent is the caller's, and trace context propagates through NESTED
+remote calls, so one trace id stitches a whole call tree across
+processes. Here spans are written as JSONL (one file per process under
+the session dir) in an OTel-compatible shape — no collector dependency;
+`load_spans()` merges them for tools/tests and the dashboard.
+
+Enable with RT_TRACING=1 (or tracing.configure(True)). Disabled, the
+hooks are a single boolean check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+
+_enabled: bool | None = None
+# contextvars, not threading.local: asyncio tasks each carry their own
+# context, so concurrent coroutines on one actor event loop keep distinct
+# trace contexts (threads get isolated contexts too)
+import contextvars
+
+_current: contextvars.ContextVar = contextvars.ContextVar("rt_trace_ctx", default=None)
+_file_lock = threading.Lock()
+_file = None
+
+
+def configure(enabled: bool):
+    global _enabled
+    _enabled = bool(enabled)
+
+
+def enabled() -> bool:
+    global _enabled
+    if _enabled is None:
+        _enabled = os.environ.get("RT_TRACING", "0").lower() in ("1", "true", "on")
+    return _enabled
+
+
+def _ctx() -> tuple | None:
+    return _current.get()
+
+
+def set_context(ctx: tuple | None):
+    """(trace_id, span_id) of the CURRENT span in this thread/task."""
+    _current.set(ctx)
+
+
+def child_context() -> tuple:
+    """Context to attach to an outgoing remote call: same trace (new if
+    none), caller's span as parent."""
+    cur = _ctx()
+    if cur is None:
+        return (uuid.uuid4().hex[:16], None)
+    return cur
+
+
+def _span_file():
+    global _file
+    with _file_lock:
+        if _file is None:
+            from ray_tpu.util.state import session_dir
+
+            d = os.path.join(session_dir(), "spans")
+            os.makedirs(d, exist_ok=True)
+            _file = open(os.path.join(d, f"spans-{os.getpid()}.jsonl"), "a", buffering=1)
+        return _file
+
+
+def record_span(name: str, kind: str, trace_id: str, span_id: str, parent_id, start_ns: int, end_ns: int, attrs: dict):
+    try:
+        _span_file().write(
+            json.dumps(
+                {
+                    "name": name,
+                    "kind": kind,
+                    "trace_id": trace_id,
+                    "span_id": span_id,
+                    "parent_id": parent_id,
+                    "start_ns": start_ns,
+                    "end_ns": end_ns,
+                    "attrs": attrs,
+                }
+            )
+            + "\n"
+        )
+    except Exception:
+        pass
+
+
+class span:
+    """Context manager: open a span under `parent_ctx` (or the thread's
+    current context), make it current inside the block."""
+
+    def __init__(self, name: str, kind: str = "internal", parent_ctx: tuple | None = None, **attrs):
+        self.name = name
+        self.kind = kind
+        self.parent_ctx = parent_ctx
+        self.attrs = attrs
+
+    def __enter__(self):
+        ctx = self.parent_ctx if self.parent_ctx is not None else child_context()
+        self.trace_id = ctx[0]
+        self.parent_id = ctx[1]
+        self.span_id = uuid.uuid4().hex[:16]
+        self._saved = _ctx()
+        set_context((self.trace_id, self.span_id))
+        self.start_ns = time.time_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        set_context(self._saved)
+        if exc_type is not None:
+            self.attrs["error"] = repr(exc)
+        record_span(
+            self.name, self.kind, self.trace_id, self.span_id, self.parent_id, self.start_ns, time.time_ns(), self.attrs
+        )
+        return False
+
+
+def load_spans(pid: int | None = None) -> list[dict]:
+    """Merge every process's span file for the session (driver + workers
+    share the session dir via RT_SESSION_PID)."""
+    from ray_tpu.util.state import session_dir
+
+    d = os.path.join(session_dir(pid), "spans")
+    out: list[dict] = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for n in sorted(names):
+        try:
+            with open(os.path.join(d, n)) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        out.append(json.loads(line))
+        except (OSError, ValueError):
+            continue
+    return out

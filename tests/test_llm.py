@@ -223,3 +223,73 @@ def test_serve_llm_tp_replica(rt_start):
         assert len(out["token_ids"]) == 8
     finally:
         serve.shutdown()
+
+
+class _ToyTokenizer:
+    """chr-level toy tokenizer for API tests (no external vocab)."""
+
+    def encode(self, s):
+        return [ord(c) % 500 for c in s]
+
+    def decode(self, ids):
+        return "".join(chr(97 + (i % 26)) for i in ids)
+
+
+def test_openai_api_completions_and_chat(rt_start):
+    """OpenAI-compatible surface (reference: build_openai_app):
+    /v1/models, /v1/completions (unary + SSE streaming), and
+    /v1/chat/completions through the HTTP proxy."""
+    import json
+    import urllib.request
+
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import LLMConfig, build_openai_app
+
+    app = build_openai_app(
+        LLMConfig(
+            model_config=LlamaConfig.tiny(dtype="float32"),
+            engine_kwargs={"max_num_seqs": 4, "max_seq_len": 128},
+            model_id="tiny-llama",
+            tokenizer=_ToyTokenizer(),
+        )
+    )
+    serve.run(app, name="oai", route_prefix="/v1", blocking_timeout_s=240.0)
+    serve.start(serve.HTTPOptions(port=0), proxy=True)
+    port = serve.api._http_proxy.port
+    base = f"http://127.0.0.1:{port}/v1"
+    try:
+        def post(path, body):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(body).encode(), headers={"Content-Type": "application/json"}
+            )
+            return json.loads(urllib.request.urlopen(req, timeout=120).read())
+
+        models = json.loads(urllib.request.urlopen(base + "/models", timeout=60).read())
+        assert models["data"][0]["id"] == "tiny-llama"
+
+        out = post("/completions", {"prompt": "hi there", "max_tokens": 8, "temperature": 0.0})
+        assert out["object"] == "text_completion" and out["model"] == "tiny-llama"
+        assert len(out["choices"][0]["text"]) == 8  # toy decode: 1 char/token
+        assert out["usage"]["completion_tokens"] == 8
+
+        chat = post("/chat/completions", {
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 6,
+        })
+        assert chat["choices"][0]["message"]["role"] == "assistant"
+        assert len(chat["choices"][0]["message"]["content"]) == 6
+
+        # SSE streaming: one data: chunk per token + [DONE]
+        req = urllib.request.Request(
+            base + "/completions",
+            data=json.dumps({"prompt": "str", "max_tokens": 5, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        body = urllib.request.urlopen(req, timeout=120).read().decode()
+        chunks = [l for l in body.splitlines() if l.startswith("data: ")]
+        assert chunks[-1] == "data: [DONE]"
+        toks = [json.loads(c[6:]) for c in chunks[:-1]]
+        assert len(toks) == 5
+        assert all(t["object"] == "text_completion" for t in toks)
+    finally:
+        serve.shutdown()

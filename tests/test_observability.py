@@ -245,3 +245,53 @@ def test_runtime_under_lock_sanitizer():
     finally:
         os.environ.pop("RT_LOCK_SANITIZER", None)
         ray_tpu.shutdown()
+
+
+def test_tracing_spans_propagate_across_nested_remote_calls(tmp_path):
+    """Spans at remote-call boundaries with cross-process context
+    propagation (reference: util/tracing/tracing_helper.py): one trace id
+    stitches driver -> task -> nested task."""
+    import os
+
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    ray_tpu.shutdown()
+    os.environ["RT_TRACING"] = "1"
+    tracing.configure(True)
+    try:
+        ray_tpu.init(num_cpus=4)
+
+        @ray_tpu.remote
+        def child(x):
+            return x + 1
+
+        @ray_tpu.remote
+        def parent(x):
+            return ray_tpu.get(child.remote(x)) + 10
+
+        assert ray_tpu.get(parent.remote(1), timeout=60) == 12
+        import time
+
+        deadline = time.time() + 20
+        while True:
+            spans = tracing.load_spans()
+            tasks = [s for s in spans if s["kind"] == "server"]
+            if len(tasks) >= 2 or time.time() > deadline:
+                break
+            time.sleep(0.2)
+        names = {s["name"] for s in spans}
+        assert "submit::parent" in names and "task::parent" in names
+        assert "submit::child" in names and "task::child" in names
+        p_task = next(s for s in spans if s["name"] == "task::parent")
+        c_task = next(s for s in spans if s["name"] == "task::child")
+        c_submit = next(s for s in spans if s["name"] == "submit::child")
+        # one trace end to end; the child's submit span was opened INSIDE
+        # the parent task's span (cross-process propagation)
+        assert p_task["trace_id"] == c_task["trace_id"] == c_submit["trace_id"]
+        assert c_submit["parent_id"] == p_task["span_id"]
+        assert c_task["parent_id"] == c_submit["span_id"]
+    finally:
+        os.environ.pop("RT_TRACING", None)
+        tracing.configure(False)
+        ray_tpu.shutdown()

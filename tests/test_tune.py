@@ -310,3 +310,42 @@ def test_infeasible_trial_pg_errors_instead_of_hanging(rt_start, tmp_path):
         run_config=_run_cfg(tmp_path),
     ).fit()
     assert grid.num_errors == 1
+
+
+def test_wandb_mlflow_offline_loggers(rt_start, tmp_path):
+    """File-backed offline modes: wandb offline run dirs (syncable later
+    with `wandb sync`) and the mlruns/ file-store layout; online modes
+    stay rejected (zero egress)."""
+    import json
+
+    from ray_tpu.tune import MLflowLoggerCallback, WandbLoggerCallback
+
+    with pytest.raises(NotImplementedError):
+        WandbLoggerCallback(mode="online")
+    with pytest.raises(NotImplementedError):
+        MLflowLoggerCallback(tracking_uri="http://mlflow:5000")
+
+    def trainable(config):
+        for i in range(3):
+            tune.report({"loss": 1.0 / (config["lr"] * (i + 1))})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=_run_cfg(
+            tmp_path,
+            callbacks=[WandbLoggerCallback(project="p"), MLflowLoggerCallback()],
+        ),
+    ).fit()
+    assert grid.num_errors == 0
+    run_dir = tmp_path / "exp"
+    wandb_runs = list((run_dir / "wandb").glob("offline-run-*"))
+    assert len(wandb_runs) == 2
+    hist = (wandb_runs[0] / "files" / "wandb-history.jsonl").read_text().splitlines()
+    assert len(hist) == 3 and "loss" in json.loads(hist[0])
+    ml_runs = [d for d in (run_dir / "mlruns" / "0").iterdir() if d.is_dir()]
+    assert len(ml_runs) == 2
+    metric = (ml_runs[0] / "metrics" / "loss").read_text().splitlines()
+    assert len(metric) == 3 and len(metric[0].split()) == 3  # ts value step
+    assert (ml_runs[0] / "tags" / "mlflow.runStatus").read_text() == "FINISHED"
