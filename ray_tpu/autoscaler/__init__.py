@@ -1,8 +1,21 @@
 from ray_tpu.autoscaler.autoscaler import (
     Autoscaler,
+    CommandNodeProvider,
     LocalNodeProvider,
     NodeProvider,
     NodeTypeConfig,
 )
+from ray_tpu.autoscaler.launcher import Cluster as LaunchedCluster
+from ray_tpu.autoscaler.launcher import down, load_config, up
 
-__all__ = ["Autoscaler", "LocalNodeProvider", "NodeProvider", "NodeTypeConfig"]
+__all__ = [
+    "Autoscaler",
+    "CommandNodeProvider",
+    "LaunchedCluster",
+    "LocalNodeProvider",
+    "NodeProvider",
+    "NodeTypeConfig",
+    "down",
+    "load_config",
+    "up",
+]

@@ -64,6 +64,89 @@ class LocalNodeProvider(NodeProvider):
         self.rt.remove_node(node.node_id, graceful=True)
 
 
+class CommandNodeProvider(NodeProvider):
+    """Launch nodes by running a shell command that starts an `rt agent`
+    somewhere — ssh to another machine, a cloud CLI creating a VM whose
+    startup script joins, or a local subprocess in tests. This is the
+    cloud-provider seam (reference: the autoscaler's NodeProvider
+    implementations — node_provider.py subclasses wrap clouds the same
+    way: run something that makes a raylet join the head).
+
+    launch_command is a format string receiving {address} {authkey}
+    {transfer_authkey} {num_cpus} {num_tpus} {node_type}; the started
+    agent dials the head's AgentListener, and create_node returns once
+    the joined node appears (or raises on timeout)."""
+
+    JOIN_TIMEOUT_S = 120.0
+
+    def __init__(self, runtime, launch_command: str, terminate_command: str | None = None):
+        self.rt = runtime
+        self.launch_command = launch_command
+        self.terminate_command = terminate_command
+        self._procs: dict = {}  # node_id -> subprocess handle
+
+    def _known_joined(self) -> set:
+        return {n.node_id for n in self.rt.node_list() if n.labels.get("ray_tpu.io/node-type") == "joined"}
+
+    def create_node(self, node_type: NodeTypeConfig):
+        import subprocess
+
+        host, port = self.rt._agent_listener.address
+        cmd = self.launch_command.format(
+            address=f"{host}:{port}",
+            authkey=self.rt._agent_listener.authkey.hex(),
+            transfer_authkey=self.rt._transfer_authkey.hex(),
+            num_cpus=node_type.resources.get("CPU", 1),
+            num_tpus=node_type.resources.get("TPU", 0),
+            node_type=node_type.name,
+        )
+        before = self._known_joined()
+        proc = subprocess.Popen(cmd, shell=True)  # operator-authored shell line (ssh, pipes, ...)
+        deadline = time.monotonic() + self.JOIN_TIMEOUT_S
+        want = node_type.resources
+        while time.monotonic() < deadline:
+            for node_id in self._known_joined() - before:
+                with self.rt._nodes_lock:
+                    node = self.rt.nodes.get(node_id)
+                if node is None:
+                    continue  # joined and died in the window
+                # a stale agent from an earlier timed-out launch can rejoin
+                # here; only adopt a node whose capacity matches what this
+                # launch asked for (an imperfect but cheap identity check)
+                if any(node.total_resources.get(k, 0) < v for k, v in want.items() if v > 0):
+                    continue
+                node.labels["ray_tpu.io/node-type"] = node_type.name
+                self._procs[node_id] = proc
+                return node
+            if proc.poll() is not None and proc.returncode != 0:
+                raise RuntimeError(f"launch command exited {proc.returncode}: {cmd}")
+            time.sleep(0.25)
+        proc.terminate()
+        raise TimeoutError(f"node from {node_type.name!r} never joined within {self.JOIN_TIMEOUT_S}s")
+
+    def terminate_node(self, node):
+        import subprocess
+
+        node_type = node.labels.get("ray_tpu.io/node-type", "")
+        self.rt.remove_node(node.node_id, graceful=True)
+        proc = self._procs.pop(node.node_id, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+        if self.terminate_command:
+
+            class _Safe(dict):
+                def __missing__(self, key):  # unknown placeholder: keep literal
+                    return "{" + key + "}"
+
+            cmd = self.terminate_command.format_map(
+                _Safe(node_id=node.node_id.hex(), node_type=node_type)
+            )
+            try:
+                subprocess.Popen(cmd, shell=True)
+            except OSError as e:
+                logger.warning("terminate command failed to start: %s (%s)", cmd, e)
+
+
 def _fits(avail: dict, req: dict) -> bool:
     return all(avail.get(k, 0.0) >= v - 1e-9 for k, v in req.items() if v > 0)
 
@@ -98,6 +181,13 @@ class Autoscaler:
         self._lock = threading.Lock()
 
     # -- lifecycle --
+    def adopt(self, node, type_name: str):
+        """Register an externally-launched node as managed (the launcher's
+        min_workers floor) so reconcile counts it toward the type's floor
+        instead of double-launching."""
+        with self._lock:
+            self._managed[node.node_id] = (type_name, time.monotonic())
+
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True, name="rt-autoscaler")
         self._thread.start()

@@ -1,0 +1,180 @@
+"""YAML cluster launcher: `rt up cluster.yaml` / `rt down`.
+
+Reference parity: `ray up`/`ray down` against a cluster YAML
+(python/ray/autoscaler/_private/commands.py + the cluster config schema)
+— reduced to the fields that matter here:
+
+    cluster_name: demo
+    head:
+      num_cpus: 4            # head node resources
+      node_manager_port: 0   # fixed port enables agent reconnect
+      gcs_persist_path: ""   # non-empty enables head fault tolerance
+    provider:
+      type: command          # or "local"
+      launch_command: >      # command provider: how to start one worker
+        ssh {node_type}-pool 'rt agent --address {address}
+        --authkey {authkey} --transfer-authkey {transfer_authkey}
+        --num-cpus {num_cpus} --num-tpus {num_tpus}'
+    available_node_types:
+      cpu_worker:
+        resources: {CPU: 4}
+        min_workers: 1
+        max_workers: 4
+
+`up()` starts the head runtime in THIS process, brings up min_workers
+per type, and runs the demand-driven autoscaler until stopped. `rt up`
+runs it in the FOREGROUND (background with `rt up cfg.yaml &` / a
+process manager) and records a pidfile so `rt down` can stop it.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+from ray_tpu.autoscaler.autoscaler import (
+    Autoscaler,
+    CommandNodeProvider,
+    LocalNodeProvider,
+    NodeTypeConfig,
+)
+
+
+def load_config(path: str) -> dict:
+    import json
+
+    with open(path) as f:
+        text = f.read()
+    if path.endswith(".json"):
+        return json.loads(text)
+    import yaml
+
+    return yaml.safe_load(text)
+
+
+def _node_types(cfg: dict) -> list[NodeTypeConfig]:
+    out = []
+    for name, spec in (cfg.get("available_node_types") or {}).items():
+        out.append(
+            NodeTypeConfig(
+                name=name,
+                resources=dict(spec.get("resources") or {"CPU": 1}),
+                min_workers=int(spec.get("min_workers", 0)),
+                max_workers=int(spec.get("max_workers", 10)),
+                labels=dict(spec.get("labels") or {}),
+            )
+        )
+    return out
+
+
+def _provider(cfg: dict, runtime):
+    p = cfg.get("provider") or {"type": "local"}
+    kind = p.get("type", "local")
+    if kind == "local":
+        return LocalNodeProvider(runtime)
+    if kind == "command":
+        return CommandNodeProvider(runtime, p["launch_command"], p.get("terminate_command"))
+    raise ValueError(f"unknown provider type {kind!r} (local | command)")
+
+
+class Cluster:
+    """A launched cluster: head runtime + autoscaler + providers."""
+
+    def __init__(self, config: dict):
+        import ray_tpu
+        from ray_tpu.core import context
+
+        self.config = config
+        head = config.get("head") or {}
+        system_config = {}
+        if head.get("node_manager_port"):
+            system_config["node_manager_port"] = int(head["node_manager_port"])
+        if head.get("gcs_persist_path"):
+            system_config["gcs_persist_path"] = head["gcs_persist_path"]
+        if head.get("node_manager_host"):
+            # cross-host workers must dial a routable head address, not the
+            # loopback default (e.g. 0.0.0.0 bind + the head's LAN IP)
+            system_config["node_manager_host"] = head["node_manager_host"]
+        ray_tpu.init(num_cpus=int(head.get("num_cpus", os.cpu_count() or 4)), _system_config=system_config or None)
+        self.runtime = context.get_client()
+        self.node_types = _node_types(config)
+        self.provider = _provider(config, self.runtime)
+        self.autoscaler = Autoscaler(self.runtime, self.node_types, provider=self.provider)
+        # bring up the floor before demand-driven scaling takes over —
+        # and ADOPT each node so reconcile counts it toward min_workers
+        # rather than launching the floor a second time
+        for nt in self.node_types:
+            for _ in range(nt.min_workers):
+                self.autoscaler.adopt(self.provider.create_node(nt), nt.name)
+        self.autoscaler.start()
+
+    def wait(self):
+        """Block until SIGTERM/SIGINT (the `rt up` foreground loop)."""
+        stop = []
+        signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+        try:
+            while not stop:
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            pass
+
+    def shutdown(self):
+        import ray_tpu
+
+        self.autoscaler.stop()
+        ray_tpu.shutdown()
+
+
+def up(config_path: str, block: bool = True) -> Cluster:
+    cluster = Cluster(load_config(config_path))
+    from ray_tpu.util.state import session_dir
+
+    with open(os.path.join(session_dir(), "cluster.pid"), "w") as f:
+        f.write(str(os.getpid()))
+    if block:
+        try:
+            cluster.wait()
+        finally:
+            cluster.shutdown()
+    return cluster
+
+
+def down() -> bool:
+    """Stop the newest LIVE `rt up` head (SIGTERM via its pidfile). Dead
+    pidfiles are cleaned up and skipped, so a stale file can never shadow
+    a live head or hit a recycled pid."""
+    root = "/tmp/ray_tpu"
+    candidates = []
+    try:
+        sessions = os.listdir(root)
+    except FileNotFoundError:
+        return False
+    for s in sessions:
+        p = os.path.join(root, s, "cluster.pid")
+        try:
+            ts = os.path.getmtime(p)
+        except OSError:
+            continue
+        candidates.append((ts, p))
+    for _, p in sorted(candidates, reverse=True):
+        try:
+            with open(p) as f:
+                pid = int(f.read().strip())
+        except (OSError, ValueError):
+            continue
+        # liveness + identity: the pid must still be the session owner
+        # (the session dir is named after the head's own pid)
+        if f"session_{pid}" not in p:
+            continue
+        try:
+            os.kill(pid, 0)
+        except (ProcessLookupError, PermissionError):
+            try:
+                os.unlink(p)  # stale: clean up so it can't shadow anything
+            except OSError:
+                pass
+            continue
+        os.kill(pid, signal.SIGTERM)
+        return True
+    return False

@@ -137,8 +137,28 @@ def main(argv=None):
     ap.add_argument("--num-cpus", type=float, default=1.0)
     ap.add_argument("--num-tpus", type=float, default=0.0)
     ap.add_argument("--reconnect", type=float, default=60.0, help="seconds to keep redialing a lost head (head FT window)")
+    up = sub.add_parser("up", help="launch a cluster from a YAML/JSON config (head + autoscaler)")
+    up.add_argument("config")
+    sub.add_parser("down", help="stop the most recent `rt up` head")
     args = p.parse_args(argv)
-    {"status": cmd_status, "list": cmd_list, "summary": cmd_summary, "agent": cmd_agent}[args.cmd](args)
+    {"status": cmd_status, "list": cmd_list, "summary": cmd_summary, "agent": cmd_agent, "up": cmd_up, "down": cmd_down}[args.cmd](args)
+
+
+def cmd_up(args):
+    from ray_tpu.autoscaler.launcher import up
+
+    print(f"launching cluster from {args.config} (Ctrl-C / `rt down` to stop)", flush=True)
+    up(args.config, block=True)
+
+
+def cmd_down(_args):
+    from ray_tpu.autoscaler.launcher import down
+
+    if down():
+        print("sent shutdown to the cluster head")
+    else:
+        print("no running `rt up` head found", file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -157,3 +157,87 @@ def test_chaos_pong_starvation_kills_node():
     finally:
         rpc_chaos.clear()
         ray_tpu.shutdown()
+
+
+# ----------------------------------------------------------------------
+# cluster launcher + command node provider (reference: `ray up` YAML +
+# autoscaler NodeProvider implementations)
+# ----------------------------------------------------------------------
+def test_command_node_provider_launches_joining_agent(rt_start):
+    """The cloud-provider seam: a shell command starts an `rt agent` that
+    joins over TCP; terminate removes node + process."""
+    import sys as _sys
+
+    from ray_tpu.autoscaler import CommandNodeProvider, NodeTypeConfig
+
+    client = context.get_client()
+    cmd = (
+        f"{_sys.executable} -m ray_tpu.scripts.cli agent --address {{address}} "
+        "--authkey {authkey} --transfer-authkey {transfer_authkey} "
+        "--num-cpus {num_cpus} --reconnect 0"
+    )
+    provider = CommandNodeProvider(client, cmd)
+    node = provider.create_node(NodeTypeConfig(name="cpu_worker", resources={"CPU": 2}))
+    assert node.labels["ray_tpu.io/node-type"] == "cpu_worker"
+    assert node.total_resources.get("CPU") == 2.0
+
+    @ray_tpu.remote(num_cpus=1)
+    def pid():
+        return os.getpid()
+
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    p = ray_tpu.get(
+        pid.options(scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=node.node_id.hex(), soft=False)).remote(),
+        timeout=90,
+    )
+    assert p != os.getpid()
+    provider.terminate_node(node)
+    deadline = time.monotonic() + 15
+    while any(n.node_id == node.node_id for n in client.node_list()):
+        assert time.monotonic() < deadline
+        time.sleep(0.2)
+
+
+def test_cluster_launcher_yaml(tmp_path):
+    """`rt up`-style launch: YAML -> head + min_workers floor via the
+    provider + autoscaler running."""
+    import sys as _sys
+
+    import ray_tpu
+    from ray_tpu.autoscaler.launcher import Cluster, load_config
+
+    ray_tpu.shutdown()
+    cfg_path = tmp_path / "cluster.yaml"
+    cfg_path.write_text(
+        f"""
+cluster_name: test
+head:
+  num_cpus: 2
+provider:
+  type: command
+  launch_command: >-
+    {_sys.executable} -m ray_tpu.scripts.cli agent --address {{address}}
+    --authkey {{authkey}} --transfer-authkey {{transfer_authkey}}
+    --num-cpus {{num_cpus}} --reconnect 0
+available_node_types:
+  cpu_worker:
+    resources: {{CPU: 2}}
+    min_workers: 1
+    max_workers: 2
+"""
+    )
+    cluster = Cluster(load_config(str(cfg_path)))
+    try:
+        nodes = cluster.runtime.node_list()
+        workers = [n for n in nodes if n.labels.get("ray_tpu.io/node-type") == "cpu_worker"]
+        assert len(workers) == 1, [n.labels for n in nodes]
+        assert cluster.autoscaler._thread is not None and cluster.autoscaler._thread.is_alive()
+
+        @ray_tpu.remote
+        def two():
+            return 2
+
+        assert ray_tpu.get(two.remote(), timeout=60) == 2
+    finally:
+        cluster.shutdown()
