@@ -90,8 +90,14 @@ class CommandNodeProvider(NodeProvider):
 
     def create_node(self, node_type: NodeTypeConfig):
         import subprocess
+        import uuid
 
         host, port = self.rt._agent_listener.address
+        # include "--join-token {join_token}" in launch_command for EXACT
+        # launch<->node matching; without it, adoption falls back to a
+        # capacity check (a concurrent operator-run join could be claimed)
+        token = uuid.uuid4().hex[:12]
+        use_token = "{join_token}" in self.launch_command
         cmd = self.launch_command.format(
             address=f"{host}:{port}",
             authkey=self.rt._agent_listener.authkey.hex(),
@@ -99,6 +105,7 @@ class CommandNodeProvider(NodeProvider):
             num_cpus=node_type.resources.get("CPU", 1),
             num_tpus=node_type.resources.get("TPU", 0),
             node_type=node_type.name,
+            join_token=token,
         )
         before = self._known_joined()
         proc = subprocess.Popen(cmd, shell=True)  # operator-authored shell line (ssh, pipes, ...)
@@ -110,10 +117,10 @@ class CommandNodeProvider(NodeProvider):
                     node = self.rt.nodes.get(node_id)
                 if node is None:
                     continue  # joined and died in the window
-                # a stale agent from an earlier timed-out launch can rejoin
-                # here; only adopt a node whose capacity matches what this
-                # launch asked for (an imperfect but cheap identity check)
-                if any(node.total_resources.get(k, 0) < v for k, v in want.items() if v > 0):
+                if use_token:
+                    if node.labels.get("ray_tpu.io/join-token") != token:
+                        continue
+                elif any(node.total_resources.get(k, 0) < v for k, v in want.items() if v > 0):
                     continue
                 node.labels["ray_tpu.io/node-type"] = node_type.name
                 self._procs[node_id] = proc
@@ -247,11 +254,24 @@ class Autoscaler:
                     launches.append(t)
                     planned.append(dict(t.resources))
 
-            for t in launches[: self.upscaling_speed]:
-                node = self.provider.create_node(t)
-                self._managed[node.node_id] = (t.name, time.monotonic())
-                logger.info("autoscaler launched node %s type=%s", node.node_id.hex()[:8], t.name)
+            to_launch = launches[: self.upscaling_speed]
 
+        # launch OUTSIDE the lock: a command provider can take minutes per
+        # node (ssh, VM boot) and must not block adopt()/status()/stop()
+        for t in to_launch:
+            if self._stopped.is_set():
+                return
+            try:
+                node = self.provider.create_node(t)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("autoscaler launch of %s failed: %s", t.name, e)
+                continue
+            with self._lock:
+                self._managed[node.node_id] = (t.name, time.monotonic())
+            logger.info("autoscaler launched node %s type=%s", node.node_id.hex()[:8], t.name)
+
+        with self._lock:
+            nodes = self.rt.node_list()
             # scale-down: managed nodes idle past the timeout, above min
             now = time.monotonic()
             for n in nodes:

@@ -142,9 +142,11 @@ def up(config_path: str, block: bool = True) -> Cluster:
 
 def down() -> bool:
     """Stop the newest LIVE `rt up` head (SIGTERM via its pidfile). Dead
-    pidfiles are cleaned up and skipped, so a stale file can never shadow
-    a live head or hit a recycled pid."""
-    root = "/tmp/ray_tpu"
+    pidfiles are cleaned up and skipped; a recycled pid is rejected by a
+    /proc cmdline check (the process must still be a python head)."""
+    from ray_tpu.util.state import session_dir
+
+    root = os.path.dirname(session_dir())
     candidates = []
     try:
         sessions = os.listdir(root)
@@ -164,12 +166,20 @@ def down() -> bool:
         except (OSError, ValueError):
             continue
         # liveness + identity: the pid must still be the session owner
-        # (the session dir is named after the head's own pid)
+        # (dir is named after the head's own pid), still alive, and still
+        # a python process — a recycled pid fails the cmdline check
         if f"session_{pid}" not in p:
             continue
+        alive = True
         try:
             os.kill(pid, 0)
-        except (ProcessLookupError, PermissionError):
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmdline = f.read()
+            if b"python" not in cmdline and b"rt" not in cmdline:
+                alive = False
+        except (ProcessLookupError, PermissionError, OSError):
+            alive = False
+        if not alive:
             try:
                 os.unlink(p)  # stale: clean up so it can't shadow anything
             except OSError:

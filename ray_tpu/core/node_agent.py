@@ -136,6 +136,7 @@ def agent_entry(
     transfer_authkey: bytes = b"",
     resources: dict | None = None,
     reconnect_s: float | None = None,
+    labels: dict | None = None,
 ):
     """Main loop of the node-agent process. ``resources`` rides in every
     hello so a RESTARTED head (same node_manager_port) can adopt this agent
@@ -183,7 +184,7 @@ def agent_entry(
                 "transfer_addr": transfer_srv.address,
                 "ns": my_ns,
                 "resources": resources,
-                "labels": None,
+                "labels": labels,
             }
         )
 
@@ -450,6 +451,7 @@ def standalone_agent_main(
     resources: dict,
     env: dict | None = None,
     reconnect_s: float = 60.0,
+    labels: dict | None = None,
 ):
     """Entry for ``rt agent --address head:port`` — a node agent on (
     typically) another host joining an existing cluster over TCP. Blocks
@@ -468,4 +470,5 @@ def standalone_agent_main(
         transfer_authkey=transfer_authkey,
         resources=resources,
         reconnect_s=reconnect_s,
+        labels=labels,
     )

@@ -118,8 +118,9 @@ def cmd_agent(args):
     resources = {"CPU": float(args.num_cpus)}
     if args.num_tpus:
         resources["TPU"] = float(args.num_tpus)
+    labels = {"ray_tpu.io/join-token": args.join_token} if args.join_token else None
     print(f"joining head at {host}:{port} with {resources}", flush=True)
-    standalone_agent_main(host, int(port), authkey, transfer_key, resources, reconnect_s=args.reconnect)
+    standalone_agent_main(host, int(port), authkey, transfer_key, resources, reconnect_s=args.reconnect, labels=labels)
 
 
 def main(argv=None):
@@ -137,6 +138,7 @@ def main(argv=None):
     ap.add_argument("--num-cpus", type=float, default=1.0)
     ap.add_argument("--num-tpus", type=float, default=0.0)
     ap.add_argument("--reconnect", type=float, default=60.0, help="seconds to keep redialing a lost head (head FT window)")
+    ap.add_argument("--join-token", default=None, help="opaque token echoed in the hello so a provider can match this agent to its launch")
     up = sub.add_parser("up", help="launch a cluster from a YAML/JSON config (head + autoscaler)")
     up.add_argument("config")
     sub.add_parser("down", help="stop the most recent `rt up` head")

@@ -16,6 +16,7 @@ from ray_tpu.serve.deployment import Application, build_app_spec
 from ray_tpu.serve.handle import DeploymentHandle
 
 _http_proxy = None
+_grpc_proxy = None
 
 
 def _get_or_create_controller(http_options: HTTPOptions | None = None):
@@ -31,12 +32,20 @@ def _get_or_create_controller(http_options: HTTPOptions | None = None):
     )
 
 
-def start(http_options: HTTPOptions | None = None, proxy: bool = False):
+def start(http_options: HTTPOptions | None = None, proxy: bool = False, grpc_port: int | None = None):
     """Start the Serve control plane (idempotent); optionally the HTTP
-    proxy (reference: serve.start(http_options=...))."""
+    proxy and/or the gRPC ingress (reference: serve.start(http_options=
+    ..., grpc_options=...); grpc_port=0 picks a free port — read it back
+    from serve.api._grpc_proxy.port)."""
     controller = _get_or_create_controller(http_options)
     if proxy:
         _ensure_proxy(controller, http_options or HTTPOptions())
+    if grpc_port is not None:
+        global _grpc_proxy
+        if _grpc_proxy is None:
+            from ray_tpu.serve._grpc_proxy import GrpcProxy
+
+            _grpc_proxy = GrpcProxy(controller, port=grpc_port)
     return controller
 
 
@@ -93,11 +102,14 @@ def get_deployment_handle(deployment: str, app_name: str = "default") -> Deploym
 
 
 def shutdown():
-    """Tear down all applications, replicas, proxy, and the controller."""
-    global _http_proxy
+    """Tear down all applications, replicas, proxies, and the controller."""
+    global _grpc_proxy, _http_proxy
     if _http_proxy is not None:
         _http_proxy.stop()
         _http_proxy = None
+    if _grpc_proxy is not None:
+        _grpc_proxy.stop()
+        _grpc_proxy = None
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:

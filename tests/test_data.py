@@ -289,15 +289,15 @@ def test_join_empty_side(rt_start):
 # locality (reference: output_splitter.py locality routing + locality-
 # aware dispatch in the streaming executor)
 # ----------------------------------------------------------------------
-def _locality_cluster():
+def _locality_cluster(node_cpus: float = 2.0):
     import ray_tpu
     from ray_tpu.core import context as core_ctx
 
     ray_tpu.shutdown()
     ray_tpu.init(num_cpus=2)
     client = core_ctx.get_client()
-    na = client.add_node({"CPU": 2.0, "na": 1.0}, shm_isolation=True)
-    nb = client.add_node({"CPU": 2.0, "nb": 1.0}, shm_isolation=True)
+    na = client.add_node({"CPU": node_cpus, "na": 1.0}, shm_isolation=True)
+    nb = client.add_node({"CPU": node_cpus, "nb": 1.0}, shm_isolation=True)
     return client, na, nb
 
 
@@ -356,7 +356,10 @@ def test_map_tasks_dispatch_to_block_node():
     from ray_tpu.data.block import BlockAccessor
     from ray_tpu.data.dataset import MaterializedDataset
 
-    client, na, nb = _locality_cluster()
+    # to_arrow_refs drives the whole stream, so all 6 map tasks submit
+    # CONCURRENTLY: size node A to hold them all and the soft preference
+    # is deterministic (with fewer CPUs the excess soft-spills by design)
+    client, na, nb = _locality_cluster(node_cpus=8.0)
     try:
         refs_a = _blocks_on("na", 6, 0.0)
         ray_tpu.wait(refs_a, num_returns=6, timeout=120)
@@ -367,15 +370,14 @@ def test_map_tasks_dispatch_to_block_node():
             nid = core_ctx.get_client().node_id.hex()
             return {"nid": np.array([int(nid[:8], 16)])}
 
-        # concurrency <= node A's CPUs so soft affinity has room on A
-        ds = MaterializedDataset(refs_a).map_batches(where, batch_size=None, concurrency=2)
+        ds = MaterializedDataset(refs_a).map_batches(where, batch_size=None)
         out = [ray_tpu.get(r) for r in ds.to_arrow_refs()]
         ran_on = [int(BlockAccessor(o).to_batch("numpy")["nid"][0]) for o in out]
         expect = int(na.node_id.hex()[:8], 16)
         frac_local = sum(1 for n in ran_on if n == expect) / len(ran_on)
-        # soft affinity: the block's node is preferred whenever it has
-        # capacity; demand a clear majority, not unanimity
-        assert frac_local >= 0.5, (ran_on, expect)
+        # soft affinity: preferred whenever the node has capacity — which
+        # sequential dispatch guarantees here
+        assert frac_local >= 0.8, (ran_on, expect)
     finally:
         ray_tpu.shutdown()
 

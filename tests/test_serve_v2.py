@@ -199,3 +199,27 @@ def test_shutdown_hook_runs_on_drain(serve_session, tmp_path):
         assert time.time() < deadline, "shutdown hook never ran"
         time.sleep(0.1)
     assert open(marker).read() == "clean"
+
+
+def test_grpc_ingress_unary_and_streaming(serve_session):
+    """gRPC ingress (reference: Serve gRPC proxy): generic proto-less
+    method over real gRPC framing, unary + server-streaming."""
+    from ray_tpu.serve._grpc_proxy import grpc_call, grpc_call_streaming
+
+    @serve.deployment
+    class Api:
+        def __call__(self, x):
+            return {"doubled": x * 2}
+
+        def tokens(self, n):
+            for i in range(n):
+                yield {"t": i}
+
+    serve.run(Api.bind(), name="grpc_app")
+    serve.start(grpc_port=0)
+    addr = f"127.0.0.1:{serve.api._grpc_proxy.port}"
+    assert grpc_call(addr, "grpc_app", 21) == {"doubled": 42}
+    items = list(grpc_call_streaming(addr, "grpc_app", 3, method="tokens"))
+    assert items == [{"t": 0}, {"t": 1}, {"t": 2}]
+    with pytest.raises(RuntimeError):
+        grpc_call(addr, "no_such_app", 1)
