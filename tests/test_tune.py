@@ -231,8 +231,10 @@ def test_logger_callbacks_write_files(rt_start, tmp_path):
 
     import pytest as _pytest
 
-    with _pytest.raises(NotImplementedError, match="Wandb"):
-        tune.WandbLoggerCallback()
+    # offline mode constructs fine; ONLINE mode stays rejected (no egress)
+    tune.WandbLoggerCallback()
+    with _pytest.raises(NotImplementedError, match="offline"):
+        tune.WandbLoggerCallback(mode="online")
 
 
 def test_placement_group_factory_basics():
