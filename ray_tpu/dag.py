@@ -5,6 +5,13 @@ ClassMethodNode; compiled execution in compiled_dag_node.py). This module
 provides the lazy .bind()/.execute() graph; compiled-graph channel execution
 for accelerator pipelines lives in ray_tpu.parallel.pipeline (the TPU-native
 equivalent of NCCL-channel compiled graphs).
+
+Data-plane note: every DAG edge passes the upstream ObjectRef STRAIGHT
+into the downstream task's args (no driver-side get), so edge bytes move
+store-to-store — same-host via zero-copy shm attach, cross-host via the
+chunked transfer service (core/transport.py) — while the head carries
+only the submit control messages. execute() returns leaf ObjectRefs
+without waiting, so successive invocations pipeline naturally.
 """
 
 from __future__ import annotations
