@@ -178,7 +178,7 @@ ray_tpu.init(num_cpus=1, _system_config={
     "node_manager_port": int(os.environ["NM_PORT"]),
 })
 client = context.get_client()
-deadline = time.monotonic() + 60
+deadline = time.monotonic() + 120
 while not any(n.labels.get("ray_tpu.io/node-type") == "joined" for n in client.node_list()):
     assert time.monotonic() < deadline, "agent never joined head1"
     time.sleep(0.2)
@@ -197,7 +197,7 @@ ray_tpu.init(num_cpus=1, _system_config={
     "node_manager_port": int(os.environ["NM_PORT"]),
 })
 client = context.get_client()
-deadline = time.monotonic() + 60
+deadline = time.monotonic() + 120
 joined = None
 while joined is None:
     assert time.monotonic() < deadline, "agent never re-joined head2"
@@ -248,8 +248,20 @@ def test_agent_reconnects_to_restarted_head(tmp_path):
         agent_env = dict(os.environ)
         agent_env.pop("RT_SHM_NS", None)
         agent_env["PYTHONPATH"] = REPO
+        # target head1 EXPLICITLY (auto-discovery could race other live
+        # sessions' cluster_info under pytest)
+        import json as _json
+
+        with open(info_path) as f:
+            info = _json.load(f)
         agent = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.scripts.cli", "agent", "--num-cpus", "2", "--reconnect", "90"],
+            [
+                sys.executable, "-m", "ray_tpu.scripts.cli", "agent",
+                "--address", f"{info['agent_address'][0]}:{info['agent_address'][1]}",
+                "--authkey", info["authkey"],
+                "--transfer-authkey", info["transfer_authkey"],
+                "--num-cpus", "2", "--reconnect", "240",
+            ],
             env=agent_env,
             cwd=REPO,
             stdout=subprocess.PIPE,
