@@ -22,6 +22,7 @@ from ray_tpu.rllib.env.env_runner import EnvRunnerGroup
 
 class Algorithm:
     learner_cls: type = None  # set by subclasses
+    supports_offline_input = False  # DQN-family overrides
 
     def __init__(self, config):
         self.config = config
@@ -33,6 +34,11 @@ class Algorithm:
     def setup(self):
         cfg = self.config
         assert cfg.env is not None, "config.environment(env=...) is required"
+        if cfg.input_ and not type(self).supports_offline_input:
+            raise NotImplementedError(
+                f"{type(self).__name__} does not support offline_data(input_=...); "
+                "use an off-policy algorithm (DQN)"
+            )
         # spaces come from a throwaway env (cheap for gym registry ids)
         import gymnasium as gym
 
@@ -48,6 +54,9 @@ class Algorithm:
             num_env_runners=cfg.num_env_runners,
             num_envs_per_env_runner=cfg.num_envs_per_env_runner,
             seed=cfg.seed,
+            # offline mode evaluates greedily through the same runners;
+            # recording those eval episodes would pollute the dataset
+            output=None if cfg.input_ else cfg.output,
         )
         from ray_tpu.rllib.core.learner import LearnerGroup
 

@@ -28,6 +28,9 @@ class AlgorithmConfig:
         self.num_epochs: int = 1
         self.grad_clip: float | None = None
         self.model: dict = {}
+        # offline data (reference: config.offline_data(input_=..., output=...))
+        self.output: str | None = None  # record sampled episodes to JSONL
+        self.input_: str | None = None  # train from recorded episodes
         # rl module
         self.module_class: type | None = None
         # debugging
@@ -60,6 +63,13 @@ class AlgorithmConfig:
             if not hasattr(self, k):
                 raise AttributeError(f"unknown training option {k!r}")
             setattr(self, k, v)
+        return self
+
+    def offline_data(self, *, input_: str | None = None, output: str | None = None):
+        if input_ is not None:
+            self.input_ = input_
+        if output is not None:
+            self.output = output
         return self
 
     def rl_module(self, *, module_class: type | None = None, model_config: dict | None = None):

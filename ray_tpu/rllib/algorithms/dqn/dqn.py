@@ -92,6 +92,10 @@ class DQNConfig(AlgorithmConfig):
         self.epsilon_timesteps = 10_000
         self.rollout_fragment_length = 64
         self.train_intensity = 2.0  # learner sgd steps per env step / batch size
+        # offline mode (config.offline_data(input_=path)): TD updates per
+        # train() iteration drawn from the recorded dataset; env runners
+        # only evaluate (explore=False)
+        self.offline_updates_per_iter = 50
         self.module_class = QModule
 
     @property
@@ -153,6 +157,7 @@ class DQNLearner(Learner):
 
 class DQN(Algorithm):
     learner_cls = DQNLearner
+    supports_offline_input = True
 
     def _epsilon(self) -> float:
         cfg = self.config
@@ -172,12 +177,32 @@ class DQN(Algorithm):
         else:
             self.replay = EpisodeReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
         self._steps_since_target_sync = 0
+        self._offline = bool(cfg.input_)
+        if self._offline:
+            # fixed-dataset training (reference: offline DQN over
+            # offline/json_reader.py input): fill the buffer once
+            from ray_tpu.rllib.offline import JsonReader
+
+            n = 0
+            for episode in JsonReader(cfg.input_):
+                n += len(self.replay.add(episode))
+                if n > self.replay.capacity:
+                    raise ValueError(
+                        f"offline dataset {cfg.input_!r} exceeds replay_buffer_capacity "
+                        f"({self.replay.capacity}): the ring would silently drop early "
+                        "transitions — raise replay_buffer_capacity to at least the dataset size"
+                    )
+            if n == 0:
+                raise ValueError(f"offline input {cfg.input_!r} contained no transitions")
+            self._offline_transitions = n
 
     @property
     def _learner(self) -> DQNLearner:
         return self.learner_group._local
 
     def training_step(self) -> dict:
+        if self._offline:
+            return self._offline_training_step()
         cfg = self.config
         eps = self._epsilon()
         self.env_runner_group.set_exploration(eps=eps)
@@ -211,4 +236,26 @@ class DQN(Algorithm):
         result["learner"] = {"num_updates": num_updates, **metrics}
         result["num_env_steps_sampled_lifetime"] = self._total_env_steps
         result["epsilon"] = eps
+        return result
+
+    def _offline_training_step(self) -> dict:
+        """Train from the recorded dataset; the env (if any) is used for
+        greedy EVALUATION only — no new experience enters the buffer."""
+        cfg = self.config
+        metrics = {}
+        for _ in range(cfg.offline_updates_per_iter):
+            batch = self.replay.sample(cfg.train_batch_size)
+            metrics, td_abs = self._learner.update_dqn(batch)
+            if cfg.prioritized_replay:
+                self.replay.update_priorities(batch["batch_indices"], td_abs)
+            self._steps_since_target_sync += 1
+            if self._steps_since_target_sync >= max(1, cfg.target_network_update_freq // cfg.train_batch_size):
+                self._learner.sync_target()
+                self._steps_since_target_sync = 0
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        self.env_runner_group.set_exploration(eps=0.0)
+        _, runner_metrics = self.env_runner_group.sample(cfg.rollout_fragment_length, explore=False)
+        result = self._merge_runner_metrics(runner_metrics)
+        result["learner"] = {"num_updates": cfg.offline_updates_per_iter, **metrics}
+        result["offline_transitions"] = self._offline_transitions
         return result

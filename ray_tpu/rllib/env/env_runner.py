@@ -161,8 +161,16 @@ class EnvRunnerGroup:
     num_env_runners == 0 (reference env_runner_group.py local-worker
     semantics)."""
 
-    def __init__(self, module_spec, env_id, env_config=None, num_env_runners: int = 0, num_envs_per_env_runner: int = 1, seed: int = 0):
+    def __init__(self, module_spec, env_id, env_config=None, num_env_runners: int = 0, num_envs_per_env_runner: int = 1, seed: int = 0, output: str | None = None):
         self.num_env_runners = num_env_runners
+        # offline-data recording (reference: offline/json_writer.py via
+        # config.offline_data(output=...)): every collected episode batch
+        # is appended to JSONL shards as it arrives at the driver
+        self._writer = None
+        if output:
+            from ray_tpu.rllib.offline import JsonWriter
+
+            self._writer = JsonWriter(output)
         if num_env_runners == 0:
             self._local = SingleAgentEnvRunner(module_spec, env_id, env_config, num_envs_per_env_runner, seed)
             self._actors = []
@@ -195,6 +203,7 @@ class EnvRunnerGroup:
         """Returns (all segment batches, per-runner metrics list)."""
         if self._local is not None:
             segs, m = self._local.sample(num_steps, explore)
+            self._record(segs)
             return segs, [m]
         return self.collect(self.sample_async(num_steps, explore))
 
@@ -212,9 +221,18 @@ class EnvRunnerGroup:
         for segs, m in outs:
             segments.extend(segs)
             metrics.append(m)
+        self._record(segments)
         return segments, metrics
 
+    def _record(self, segments):
+        if self._writer is not None:
+            for s in segments:
+                self._writer.write(s)
+
     def stop(self):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
         for a in self._actors:
             ray_tpu.kill(a)
         self._actors = []

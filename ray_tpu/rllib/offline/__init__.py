@@ -1,0 +1,3 @@
+from ray_tpu.rllib.offline.json_io import JsonReader, JsonWriter, read_episodes, write_episodes
+
+__all__ = ["JsonReader", "JsonWriter", "read_episodes", "write_episodes"]
