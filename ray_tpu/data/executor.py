@@ -201,16 +201,49 @@ def _sample_block(block: Block, key: str, k: int):
 # ----------------------------------------------------------------------
 # streaming pipeline
 # ----------------------------------------------------------------------
+class _OpResourcePool:
+    """Process-wide memory pool DYNAMICALLY shared by every active stage
+    (reference: streaming_executor_state.py:745 under_resource_limits over
+    resource_manager.py's per-op budgets): each live OpBudget reports its
+    estimated in-flight bytes; a stage's share is whatever the pool still
+    has, so one active op can use the whole budget while an idle pipeline
+    neighbor releases its claim — instead of the static 1/num_stages
+    split."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._usage: dict[int, int] = {}  # id(OpBudget) -> est. in-flight bytes
+
+    def report(self, op_id: int, inflight_bytes: int):
+        with self._lock:
+            self._usage[op_id] = int(inflight_bytes)
+
+    def release(self, op_id: int):
+        with self._lock:
+            self._usage.pop(op_id, None)
+
+    def available(self, op_id: int, total_budget: int) -> int:
+        with self._lock:
+            others = sum(v for k, v in self._usage.items() if k != op_id)
+        return max(0, total_budget - others)
+
+
+_op_pool = _OpResourcePool()
+
+
 class OpBudget:
     """Resource-aware in-flight budget for one pipeline stage.
 
     Replaces the fixed window the round-1 review flagged (reference:
     _internal/execution/streaming_executor_state.py:745 under_resource
-    _limits + resource_manager.py). Two constraints, re-evaluated as
-    blocks are observed:
+    _limits + resource_manager.py). Constraints, re-evaluated as blocks
+    are observed:
     - CPU: in-flight tasks <= cluster CPUs / task num_cpus (+ headroom),
-    - memory: in-flight bytes <= a fraction of the object-store budget /
-      concurrent stages, using a running mean of observed block sizes.
+    - memory: in-flight bytes <= the share of the GLOBAL object-store
+      budget the other active stages are not using (running mean of
+      observed block sizes x in-flight count, reported to _op_pool).
     An explicit user `concurrency=` wins outright.
     """
 
@@ -230,7 +263,19 @@ class OpBudget:
         except Exception:
             cpus, store_budget = 4.0, 2 << 30
         self._cpu_cap = max(self.MIN_WINDOW, int(cpus / max(num_cpus_per_task, 0.25)) + 1)
-        self._mem_budget = max(64 << 20, store_budget // (2 * max(num_stages, 1)))
+        # the pool-wide memory budget; this op's share is computed live
+        self._total_budget = max(64 << 20, store_budget // 2)
+        self._floor = max(64 << 20, self._total_budget // (4 * max(num_stages, 1)))
+
+    def _mean_block(self) -> float:
+        return self._block_bytes_sum / self._block_count if self._block_count else 0.0
+
+    def set_inflight(self, n: int):
+        """Report this stage's in-flight estimate to the shared pool."""
+        _op_pool.report(id(self), int(n * self._mean_block()))
+
+    def close(self):
+        _op_pool.release(id(self))
 
     def try_observe(self, ref) -> bool:
         """Record a block's size if it is sealed in the store yet; returns
@@ -255,8 +300,11 @@ class OpBudget:
             return self.explicit
         w = self._cpu_cap
         if self._block_count:
-            mean = self._block_bytes_sum / self._block_count
-            w = min(w, int(self._mem_budget / max(mean, 1)))
+            mean = self._mean_block()
+            # dynamic share: whatever the other active stages aren't
+            # using right now, never below a per-stage floor (liveness)
+            share = max(self._floor, _op_pool.available(id(self), self._total_budget))
+            w = min(w, int(share / max(mean, 1)))
         return max(self.MIN_WINDOW, min(self.MAX_WINDOW, w))
 
 
@@ -276,15 +324,19 @@ def _windowed(submits: Iterator, budget: "OpBudget | int"):
             if not budget.try_observe(ref):
                 unobserved.append(ref)
 
-    for submit in submits:
-        inflight.append(submit())
-        sweep()
-        while len(inflight) >= budget.window:
-            ref = inflight.popleft()
-            unobserved.append(ref)
-            yield ref
-    while inflight:
-        yield inflight.popleft()
+    try:
+        for submit in submits:
+            inflight.append(submit())
+            sweep()
+            budget.set_inflight(len(inflight) + len(unobserved))
+            while len(inflight) >= budget.window:
+                ref = inflight.popleft()
+                unobserved.append(ref)
+                yield ref
+        while inflight:
+            yield inflight.popleft()
+    finally:
+        budget.close()  # release this stage's pool claim
 
 
 def execute_plan(source_tasks: list, ops: list) -> Iterator:

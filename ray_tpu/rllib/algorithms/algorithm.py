@@ -39,6 +39,12 @@ class Algorithm:
                 f"{type(self).__name__} does not support offline_data(input_=...); "
                 "use an off-policy algorithm (DQN)"
             )
+        if cfg.input_ and cfg.output:
+            raise ValueError(
+                "offline_data(input_=..., output=...) conflict: offline mode "
+                "evaluates greedily and recording those episodes would pollute "
+                "the dataset — drop output for offline training"
+            )
         # spaces come from a throwaway env (cheap for gym registry ids)
         import gymnasium as gym
 
@@ -54,9 +60,7 @@ class Algorithm:
             num_env_runners=cfg.num_env_runners,
             num_envs_per_env_runner=cfg.num_envs_per_env_runner,
             seed=cfg.seed,
-            # offline mode evaluates greedily through the same runners;
-            # recording those eval episodes would pollute the dataset
-            output=None if cfg.input_ else cfg.output,
+            output=cfg.output,  # input_+output conflicts rejected above
         )
         from ray_tpu.rllib.core.learner import LearnerGroup
 

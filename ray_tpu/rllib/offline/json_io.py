@@ -20,9 +20,15 @@ class JsonWriter:
     """Append episode batches to sharded JSONL files."""
 
     def __init__(self, path: str, max_rows_per_shard: int = 5000):
+        import uuid
+
         self.path = path
         os.makedirs(path, exist_ok=True)
         self.max_rows = max_rows_per_shard
+        # unique per WRITER, not just per pid: two writers in one process
+        # (sequential runs on the same path) must not append to the same
+        # shard file
+        self._tag = f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
         self._shard = 0
         self._rows = 0
         self._f = None
@@ -33,7 +39,7 @@ class JsonWriter:
                 self._f.close()
                 self._shard += 1
                 self._rows = 0
-            self._f = open(os.path.join(self.path, f"episodes-{os.getpid()}-{self._shard:05d}.jsonl"), "a", buffering=1)
+            self._f = open(os.path.join(self.path, f"episodes-{self._tag}-{self._shard:05d}.jsonl"), "a", buffering=1)
         return self._f
 
     def write(self, episode_batch: dict):

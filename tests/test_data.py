@@ -192,7 +192,7 @@ def test_op_budget_resource_aware(rt_start):
     w0 = b.window
     assert OpBudget.MIN_WINDOW <= w0 <= OpBudget.MAX_WINDOW
     # simulate huge observed blocks: memory constraint must bind
-    b._block_bytes_sum = b._mem_budget * 10
+    b._block_bytes_sum = b._total_budget * 10
     b._block_count = 1
     assert b.window == OpBudget.MIN_WINDOW
     # explicit user concurrency always wins
@@ -405,3 +405,39 @@ def test_groupby_on_float_keys():
         assert out == {0.0: 8, 0.25: 8, 0.5: 8, 0.75: 8}, out
     finally:
         ray_tpu.shutdown()
+
+
+def test_op_budget_pool_is_shared_dynamically():
+    """Per-op dynamic resource scheduling (reference:
+    streaming_executor_state.py:745): an op's memory share is what the
+    OTHER active ops aren't using — it shrinks while a neighbor is busy
+    and recovers when that neighbor finishes."""
+    from ray_tpu._config import get_config, reset_config
+    from ray_tpu.data.executor import OpBudget, _op_pool
+
+    reset_config()
+    a = OpBudget(num_cpus_per_task=0.25, num_stages=2)
+    b = OpBudget(num_cpus_per_task=0.25, num_stages=2)
+    try:
+        # pin the knobs so neither the host's CPU count nor the minimum
+        # floors mask the memory-sharing path under test
+        for op in (a, b):
+            op._cpu_cap = 1000
+            op._total_budget = 32 * 2**20
+            op._floor = 2 * 2**20
+            op._block_bytes_sum, op._block_count = 8 * 2**20, 8  # 1 MiB blocks
+        b.set_inflight(0)
+        idle_window = a.window
+        # b claims 24 MiB of the 32 MiB pool -> a's share collapses
+        b.set_inflight(24)
+        busy_window = a.window
+        assert busy_window < idle_window, (busy_window, idle_window)
+        # b finishes: a recovers the full pool
+        b.close()
+        assert a.window == idle_window
+        # floor keeps a live even under total pressure
+        assert busy_window >= OpBudget.MIN_WINDOW
+    finally:
+        a.close()
+        b.close()
+        reset_config()
