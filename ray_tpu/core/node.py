@@ -22,23 +22,19 @@ _mp_ctx = None
 
 
 def stop_forkserver():
-    """Stop the multiprocessing forkserver (if running). The forkserver
-    holds a copy of the resource tracker's pipe fd; if the tracker's
-    finalizer runs at interpreter teardown while the forkserver is still
-    alive, os.waitpid deadlocks. The stop itself can block the same way
-    (a straggler worker forked from the server keeps its alive-fd open),
-    so it runs under a watchdog that falls back to SIGKILL. It restarts
-    on demand at the next spawn."""
+    """Stop the multiprocessing forkserver AND resource tracker (if
+    running), each under a SIGKILL watchdog. Both hold pipes whose other
+    ends can be kept open by straggler forked children; their finalizers
+    then block interpreter exit in os.waitpid forever. Stopping them here
+    (registered as a ONE-TIME atexit hook by the runtime) bounds teardown
+    to a few seconds no matter what leaked; both restart on demand at the
+    next spawn."""
     global _mp_ctx
-    try:
-        import os
-        import signal as _signal
+    import os
+    import signal as _signal
 
-        from multiprocessing import forkserver
-
-        fs = forkserver._forkserver
-        pid = getattr(fs, "_forkserver_pid", None)
-        t = threading.Thread(target=fs._stop, daemon=True, name="rt-fks-stop")
+    def _watchdog_stop(stop_fn, pid, name):
+        t = threading.Thread(target=stop_fn, daemon=True, name=f"rt-{name}-stop")
         t.start()
         t.join(3.0)
         if t.is_alive() and pid:
@@ -47,6 +43,24 @@ def stop_forkserver():
             except OSError:
                 pass
             t.join(2.0)
+
+    try:
+        from multiprocessing import forkserver
+
+        fs = forkserver._forkserver
+        _watchdog_stop(fs._stop, getattr(fs, "_forkserver_pid", None), "fks")
+    except Exception:
+        pass
+    try:
+        from multiprocessing import resource_tracker
+
+        rt = resource_tracker._resource_tracker
+        if getattr(rt, "_pid", None) is not None:
+            _watchdog_stop(rt._stop, rt._pid, "tracker")
+            # a watchdog kill leaves _pid set; clear it so the module
+            # finalizer's second _stop can't re-enter waitpid
+            rt._pid = None
+            rt._fd = None
     except Exception:
         pass
     _mp_ctx = None

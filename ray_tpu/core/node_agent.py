@@ -227,6 +227,20 @@ def agent_entry(
             proc.start()
         child_conn.close()
         with lock:
+            if shutdown.is_set():
+                # spawn raced the drain (first spawn = seconds of
+                # forkserver boot): an unregistered orphan would hold the
+                # forkserver/resource-tracker pipes and wedge this agent's
+                # exit (and, transitively, the head's interpreter exit)
+                try:
+                    proc.terminate()
+                except Exception:
+                    pass
+                try:
+                    parent_conn.close()
+                except Exception:
+                    pass
+                return
             workers[wid_hex] = (proc, parent_conn)
         send_head({"type": "worker_started", "wid": wid_hex, "pid": proc.pid})
 
