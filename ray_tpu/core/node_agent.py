@@ -201,6 +201,8 @@ def agent_entry(
     send_lock = threading.Lock()
     shutdown = threading.Event()  # definitive shutdown (no reconnect)
     conn_lost = threading.Event()  # head connection dropped
+    draining = threading.Event()  # a worker-kill drain is in progress
+    spawn_threads: list = []  # in-flight start_worker threads
 
     def send_head(msg):
         with send_lock:
@@ -227,13 +229,15 @@ def agent_entry(
             proc.start()
         child_conn.close()
         with lock:
-            if shutdown.is_set():
-                # spawn raced the drain (first spawn = seconds of
-                # forkserver boot): an unregistered orphan would hold the
-                # forkserver/resource-tracker pipes and wedge this agent's
-                # exit (and, transitively, the head's interpreter exit)
+            if shutdown.is_set() or draining.is_set():
+                # spawn raced a drain (first spawn = seconds of forkserver
+                # boot): an unregistered orphan would hold the forkserver/
+                # resource-tracker pipes and wedge this agent's exit (and,
+                # transitively, the head's interpreter exit) — and after a
+                # reconnect the head wouldn't know this worker anyway
                 try:
                     proc.terminate()
+                    proc.join(timeout=2.0)  # reap: no zombie either
                 except Exception:
                     pass
                 try:
@@ -348,6 +352,13 @@ def agent_entry(
             # on a fixed node_manager_port) and re-hello as a join
             if reconnect_s <= 0:
                 break
+            # drain protocol: flag first so racing spawns self-reap, wait
+            # out in-flight spawns (forkserver boot takes seconds), THEN
+            # kill — otherwise a late registration leaks a worker the
+            # (restarted) head knows nothing about
+            draining.set()
+            for t in list(spawn_threads):
+                t.join(timeout=15.0)
             kill_all_workers()  # head lost all task state
             resolver = _NsResolver(send_head)  # old transfer addrs are stale
             new_conn = None
@@ -365,6 +376,7 @@ def agent_entry(
                 pass
             conn = new_conn
             conn_lost.clear()
+            draining.clear()  # fresh head may start workers again
             try:
                 send_hello(conn)
             except (OSError, EOFError):
@@ -396,7 +408,10 @@ def agent_entry(
                         except Exception as e:  # noqa: BLE001
                             send_head({"type": "worker_death", "wid": wid, "reason": f"spawn failed: {e}"})
 
-                    threading.Thread(target=_spawn, daemon=True).start()
+                    t = threading.Thread(target=_spawn, daemon=True)
+                    t.start()
+                    spawn_threads.append(t)
+                    spawn_threads[:] = [x for x in spawn_threads if x.is_alive()]
                 elif t == "to_worker":
                     with lock:
                         entry = workers.get(msg["wid"])
@@ -432,7 +447,14 @@ def agent_entry(
                     continue
                 handle_worker_frame(wid, data)
 
-    # drain: kill workers, close head socket
+    # drain: kill workers, close head socket. shutdown covers the break
+    # exits (conn loss without reconnect, reconnect timeout) so racing
+    # spawns self-reap, and in-flight spawns are waited out BEFORE the
+    # forkserver stops — a post-stop proc.start() would re-boot the
+    # forkserver/tracker and resurrect the exit deadlock
+    shutdown.set()
+    for t in list(spawn_threads):
+        t.join(timeout=15.0)
     kill_all_workers()
     from ray_tpu.core.node import stop_forkserver
 
