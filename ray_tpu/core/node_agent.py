@@ -376,7 +376,20 @@ def agent_entry(
                 pass
             conn = new_conn
             conn_lost.clear()
-            draining.clear()  # fresh head may start workers again
+            stragglers = [t for t in spawn_threads if t.is_alive()]
+            if stragglers:
+                # a spawn outlived even the drain wait (overloaded node):
+                # keep draining set so it self-reaps, and clear only once
+                # every straggler has finished — a fixed-delay clear would
+                # reopen the late-registration leak
+                def _clear_when_done(ts=stragglers):
+                    for t in ts:
+                        t.join()
+                    draining.clear()
+
+                threading.Thread(target=_clear_when_done, daemon=True).start()
+            else:
+                draining.clear()  # fresh head may start workers again
             try:
                 send_hello(conn)
             except (OSError, EOFError):

@@ -16,6 +16,7 @@ from ray_tpu.serve.api import (
     status,
 )
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions, ReplicaConfig
 from ray_tpu.serve.deployment import Application, Deployment, deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse, DeploymentResponseGenerator
@@ -33,6 +34,8 @@ __all__ = [
     "ReplicaConfig",
     "Request",
     "batch",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "delete",
     "deployment",
     "get_app_handle",

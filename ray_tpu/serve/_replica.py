@@ -122,20 +122,39 @@ class Replica:
             return self._callable
         return getattr(self._callable, method_name)
 
-    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+    @staticmethod
+    def _set_model_id(model_id):
+        from ray_tpu.serve.multiplex import _set_multiplexed_model_id
+
+        _set_multiplexed_model_id(model_id or "")
+
+    def _with_model_ctx(self, coro, model_id):
+        """Carry the request's model id onto the actor event loop (the
+        contextvar set in this pool thread doesn't cross threads)."""
+
+        async def _inner():
+            self._set_model_id(model_id)
+            return await coro
+
+        return _inner()
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict, multiplexed_model_id: str | None = None):
         with self._lock:
             self._ongoing += 1
             self._total += 1
         try:
+            self._set_model_id(multiplexed_model_id)
             result = self._target(method_name)(*args, **(kwargs or {}))
             if inspect.iscoroutine(result):
-                result = asyncio.run_coroutine_threadsafe(result, self._loop).result()
+                result = asyncio.run_coroutine_threadsafe(
+                    self._with_model_ctx(result, multiplexed_model_id), self._loop
+                ).result()
             return result
         finally:
             with self._lock:
                 self._ongoing -= 1
 
-    def handle_request_streaming(self, method_name: str, args: tuple, kwargs: dict):
+    def handle_request_streaming(self, method_name: str, args: tuple, kwargs: dict, multiplexed_model_id: str | None = None):
         """Generator method: items stream back through the runtime's
         streaming-generator path (reference: handle_request_streaming,
         serve/_private/replica.py). Called with num_returns='streaming'."""
@@ -143,9 +162,12 @@ class Replica:
             self._ongoing += 1
             self._total += 1
         try:
+            self._set_model_id(multiplexed_model_id)
             result = self._target(method_name)(*args, **(kwargs or {}))
             if inspect.iscoroutine(result):
-                result = asyncio.run_coroutine_threadsafe(result, self._loop).result()
+                result = asyncio.run_coroutine_threadsafe(
+                    self._with_model_ctx(result, multiplexed_model_id), self._loop
+                ).result()
             if inspect.isasyncgen(result):
                 while True:
                     try:

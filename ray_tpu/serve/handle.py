@@ -143,6 +143,12 @@ class _Router:
         self._queued = 0
         self._last_refresh = 0.0
         self._last_push = 0.0
+        from collections import OrderedDict
+
+        # model_id -> last replica, LRU-capped so unbounded id
+        # cardinality (per-user fine-tunes) can't grow forever; stale
+        # replica ids are pruned on replica-set refresh
+        self._model_affinity: OrderedDict = OrderedDict()
 
     # -- controller sync --
 
@@ -161,6 +167,8 @@ class _Router:
                 self._max_ongoing = max(1, max_ongoing)
                 live = {rid for rid, _ in replicas}
                 self._inflight = {rid: self._inflight.get(rid, 0) for rid in live}
+                for mid in [m for m, rid in self._model_affinity.items() if rid not in live]:
+                    del self._model_affinity[mid]
                 self._lock.notify_all()
         self._push_metrics()
 
@@ -210,19 +218,27 @@ class _Router:
 
     # -- the router --
 
-    def _pick_replica(self):
+    def _pick_replica(self, model_id: str | None = None):
         """Two random choices, take the lower local in-flight count; None
-        if every replica is at max_ongoing_requests."""
+        if every replica is at max_ongoing_requests. Multiplexed requests
+        stick to the replica that last served their model (its LRU cache
+        already holds the model — reference: model-aware routing in the
+        multiplex-enabled router) whenever it has capacity."""
         candidates = [(rid, actor) for rid, actor in self._replicas if self._inflight.get(rid, 0) < self._max_ongoing]
         if not candidates:
             return None
+        if model_id:
+            sticky = self._model_affinity.get(model_id)
+            for rid, actor in candidates:
+                if rid == sticky:
+                    return (rid, actor)
         if len(candidates) <= 2:
             picks = candidates
         else:
             picks = random.sample(candidates, 2)
         return min(picks, key=lambda c: self._inflight.get(c[0], 0))
 
-    def submit(self, method_name: str, args: tuple, kwargs: dict, timeout_s: float | None = 60.0, stream: bool = False):
+    def submit(self, method_name: str, args: tuple, kwargs: dict, timeout_s: float | None = 60.0, stream: bool = False, multiplexed_model_id: str | None = None):
         deadline = time.time() + timeout_s if timeout_s else None
         self._refresh(force=not self._replicas)
         with self._lock:
@@ -230,7 +246,7 @@ class _Router:
         try:
             while True:
                 with self._lock:
-                    pick = self._pick_replica() if self._replicas else None
+                    pick = self._pick_replica(multiplexed_model_id) if self._replicas else None
                     if pick is not None:
                         rid, actor = pick
                         self._inflight[rid] = self._inflight.get(rid, 0) + 1
@@ -261,12 +277,18 @@ class _Router:
         finally:
             with self._lock:
                 self._queued -= 1
+        if multiplexed_model_id:
+            with self._lock:
+                self._model_affinity[multiplexed_model_id] = rid
+                self._model_affinity.move_to_end(multiplexed_model_id)
+                while len(self._model_affinity) > 1024:
+                    self._model_affinity.popitem(last=False)
         self._push_metrics()
         try:
             if stream:
-                ref = actor.handle_request_streaming.options(num_returns="streaming").remote(method_name, args, kwargs)
+                ref = actor.handle_request_streaming.options(num_returns="streaming").remote(method_name, args, kwargs, multiplexed_model_id)
             else:
-                ref = actor.handle_request.remote(method_name, args, kwargs)
+                ref = actor.handle_request.remote(method_name, args, kwargs, multiplexed_model_id)
         except Exception:
             with self._lock:
                 if rid in self._inflight:
@@ -286,24 +308,28 @@ class DeploymentHandle:
     ref = h.remote(x) / h.method.remote(x); ref.result()
     """
 
-    def __init__(self, controller, app_name: str, deployment: str, method_name: str = "__call__", stream: bool = False):
+    def __init__(self, controller, app_name: str, deployment: str, method_name: str = "__call__", stream: bool = False, multiplexed_model_id: str | None = None):
         self._controller = controller
         self._app = app_name
         self._deployment = deployment
         self._method = method_name
         self._stream = stream
+        self._model_id = multiplexed_model_id
         self._router = _Router(controller, app_name, deployment)
 
-    def options(self, method_name: str | None = None, stream: bool | None = None):
+    def options(self, method_name: str | None = None, stream: bool | None = None, multiplexed_model_id: str | None = None):
         """`stream=True` makes `.remote()` return a
-        DeploymentResponseGenerator over the replica's yielded items
-        (reference: handle.options(stream=True))."""
+        DeploymentResponseGenerator; `multiplexed_model_id` tags the
+        request for a @serve.multiplexed deployment and keeps it sticky
+        to the replica holding that model (reference:
+        handle.options(stream=..., multiplexed_model_id=...))."""
         h = DeploymentHandle(
             self._controller,
             self._app,
             self._deployment,
             method_name or self._method,
             stream=self._stream if stream is None else stream,
+            multiplexed_model_id=self._model_id if multiplexed_model_id is None else multiplexed_model_id,
         )
         h._router = self._router  # share the router: one in-flight view
         return h
@@ -314,7 +340,7 @@ class DeploymentHandle:
         return _MethodProxy(self, name)
 
     def remote(self, *args, **kwargs):
-        return self._router.submit(self._method, args, kwargs, stream=self._stream)
+        return self._router.submit(self._method, args, kwargs, stream=self._stream, multiplexed_model_id=self._model_id)
 
 
 class _MethodProxy:
@@ -323,4 +349,6 @@ class _MethodProxy:
         self._method = method
 
     def remote(self, *args, **kwargs):
-        return self._handle._router.submit(self._method, args, kwargs, stream=self._handle._stream)
+        return self._handle._router.submit(
+            self._method, args, kwargs, stream=self._handle._stream, multiplexed_model_id=self._handle._model_id
+        )

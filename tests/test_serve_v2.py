@@ -223,3 +223,63 @@ def test_grpc_ingress_unary_and_streaming(serve_session):
     assert items == [{"t": 0}, {"t": 1}, {"t": 2}]
     with pytest.raises(RuntimeError):
         grpc_call(addr, "no_such_app", 1)
+
+
+def test_multiplexed_models_lru_and_sticky_routing(serve_session):
+    """Model multiplexing (reference: serve/multiplex.py): per-replica LRU
+    of lazily-loaded models with eviction hooks, request model ids via
+    handle.options(multiplexed_model_id=...), and sticky routing keeping a
+    model's requests on the replica that already holds it."""
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=8)
+    class ModelServer:
+        def __init__(self):
+            self.loads = []
+            self.evicted = []
+
+        @serve.multiplexed(max_num_models_per_replica=2, evict_grace_s=0)
+        def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            outer = self
+
+            class M:
+                def __init__(self, mid):
+                    self.mid = mid
+
+                def __call__(self, x):
+                    return f"{self.mid}:{x}"
+
+                def close(self):
+                    outer.evicted.append(self.mid)
+
+            return M(model_id)
+
+        def __call__(self, x):
+            mid = serve.get_multiplexed_model_id()
+            assert mid, "model id must reach the replica"
+            return {"out": self.get_model()(x), "replica": id(self)}
+
+        def stats(self):
+            return {"replica": id(self), "loads": list(self.loads), "evicted": list(self.evicted)}
+
+    h = serve.run(ModelServer.bind(), name="mux")
+    # repeated calls for one model: ONE load, all requests on one replica
+    outs = [h.options(multiplexed_model_id="m1").remote(i).result(timeout_s=60) for i in range(6)]
+    assert [o["out"] for o in outs] == [f"m1:{i}" for i in range(6)]
+    assert len({o["replica"] for o in outs}) == 1, "m1 requests should stick to one replica"
+
+    # a second and third model on the same sticky replica: LRU cap 2
+    # evicts the least-recent (m1 refreshed by calls above or evicted —
+    # drive m2, m3, then m2 again: no reload of m2)
+    for mid in ("m2", "m3", "m2"):
+        assert h.options(multiplexed_model_id=mid).remote(0).result(timeout_s=60)["out"] == f"{mid}:0"
+
+    # route the stats call WITH m1's model id: sticky affinity sends it
+    # to exactly the replica that served (and cached) m1
+    st = h.options(multiplexed_model_id="m1", method_name="stats").remote().result(timeout_s=60)
+    all_loads = st["loads"]
+    all_evicted = st["evicted"]
+    assert all_loads.count("m1") == 1, all_loads  # cached across 6 calls
+    assert len(all_evicted) >= 1, "cap-2 LRU must have evicted something"
+    # eviction ran the model's close() hook
+    assert set(all_evicted) <= {"m1", "m2", "m3"}
