@@ -202,6 +202,7 @@ def agent_entry(
     shutdown = threading.Event()  # definitive shutdown (no reconnect)
     conn_lost = threading.Event()  # head connection dropped
     draining = threading.Event()  # a worker-kill drain is in progress
+    drain_epoch = [0]  # bumps per drain; stale clear-watchers check it
     spawn_threads: list = []  # in-flight start_worker threads
 
     def send_head(msg):
@@ -357,6 +358,7 @@ def agent_entry(
             # kill — otherwise a late registration leaks a worker the
             # (restarted) head knows nothing about
             draining.set()
+            drain_epoch[0] += 1
             for t in list(spawn_threads):
                 t.join(timeout=15.0)
             kill_all_workers()  # head lost all task state
@@ -380,12 +382,14 @@ def agent_entry(
             if stragglers:
                 # a spawn outlived even the drain wait (overloaded node):
                 # keep draining set so it self-reaps, and clear only once
-                # every straggler has finished — a fixed-delay clear would
-                # reopen the late-registration leak
-                def _clear_when_done(ts=stragglers):
+                # every straggler has finished — and only if NO NEWER drain
+                # started meanwhile (epoch check: a stale watcher clearing
+                # a later drain's flag would reopen the leak)
+                def _clear_when_done(ts=stragglers, epoch=drain_epoch[0]):
                     for t in ts:
                         t.join()
-                    draining.clear()
+                    if drain_epoch[0] == epoch:
+                        draining.clear()
 
                 threading.Thread(target=_clear_when_done, daemon=True).start()
             else:

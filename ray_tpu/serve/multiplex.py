@@ -75,9 +75,17 @@ class _ModelCache:
                     pass
                 return
 
-    def _evict_lru(self):
+    def _evict_lru(self) -> list:
+        """Pop LRU victims; caller runs _dispose(victims) OUTSIDE the
+        lock (a slow user cleanup hook must not stall unrelated hits)."""
+        victims = []
         while len(self._models) > self._max:
             _, victim = self._models.popitem(last=False)
+            victims.append(victim)
+        return victims
+
+    def _dispose(self, victims: list):
+        for victim in victims:
             if self._grace <= 0:
                 self._run_hook(victim)
             else:
@@ -105,9 +113,10 @@ class _ModelCache:
         with self._lock:
             self._models[model_id] = model
             self._models.move_to_end(model_id)
-            self._evict_lru()
+            victims = self._evict_lru()
             self._loading.pop(model_id, None)
         ev.set()
+        self._dispose(victims)
 
     def _abort(self, model_id: str, ev: threading.Event):
         with self._lock:
@@ -165,11 +174,14 @@ class _MultiplexWrapper:
         # per-process cache state never travels; rebuild on the replica
         return (_MultiplexWrapper, (self._loader, self._max, self._grace))
 
+    _cache_create_lock = threading.Lock()
+
     def _cache(self, obj) -> _ModelCache:
         key = f"__serve_mux_{self.__name__}"
         c = obj.__dict__.get(key)
         if c is None:
-            c = obj.__dict__[key] = _ModelCache(self._loader, self._max, self._grace)
+            with _MultiplexWrapper._cache_create_lock:
+                c = obj.__dict__.setdefault(key, _ModelCache(self._loader, self._max, self._grace))
         return c
 
     def __get__(self, obj, objtype=None):

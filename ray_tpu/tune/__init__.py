@@ -14,6 +14,7 @@ from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -48,6 +49,7 @@ __all__ = [
     "FIFOScheduler",
     "MedianStoppingRule",
     "OptunaSearch",
+    "PB2",
     "PopulationBasedTraining",
     "ResultGrid",
     "Searcher",

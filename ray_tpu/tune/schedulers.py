@@ -188,3 +188,118 @@ class PopulationBasedTraining(TrialScheduler):
             elif isinstance(spec, list):
                 config[k] = spec[int(self.rng.integers(0, len(spec)))]
         return config
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference: tune/schedulers/pb2.py; Parker-
+    Holder et al. 2020): PBT where EXPLORE is not a random x0.8/x1.2
+    perturbation but a GP-bandit suggestion — a Gaussian process is fit on
+    (hyperparams -> observed reward improvement) across the population's
+    recent perturbation intervals, and the exploited trial's new config
+    maximizes the UCB acquisition over the bounded search space. Much more
+    sample-efficient than PBT at small population sizes, where random
+    perturbations rarely hit good regions.
+
+    hyperparam_bounds: {name: (low, high)} continuous bounds (the PB2
+    formulation is continuous); pass hyperparam_mutations for any
+    categorical params to keep them on PBT's resample/perturb explore.
+    """
+
+    def __init__(
+        self,
+        metric=None,
+        mode="max",
+        perturbation_interval: int = 5,
+        hyperparam_bounds: dict | None = None,
+        quantile_fraction: float = 0.25,
+        time_attr: str = "training_iteration",
+        seed: int | None = None,
+        ucb_kappa: float = 2.0,
+        num_candidates: int = 256,
+        hyperparam_mutations: dict | None = None,
+    ):
+        super().__init__(
+            metric=metric,
+            mode=mode,
+            perturbation_interval=perturbation_interval,
+            hyperparam_mutations=hyperparam_mutations or {},
+            quantile_fraction=quantile_fraction,
+            time_attr=time_attr,
+            seed=seed,
+        )
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds={name: (low, high), ...}")
+        self.bounds = {k: (float(lo), float(hi)) for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = float(ucb_kappa)
+        self.num_candidates = int(num_candidates)
+        # observations: rows of (normalized hyperparams, reward delta)
+        self._obs_x: list[list[float]] = []
+        self._obs_y: list[float] = []
+        self._last_score: dict[str, float] = {}
+
+    # -- data collection: reward improvement per interval, tagged with the
+    # config that produced it --
+    def on_trial_result(self, controller, trial, result):
+        score = self._score(trial)
+        if score is not None:
+            t = result.get(self.time_attr, trial.iteration)
+            # snapshot the score only at interval BOUNDARIES: y is then
+            # the whole interval's improvement under trial.config, not a
+            # single noisy step delta
+            if t - self.last_perturb.get(trial.trial_id, 0) >= self.interval:
+                prev = self._last_score.get(trial.trial_id)
+                if prev is not None:
+                    self._obs_x.append(self._normalize(trial.config))
+                    self._obs_y.append(score - prev)
+                    if len(self._obs_y) > 512:  # bounded memory, recent wins
+                        self._obs_x.pop(0)
+                        self._obs_y.pop(0)
+                self._last_score[trial.trial_id] = score
+        return super().on_trial_result(controller, trial, result)
+
+    def _normalize(self, config: dict) -> list[float]:
+        out = []
+        for k, (lo, hi) in self.bounds.items():
+            v = float(config.get(k, lo))
+            out.append((v - lo) / max(hi - lo, 1e-12))
+        return out
+
+    def _denormalize(self, x) -> dict:
+        return {k: lo + float(xi) * (hi - lo) for xi, (k, (lo, hi)) in zip(x, self.bounds.items())}
+
+    # -- GP-UCB explore for bounded params (categoricals first go
+    # through PBT's resample/perturb when hyperparam_mutations given) --
+    def _explore(self, config: dict) -> dict:
+        if self.mutations:
+            config = super()._explore(dict(config))
+        cand = self.rng.random((self.num_candidates, len(self.bounds)))
+        if len(self._obs_y) >= 3:
+            X = np.asarray(self._obs_x, dtype=np.float64)
+            y = np.asarray(self._obs_y, dtype=np.float64)
+            y = (y - y.mean()) / (y.std() + 1e-9)
+            # GP with RBF kernel (PB2 uses a time-varying SE kernel; the
+            # bounded-recency observation window plays the decay role)
+            ls = 0.2
+            noise = 1e-2
+
+            def k_rbf(A, B):
+                d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+                return np.exp(-d2 / (2 * ls * ls))
+
+            K = k_rbf(X, X) + noise * np.eye(len(X))
+            try:
+                L = np.linalg.cholesky(K)
+                alpha = np.linalg.solve(L.T, np.linalg.solve(L, y))
+                Ks = k_rbf(X, cand)  # [n_obs, n_cand]
+                mu = Ks.T @ alpha
+                v = np.linalg.solve(L, Ks)
+                var = np.clip(1.0 - (v * v).sum(0), 1e-9, None)
+                ucb = mu + self.kappa * np.sqrt(var)
+                best = cand[int(np.argmax(ucb))]
+            except np.linalg.LinAlgError:
+                best = cand[int(self.rng.integers(0, len(cand)))]
+        else:
+            best = cand[int(self.rng.integers(0, len(cand)))]
+        new = dict(config)
+        new.update(self._denormalize(best))
+        return new
