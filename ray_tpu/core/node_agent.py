@@ -203,6 +203,7 @@ def agent_entry(
     conn_lost = threading.Event()  # head connection dropped
     draining = threading.Event()  # a worker-kill drain is in progress
     drain_epoch = [0]  # bumps per drain; stale clear-watchers check it
+    drain_lock = threading.Lock()  # makes {set+bump} / {check+clear} atomic
     spawn_threads: list = []  # in-flight start_worker threads
 
     def send_head(msg):
@@ -357,8 +358,9 @@ def agent_entry(
             # out in-flight spawns (forkserver boot takes seconds), THEN
             # kill — otherwise a late registration leaks a worker the
             # (restarted) head knows nothing about
-            draining.set()
-            drain_epoch[0] += 1
+            with drain_lock:
+                draining.set()
+                drain_epoch[0] += 1
             for t in list(spawn_threads):
                 t.join(timeout=15.0)
             kill_all_workers()  # head lost all task state
@@ -388,8 +390,9 @@ def agent_entry(
                 def _clear_when_done(ts=stragglers, epoch=drain_epoch[0]):
                     for t in ts:
                         t.join()
-                    if drain_epoch[0] == epoch:
-                        draining.clear()
+                    with drain_lock:
+                        if drain_epoch[0] == epoch:
+                            draining.clear()
 
                 threading.Thread(target=_clear_when_done, daemon=True).start()
             else:

@@ -18,7 +18,7 @@ from ray_tpu.tune.schedulers import (
     PopulationBasedTraining,
     TrialScheduler,
 )
-from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter, OptunaSearch, Searcher
+from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter, OptunaSearch, Searcher, TPESearcher
 from ray_tpu.tune.search_space import (
     choice,
     grid_search,
@@ -53,6 +53,7 @@ __all__ = [
     "PopulationBasedTraining",
     "ResultGrid",
     "Searcher",
+    "TPESearcher",
     "TrialScheduler",
     "TuneConfig",
     "Tuner",

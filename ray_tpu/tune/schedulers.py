@@ -255,7 +255,14 @@ class PB2(PopulationBasedTraining):
                         self._obs_x.pop(0)
                         self._obs_y.pop(0)
                 self._last_score[trial.trial_id] = score
-        return super().on_trial_result(controller, trial, result)
+        decision = super().on_trial_result(controller, trial, result)
+        if decision == PAUSE:
+            # exploited: the trial resumes from the DONOR's checkpoint, so
+            # its next score jump reflects the clone, not training under
+            # the suggested config — drop the baseline or the GP learns
+            # self-confirming inflated improvements
+            self._last_score.pop(trial.trial_id, None)
+        return decision
 
     def _normalize(self, config: dict) -> list[float]:
         out = []
@@ -264,8 +271,16 @@ class PB2(PopulationBasedTraining):
             out.append((v - lo) / max(hi - lo, 1e-12))
         return out
 
-    def _denormalize(self, x) -> dict:
-        return {k: lo + float(xi) * (hi - lo) for xi, (k, (lo, hi)) in zip(x, self.bounds.items())}
+    def _denormalize(self, x, config: dict | None = None) -> dict:
+        out = {}
+        for xi, (k, (lo, hi)) in zip(x, self.bounds.items()):
+            v = lo + float(xi) * (hi - lo)
+            # integer-valued hyperparams (batch size, layer count) keep
+            # their type across exploits, like PBT's type-preserving explore
+            if config is not None and isinstance(config.get(k), int) and not isinstance(config.get(k), bool):
+                v = int(round(v))
+            out[k] = v
+        return out
 
     # -- GP-UCB explore for bounded params (categoricals first go
     # through PBT's resample/perturb when hyperparam_mutations given) --
@@ -301,5 +316,5 @@ class PB2(PopulationBasedTraining):
         else:
             best = cand[int(self.rng.integers(0, len(cand)))]
         new = dict(config)
-        new.update(self._denormalize(best))
+        new.update(self._denormalize(best, config))
         return new

@@ -399,3 +399,53 @@ def test_pb2_gp_bandit_explore(rt_start, tmp_path):
     assert best.metrics["w"] > 20 * 0.3, best.metrics
     final_lrs = [r.metrics.get("lr") for r in grid if r.metrics.get("lr") is not None]
     assert any(abs(lr - 0.3) < 0.25 for lr in final_lrs), final_lrs
+
+
+def test_tpe_searcher_beats_random_on_quadratic(rt_start, tmp_path):
+    """TPE (the BO half of BOHB, reference: tune/search/bohb KDE model):
+    after startup trials, suggestions concentrate near the optimum of a
+    quadratic objective, beating pure random sampling's best."""
+    import numpy as np
+
+    def trainable(config):
+        tune.report({"loss": (config["x"] - 0.7) ** 2 + (config["y"] - 0.2) ** 2})
+
+    space = {"x": tune.uniform(0, 1), "y": tune.uniform(0, 1)}
+    tpe = tune.TPESearcher(num_samples=24, metric="loss", mode="min", n_startup_trials=6, seed=3)
+    grid = tune.Tuner(
+        trainable,
+        param_space=space,
+        tune_config=tune.TuneConfig(metric="loss", mode="min", search_alg=tpe, max_concurrent_trials=2),
+        run_config=_run_cfg(tmp_path / "tpe"),
+    ).fit()
+    assert grid.num_errors == 0 and len(grid) == 24
+    tpe_best = grid.get_best_result("loss", "min").metrics["loss"]
+    # model-guided suggestions should land very close to (0.7, 0.2)
+    assert tpe_best < 0.02, tpe_best
+    # later (model-based) suggestions are better than the startup phase
+    losses = [r.metrics["loss"] for r in grid]
+    assert min(losses[8:]) <= min(losses[:6]), losses
+
+
+def test_tpe_with_asha_is_bohb_shaped(rt_start, tmp_path):
+    """BOHB composition: TPE proposals + ASHA multi-fidelity elimination
+    run together and find a good config."""
+
+    def trainable(config):
+        for step in range(8):
+            tune.report({"acc": (1.0 - abs(config["q"] - 0.5)) * (step + 1)})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"q": tune.uniform(0, 1)},
+        tune_config=tune.TuneConfig(
+            metric="acc",
+            mode="max",
+            search_alg=tune.TPESearcher(num_samples=12, metric="acc", mode="max", n_startup_trials=4, seed=0),
+            scheduler=tune.ASHAScheduler(metric="acc", mode="max", max_t=8, grace_period=2, reduction_factor=2),
+        ),
+        run_config=_run_cfg(tmp_path),
+    ).fit()
+    assert grid.num_errors == 0
+    best = grid.get_best_result("acc", "max")
+    assert best.metrics["acc"] > 8 * 0.8  # near q=0.5 survived to max_t
