@@ -1,0 +1,228 @@
+"""Core-runtime microbenchmark for ray_tpu.
+
+Measures the control/data-plane hot paths that every library sits on:
+
+  * put/get latency (small objects) and bandwidth (1 KB / 1 MB / 100 MB)
+  * trivial-task throughput (pipelined submit + drain) and round-trip latency
+  * sync and async actor-call throughput and round-trip latency
+  * 1 -> N task fan-out throughput
+  * cross-node (shm-isolated, TCP transfer path) object pull bandwidth
+
+Reference parity: python/ray/_private/ray_perf.py:1 and
+release/microbenchmark/run_microbenchmark.py:1 define the benchmark
+surface (tasks/s, actor calls/s, put/get); the measurement harness here
+is original — each benchmark is a (setup, op, teardown) triple timed for
+a fixed wall budget with warmup, reporting ops/s and per-op latency.
+
+Usage:
+    python bench_core.py                # all benchmarks, one JSON line each
+    python bench_core.py --out FILE     # also write the summary JSON to FILE
+    python bench_core.py --filter put   # substring-filter benchmark names
+    python bench_core.py --quick        # shorter budgets (CI smoke)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # core runtime bench: no TPU needed
+
+import numpy as np
+
+import ray_tpu
+
+
+# ----------------------------------------------------------------------
+# harness
+# ----------------------------------------------------------------------
+def _time_op(op, budget_s: float, warmup: int = 3, batch: int = 1):
+    """Run ``op`` repeatedly for ~budget_s seconds; return (ops_per_s, s_per_op).
+
+    ``batch`` is how many logical operations one ``op()`` call performs
+    (e.g. a pipelined drain of 100 tasks counts as 100 ops).
+    """
+    for _ in range(warmup):
+        op()
+    n = 0
+    t0 = time.perf_counter()
+    while True:
+        op()
+        n += 1
+        dt = time.perf_counter() - t0
+        if dt >= budget_s:
+            break
+    total_ops = n * batch
+    return total_ops / dt, dt / total_ops
+
+
+class Bench:
+    def __init__(self, budget_s: float, out_path: str | None, name_filter: str):
+        self.budget_s = budget_s
+        self.out_path = out_path
+        self.name_filter = name_filter
+        self.results: list[dict] = []
+
+    def run(self, name: str, op, *, batch: int = 1, unit: str = "ops/s", bytes_per_op: int | None = None):
+        if self.name_filter and self.name_filter not in name:
+            return
+        ops_s, s_op = _time_op(op, self.budget_s, batch=batch)
+        rec = {"metric": name, "value": round(ops_s, 2), "unit": unit, "per_op_us": round(s_op * 1e6, 2)}
+        if bytes_per_op is not None:
+            rec["gib_per_s"] = round(ops_s * bytes_per_op / (1 << 30), 3)
+        self.results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    def dump(self):
+        if self.out_path:
+            with open(self.out_path, "w") as f:
+                json.dump({"benchmarks": self.results, "ts": time.time()}, f, indent=1)
+
+
+# ----------------------------------------------------------------------
+# remote definitions
+# ----------------------------------------------------------------------
+@ray_tpu.remote
+def _nop():
+    return b"ok"
+
+
+@ray_tpu.remote
+def _echo(x):
+    return b"ok"
+
+
+@ray_tpu.remote
+class _SyncActor:
+    def ping(self):
+        return b"ok"
+
+    def ping_arg(self, x):
+        return b"ok"
+
+
+@ray_tpu.remote
+class _AsyncActor:
+    async def ping(self):
+        return b"ok"
+
+
+# ----------------------------------------------------------------------
+# benchmark suites
+# ----------------------------------------------------------------------
+def bench_objects(b: Bench):
+    small = ray_tpu.put(b"x")
+
+    b.run("get_small_latency", lambda: ray_tpu.get(small))
+    b.run("put_small", lambda: ray_tpu.put(b"x"))
+
+    for label, nbytes in (("1kb", 1 << 10), ("1mb", 1 << 20), ("100mb", 100 << 20)):
+        arr = np.random.default_rng(0).integers(0, 255, size=nbytes, dtype=np.uint8)
+
+        def put_get(arr=arr):
+            r = ray_tpu.put(arr)
+            out = ray_tpu.get(r)
+            assert out.nbytes == arr.nbytes
+            ray_tpu.internal_free([r])
+
+        b.run(f"put_get_{label}", put_get, bytes_per_op=nbytes)
+
+
+def bench_tasks(b: Bench):
+    b.run("task_roundtrip", lambda: ray_tpu.get(_nop.remote()))
+
+    PIPE = 100
+
+    def pipelined():
+        ray_tpu.get([_nop.remote() for _ in range(PIPE)])
+
+    b.run("task_throughput_pipelined", pipelined, batch=PIPE)
+
+    FAN = 64
+
+    def fanout():
+        ray_tpu.get([_echo.remote(i) for i in range(FAN)])
+
+    b.run("task_fanout_64", fanout, batch=FAN)
+
+
+def bench_actors(b: Bench):
+    a = _SyncActor.remote()
+    ray_tpu.get(a.ping.remote())
+    b.run("actor_call_roundtrip", lambda: ray_tpu.get(a.ping.remote()))
+
+    PIPE = 100
+
+    def pipelined():
+        ray_tpu.get([a.ping.remote() for _ in range(PIPE)])
+
+    b.run("actor_calls_pipelined", pipelined, batch=PIPE)
+
+    arg = ray_tpu.put(b"payload")
+
+    def with_ref_arg():
+        ray_tpu.get([a.ping_arg.remote(arg) for _ in range(PIPE)])
+
+    b.run("actor_calls_ref_arg", with_ref_arg, batch=PIPE)
+
+    aa = _AsyncActor.remote()
+    ray_tpu.get(aa.ping.remote())
+
+    def async_pipelined():
+        ray_tpu.get([aa.ping.remote() for _ in range(PIPE)])
+
+    b.run("async_actor_calls_pipelined", async_pipelined, batch=PIPE)
+    ray_tpu.kill(a)
+    ray_tpu.kill(aa)
+
+
+def bench_cross_node(b: Bench):
+    """Cross-node pull over the TCP transfer service (shm-isolated node =
+    a real second host: no same-host shm attach fast path)."""
+    rt = ray_tpu.api._auto_init()
+    node = rt.add_node({"CPU": 2.0, "remotecpu": 2.0}, remote=True, shm_isolation=True)
+    try:
+        @ray_tpu.remote(resources={"remotecpu": 1.0})
+        def produce(nbytes):
+            import numpy as _np
+
+            return _np.zeros(nbytes, dtype=_np.uint8)
+
+        for label, nbytes in (("1mb", 1 << 20), ("64mb", 64 << 20)):
+            def pull(nbytes=nbytes):
+                r = produce.remote(nbytes)
+                out = ray_tpu.get(r)
+                assert out.nbytes == nbytes
+                ray_tpu.internal_free([r])
+
+            b.run(f"cross_node_pull_{label}", pull, bytes_per_op=nbytes)
+    finally:
+        rt.remove_node(node.node_id, graceful=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="BENCH_core.json")
+    ap.add_argument("--filter", default="")
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args(argv)
+
+    budget = 0.5 if args.quick else 2.0
+    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
+    b = Bench(budget, args.out, args.filter)
+    try:
+        bench_objects(b)
+        bench_tasks(b)
+        bench_actors(b)
+        bench_cross_node(b)
+    finally:
+        b.dump()
+        ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,17 +78,6 @@ class StoredObject:
         return 0
 
 
-def _attach_no_track(name: str) -> shared_memory.SharedMemory:
-    """Attach to an existing segment without registering with the
-    resource_tracker (the owner is responsible for unlinking)."""
-    seg = shared_memory.SharedMemory(name=name)
-    try:
-        resource_tracker.unregister(seg._name, "shared_memory")  # noqa: SLF001
-    except Exception:
-        pass
-    return seg
-
-
 def _session_tag() -> str:
     """This process's shm namespace tag. Segment names embed it so orphans
     from killed sessions can be reclaimed (reference: plasma store restart
@@ -180,61 +169,97 @@ def cleanup_orphan_segments():
 
 
 def write_to_shm(obj_id: ObjectID, s: Serialized) -> ShmDescriptor:
+    import errno
+    import os
+
+    import _posixshmem
+
     total = s.total_size()
     # full 40-hex object id: actor task ids share their first 12 bytes
     # (actor_id prefix + seq), so any truncation collides across returns
     # of one actor and concurrent writes would clobber each other
     name = f"rt{_session_tag()}_" + obj_id.hex()
+    # write(2) into the tmpfs-backed fd: ~4x faster than mmap+memcpy for
+    # fresh segments (no fault-in + page-zero before the copy)
+    flags = os.O_CREAT | os.O_EXCL | os.O_RDWR
     try:
-        seg = shared_memory.SharedMemory(name=name, create=True, size=max(total, 1))
+        fd = _posixshmem.shm_open("/" + name, flags, 0o600)
     except FileExistsError:
         # stale segment from a retried/reconstructed task: replace it
         unlink_shm(name)
-        seg = shared_memory.SharedMemory(name=name, create=True, size=max(total, 1))
+        fd = _posixshmem.shm_open("/" + name, flags, 0o600)
     try:
-        resource_tracker.unregister(seg._name, "shared_memory")  # noqa: SLF001
-    except Exception:
-        pass
-    off = 0
-    seg.buf[off : off + len(s.header)] = s.header
-    off += len(s.header)
-    lens = []
-    for b in s.buffers:
-        mv = memoryview(b).cast("B")
-        n = len(mv)
-        seg.buf[off : off + n] = mv
-        off += n
-        lens.append(n)
-    desc = ShmDescriptor(shm_name=name, header_len=len(s.header), buffer_lens=lens, total_size=total, ns=_session_tag())
-    seg.close()
-    return desc
+        views = [memoryview(s.header).cast("B")]
+        lens = []
+        for b in s.buffers:
+            mv = memoryview(b).cast("B")
+            lens.append(len(mv))
+            views.append(mv)
+        while views:
+            try:
+                written = os.writev(fd, views[:1024])
+            except OSError as e:  # pragma: no cover - ENOSPC on full /dev/shm
+                if e.errno != errno.ENOSPC:
+                    raise
+                unlink_shm(name)
+                raise MemoryError(f"/dev/shm full writing object {obj_id.hex()[:16]} ({total} bytes)") from e
+            while views and written >= len(views[0]):
+                written -= len(views[0])
+                views.pop(0)
+            if views and written:
+                views[0] = views[0][written:]
+        if total == 0:
+            os.ftruncate(fd, 1)
+    finally:
+        os.close(fd)
+    return ShmDescriptor(shm_name=name, header_len=len(s.header), buffer_lens=lens, total_size=total, ns=_session_tag())
+
+
+def _mmap_readonly(name: str):
+    """Map a segment read-only via raw mmap: exported memoryviews hold the
+    mapping alive, and the mapping is torn down by GC when the last view
+    dies — no explicit close, no resource_tracker, and a later unlink by
+    the owner leaves existing mappings valid (POSIX shm semantics)."""
+    import mmap
+    import os
+
+    import _posixshmem
+
+    fd = _posixshmem.shm_open("/" + name, os.O_RDONLY, 0)
+    try:
+        size = os.fstat(fd).st_size
+        return mmap.mmap(fd, size, prot=mmap.PROT_READ)
+    finally:
+        os.close(fd)
 
 
 def read_from_shm(desc: ShmDescriptor, zero_copy: bool = False):
     """Return (Serialized, segment). With zero_copy the buffers are
-    memoryviews into the mapping and the caller must keep `segment` alive.
+    READ-ONLY memoryviews into a GC-managed mapping (reference parity:
+    plasma gets return immutable arrays, plasma/store.h:55); `segment`
+    is returned for legacy keepalive lists but holding it is optional.
     Foreign-namespace descriptors are first materialized locally through
     the transfer service (see ensure_local_segment)."""
-    seg = _attach_no_track(ensure_local_segment(desc))
+    m = _mmap_readonly(ensure_local_segment(desc))
+    view = memoryview(m)
     off = 0
-    hdr_mv = seg.buf[off : off + desc.header_len]
-    header = bytes(hdr_mv)
-    hdr_mv.release()
+    header = bytes(view[off : off + desc.header_len])
     off += desc.header_len
     buffers = []
     for n in desc.buffer_lens:
-        mv = seg.buf[off : off + n]
+        mv = view[off : off + n]
         if zero_copy:
             buffers.append(mv)
         else:
             buffers.append(bytes(mv))
             mv.release()
         off += n
+    view.release()
     s = Serialized(header=header, buffers=buffers)
     if not zero_copy:
-        seg.close()
-        seg = None
-    return s, seg
+        m.close()
+        m = None
+    return s, m
 
 
 def unlink_shm(name: str):
@@ -305,6 +330,10 @@ class ObjectStore:
             desc = write_to_shm(obj_id, s)
             entry = StoredObject(shm=desc, contained_refs=list(s.contained_refs))
         else:
+            # detach inline entries from caller memory: pickle5 buffer views
+            # alias the original object, which the caller may mutate
+            if any(isinstance(b, memoryview) or not isinstance(b, bytes) for b in s.buffers):
+                s = Serialized(header=s.header, buffers=[bytes(b) for b in s.buffers], contained_refs=s.contained_refs)
             entry = StoredObject(value=s, contained_refs=list(s.contained_refs))
         self.seal(obj_id, entry)
         return entry
@@ -622,3 +651,15 @@ class ObjectStore:
             self._shm_bytes = 0
             self._spilled_bytes = 0
             self._evicted.clear()
+        # Sweep the whole session namespace: shm-backed BY-VALUE task arg
+        # payloads are written outside the store (payloads.encode_serialized)
+        # and retained for retries/lineage replays, so they have no per-task
+        # free point — the session boundary is where they die (reference:
+        # plasma store cleanup on session teardown).
+        tag = _session_tag()
+        try:
+            for n in os.listdir("/dev/shm"):
+                if n.startswith(f"rt{tag}_"):
+                    unlink_shm(n)
+        except OSError:
+            pass

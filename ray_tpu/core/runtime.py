@@ -465,7 +465,10 @@ class Runtime:
                 raise entry.error
             if entry.shm is not None:
                 try:
-                    s, _ = read_from_shm(entry.shm, zero_copy=False)
+                    # zero-copy: buffers are read-only views of a GC-managed
+                    # mapping (plasma get semantics — arrays come back
+                    # immutable; copy() to mutate)
+                    s, _ = read_from_shm(entry.shm, zero_copy=True)
                 except FileNotFoundError:
                     # raced an eviction or the bytes were spilled to disk
                     self.store.restore_or_mark_lost(obj_id)
@@ -601,11 +604,37 @@ class Runtime:
         self.task_manager.register(spec)
         if self.local_mode:
             self._local_execute(spec)
-        else:
+        elif not self._fast_submit(spec):
             self.scheduler.submit(spec)
         if streaming:
             return [spec.generator_id()]
         return spec.return_ids()
+
+    def _fast_submit(self, spec: TaskSpec) -> bool:
+        """Submit-side fast path: an unconstrained task whose deps are all
+        local reserves + dispatches inline on the calling thread, skipping
+        the scheduler-thread hop (reference: direct task submission to a
+        leased worker, core_worker task submitter). Falls back to the
+        policy queue when placement is constrained or capacity is tight."""
+        s = spec.scheduling
+        if (
+            s.placement_group is not None
+            or s.node_id is not None
+            or s.soft_node_id is not None
+            or s.label_selector
+            or s.scheduling_strategy != "DEFAULT"
+        ):
+            return False
+        if self.scheduler.has_pending():
+            return False  # don't jump ahead of queued work
+        for a in spec.args:
+            if a.ref is not None and not self.store.contains(a.ref):
+                return False
+        for node in self.node_list():
+            if node.alive and self.reserve_and_queue(node, spec):
+                self._dispatch_node(node)
+                return True
+        return False
 
     def _prepare_runtime_env(self, renv: dict | None) -> dict | None:
         """Package working_dir/py_modules once (cached by paths) into the
@@ -1153,8 +1182,16 @@ class Runtime:
             with node._lock:
                 if not node.alive or not node.dispatch_queue or node.dispatch_queue[0][0] is not spec:
                     continue  # raced remove_node's drain
+                # _dispatch_node runs concurrently from the scheduler pass,
+                # the completion fast path (worker-IO thread) and
+                # _fast_submit: the worker must be claimed under the node
+                # lock or two dispatchers hand two tasks to the same worker
+                w = next((x for x in idle if x.state == "idle" and (not chips or x.fresh)), None)
+                if w is None:
+                    continue  # idle snapshot went stale; rescan
                 node.dispatch_queue.pop(0)
-            self._dispatch_to_worker(node, idle[0], spec, alloc, chips)
+                w.state = "busy"
+            self._dispatch_to_worker(node, w, spec, alloc, chips)
 
     def _dispatch_to_worker(self, node: Node, worker: WorkerHandle, spec: TaskSpec, alloc, chips):
         env = {}
@@ -1174,6 +1211,11 @@ class Runtime:
         msg = self._build_exec_msg(spec, node, resources=resources, env=env)
         if msg is None:
             self._release_alloc(node, alloc, chips)
+            # un-claim: the worker was marked busy under the node lock in
+            # _dispatch_node before the exec message was built
+            if worker.state == "busy":
+                worker.state = "idle"
+                worker.last_idle = time.monotonic()
             return
         if spec.is_actor_creation:
             worker.state = "actor"
@@ -1594,6 +1636,14 @@ class Runtime:
                 if w.state == "busy":
                     w.state = "idle"
                     w.last_idle = time.monotonic()
+                    # completion fast path: grab the next ready task for
+                    # this node inline (IO thread), skipping the scheduler
+                    # thread wake for the common unconstrained case
+                    try:
+                        self.scheduler.take_ready_for(node, self.reserve_and_queue)
+                        self._dispatch_node(node)
+                    except Exception:
+                        logger.exception("fast dispatch failed")
         err = msg.get("error")
         if spec.is_actor_creation:
             self._on_actor_creation_done(spec, err, w)
@@ -2090,10 +2140,11 @@ def _actor_ready_oid(actor_id: ActorID) -> ObjectID:
 def _to_serialized(value) -> Serialized:
     from ray_tpu.core.serialization import serialize
 
-    s = serialize(value)
     # contained_refs MUST survive: the store entry holding them is what
-    # keeps objects pickled inside this value alive (borrow protocol)
-    return Serialized(header=s.header, buffers=[bytes(b) for b in s.buffers], contained_refs=s.contained_refs)
+    # keeps objects pickled inside this value alive (borrow protocol).
+    # Buffers stay as pickle5 views: put_serialized copies them exactly
+    # once — into shm for large values, into bytes for inline entries.
+    return serialize(value)
 
 
 def _sched_options(opts: dict, is_actor: bool = False) -> SchedulingOptions:

@@ -16,6 +16,7 @@ from __future__ import annotations
 import itertools
 import logging
 import threading
+from collections import deque
 
 from ray_tpu._config import get_config
 from ray_tpu.core.node import Node
@@ -115,7 +116,7 @@ class Scheduler:
         self._lock = threading.Condition()
         self._waiting: dict = {}  # task_id -> (spec, set(pending obj ids))
         self._dep_index: dict = {}  # obj_id -> set(task_id)
-        self._ready: list[TaskSpec] = []
+        self._ready: deque[TaskSpec] = deque()
         self._infeasible_warned: set = set()
         self._wake = threading.Event()
         self._stopped = False
@@ -186,12 +187,37 @@ class Scheduler:
     def wake(self):
         self._wake.set()
 
+    @staticmethod
+    def _shape_key(spec):
+        """Placement signature: two specs with the same key are
+        interchangeable to the placement policy, so once one fails to
+        place in a pass, the rest are requeued without a pick() each —
+        keeps a deep backlog O(n·shapes) per pass instead of O(n²)
+        (reference: cluster_lease_manager.h queues leases by resource
+        shape for the same reason)."""
+        s = spec.scheduling
+        return (
+            tuple(sorted(s.resources.items())),
+            s.node_id,
+            s.soft_node_id,
+            s.placement_group,
+            s.bundle_index,
+            s.scheduling_strategy,
+            tuple(sorted(s.label_selector.items())),
+        )
+
     def _schedule_once(self):
         with self._lock:
-            ready, self._ready = self._ready, []
+            ready, self._ready = self._ready, deque()
         requeue = []
+        blocked: set = set()
+        nodes = self.rt.node_list()
         for spec in ready:
-            node = self.policy.pick(spec, self.rt.node_list())
+            shape = self._shape_key(spec)
+            if shape in blocked:
+                requeue.append(spec)
+                continue
+            node = self.policy.pick(spec, nodes)
             if node is None:
                 if spec.task_id not in self._infeasible_warned:
                     if len(self._infeasible_warned) > 10_000:
@@ -203,15 +229,61 @@ class Scheduler:
                         spec.scheduling.resources,
                     )
                 requeue.append(spec)
+                blocked.add(shape)
                 continue
             if node == "retry":
                 requeue.append(spec)
+                blocked.add(shape)
                 continue
             if not self.rt.reserve_and_queue(node, spec):
                 requeue.append(spec)
+                blocked.add(shape)
         if requeue:
             with self._lock:
                 self._ready.extend(requeue)
+
+    def take_ready_for(self, node, reserve, limit: int = 8) -> bool:
+        """Completion fast path: the worker-IO thread that just freed
+        capacity on ``node`` pulls plain DEFAULT-strategy ready tasks
+        straight onto the node's dispatch queue, skipping the scheduler
+        thread hop (reference: direct-call workers reuse leases without a
+        raylet round trip, lease_policy.h). Placement-constrained specs
+        (PG / affinity / labels / SPREAD) stay for the policy pass."""
+        candidates = []
+        scan = limit * 4  # bounded prefix: O(1) per completion, not O(backlog)
+        with self._lock:
+            if not self._ready:
+                return False
+            kept = []
+            scanned = 0
+            while self._ready and scanned < scan and len(candidates) < limit:
+                spec = self._ready.popleft()
+                scanned += 1
+                s = spec.scheduling
+                if (
+                    s.placement_group is None
+                    and s.node_id is None
+                    and s.soft_node_id is None
+                    and not s.label_selector
+                    and s.scheduling_strategy == "DEFAULT"
+                ):
+                    candidates.append(spec)
+                else:
+                    kept.append(spec)
+            self._ready.extendleft(reversed(kept))
+            if not candidates:
+                return False
+        placed = False
+        leftovers = []
+        for spec in candidates:
+            if reserve(node, spec):
+                placed = True
+            else:
+                leftovers.append(spec)
+        if leftovers:
+            with self._lock:
+                self._ready.extendleft(reversed(leftovers))
+        return placed
 
     def has_pending(self) -> bool:
         with self._lock:

@@ -123,7 +123,7 @@ class WorkerClient:
         for attempt in range(3):
             payload = self.call("get_object", obj_id=obj_id, timeout_s=timeout, timeout=None)
             try:
-                value, seg = decode_payload(payload, zero_copy=False)
+                value, seg = decode_payload(payload, zero_copy=True)
             except FileNotFoundError:
                 # shm backing raced an eviction; tell the owner and retry
                 # (lineage reconstruction will re-produce it)

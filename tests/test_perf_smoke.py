@@ -1,0 +1,91 @@
+"""Core-runtime performance regression floor.
+
+Thresholds are ~5-10x below the measured numbers on the build machine
+(BENCH_core.json) so VM jitter never trips them, but a structural
+regression (an O(n^2) queue scan, a lost zero-copy path, a serialization
+copy) does. Reference parity: python/ray/_private/ray_perf.py is run in
+release tests with recorded floors (release/microbenchmark/).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _rate(op, n):
+    op()  # warm
+    t0 = time.perf_counter()
+    for _ in range(n):
+        op()
+    return n / (time.perf_counter() - t0)
+
+
+def test_task_throughput_floor(rt):
+    @ray_tpu.remote
+    def nop():
+        return b"ok"
+
+    ray_tpu.get([nop.remote() for _ in range(20)])  # spin up workers
+    rate = _rate(lambda: ray_tpu.get([nop.remote() for _ in range(50)]), 4) * 50
+    assert rate > 300, f"trivial task throughput collapsed: {rate:.0f}/s"
+
+
+def test_put_get_bandwidth_floor(rt):
+    arr = np.ones(32 << 20, dtype=np.uint8)
+
+    def op():
+        r = ray_tpu.put(arr)
+        out = ray_tpu.get(r)
+        assert out.nbytes == arr.nbytes
+        ray_tpu.internal_free([r])
+
+    rate = _rate(op, 5)
+    gib_s = rate * arr.nbytes / (1 << 30)
+    assert gib_s > 0.1, f"put/get bandwidth collapsed: {gib_s:.3f} GiB/s"
+
+
+def test_get_is_zero_copy(rt):
+    """Large-array get returns a view of the shm mapping, not a copy."""
+    arr = np.arange(4 << 20, dtype=np.uint8)
+    r = ray_tpu.put(arr)
+    out = ray_tpu.get(r)
+    assert not out.flags.writeable  # plasma semantics: immutable view
+    assert not out.flags.owndata
+    np.testing.assert_array_equal(out[:64], arr[:64])
+    # a second get maps independently
+    out2 = ray_tpu.get(r)
+    np.testing.assert_array_equal(out2[:64], arr[:64])
+    del out, out2
+    ray_tpu.internal_free([r])
+
+
+def test_zero_copy_survives_free(rt):
+    """POSIX shm: unlink by the owner leaves live mappings valid."""
+    arr = np.full(2 << 20, 7, dtype=np.uint8)
+    r = ray_tpu.put(arr)
+    out = ray_tpu.get(r)
+    ray_tpu.internal_free([r])
+    assert int(out[123]) == 7  # mapping still readable after unlink
+
+
+def test_actor_call_floor(rt):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return b"ok"
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+    rate = _rate(lambda: ray_tpu.get([a.ping.remote() for _ in range(50)]), 4) * 50
+    ray_tpu.kill(a)
+    assert rate > 300, f"actor call throughput collapsed: {rate:.0f}/s"
