@@ -205,11 +205,13 @@ def bench_cross_node(b: Bench):
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="BENCH_core.json")
+    ap.add_argument("--out", default=None, help="summary JSON path; defaults to BENCH_core.json for full runs only")
     ap.add_argument("--filter", default="")
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args(argv)
 
+    if args.out is None and not args.filter and not args.quick:
+        args.out = "BENCH_core.json"  # partial runs never clobber the baseline
     budget = 0.5 if args.quick else 2.0
     ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
     b = Bench(budget, args.out, args.filter)

@@ -332,7 +332,7 @@ class ObjectStore:
         else:
             # detach inline entries from caller memory: pickle5 buffer views
             # alias the original object, which the caller may mutate
-            if any(isinstance(b, memoryview) or not isinstance(b, bytes) for b in s.buffers):
+            if any(not isinstance(b, bytes) for b in s.buffers):
                 s = Serialized(header=s.header, buffers=[bytes(b) for b in s.buffers], contained_refs=s.contained_refs)
             entry = StoredObject(value=s, contained_refs=list(s.contained_refs))
         self.seal(obj_id, entry)

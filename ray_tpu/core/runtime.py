@@ -1185,8 +1185,21 @@ class Runtime:
                 # _dispatch_node runs concurrently from the scheduler pass,
                 # the completion fast path (worker-IO thread) and
                 # _fast_submit: the worker must be claimed under the node
-                # lock or two dispatchers hand two tasks to the same worker
-                w = next((x for x in idle if x.state == "idle" and (not chips or x.fresh)), None)
+                # lock or two dispatchers hand two tasks to the same
+                # worker. The claim re-checks env compatibility too — a
+                # racing dispatcher may have bound a different runtime_env
+                # to this worker since the idle snapshot above.
+                w = next(
+                    (
+                        x
+                        for x in idle
+                        if x.state == "idle"
+                        and (not chips or x.fresh)
+                        and "TPU_VISIBLE_CHIPS" not in x.env_binding
+                        and x.env_binding.get("runtime_env") in (renv_key, None)
+                    ),
+                    None,
+                )
                 if w is None:
                     continue  # idle snapshot went stale; rescan
                 node.dispatch_queue.pop(0)

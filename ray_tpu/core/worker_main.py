@@ -417,6 +417,30 @@ class WorkerClient:
             from ray_tpu.experimental.device_objects import export_for_transfer
 
             return export_for_transfer
+        if name == "__rt_chan_setup__":
+            # channel-compiled DAG: bring up this actor's ring endpoints
+            # and start its execution-loop thread (experimental/channels.py)
+            def _chan_setup(plan):
+                from ray_tpu.experimental.channels import ChannelLoopRunner
+
+                old = getattr(self, "_chan_runner", None)
+                if old is not None:
+                    old.teardown()
+                runner = ChannelLoopRunner(self._actor_instance, plan)
+                runner.setup()
+                self._chan_runner = runner
+                return True
+
+            return _chan_setup
+        if name == "__rt_chan_teardown__":
+            def _chan_teardown():
+                runner = getattr(self, "_chan_runner", None)
+                if runner is not None:
+                    runner.teardown()
+                    self._chan_runner = None
+                return True
+
+            return _chan_teardown
         fn = getattr(self._actor_instance, name, None)
         if fn is None:
             raise AttributeError(f"actor has no method {name!r}")

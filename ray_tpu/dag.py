@@ -48,14 +48,24 @@ class DAGNode:
         memo = {"__inputs__": input_args}
         return self._execute_memo(memo)
 
-    def experimental_compile(self) -> "CompiledDAG":
+    def experimental_compile(self, *, channels: bool = False, nslots: int = 8, buffer_size_bytes: int = 256 << 10):
         """Compile this DAG for repeated execution (reference:
         compiled_dag_node.py). Topology is validated and actors are
         instantiated ONCE at compile time; each execute() then walks a
-        flat pre-ordered schedule. (Accelerator-tensor pipelines — the
-        reference's NCCL-channel use of compiled graphs — are the GSPMD
-        microbatch pipeline in ray_tpu.parallel.pipeline, which compiles
-        the whole schedule into one XLA program.)"""
+        flat pre-ordered schedule.
+
+        ``channels=True`` compiles to persistent shm-ring channels with
+        per-actor execution loops — the head leaves the steady-state path
+        entirely and each hop is a ~30us doorbell
+        (ray_tpu.experimental.compiled_dag; same-host actor-method DAGs).
+        (Accelerator-tensor pipelines — the reference's NCCL-channel use
+        of compiled graphs — are the GSPMD microbatch pipeline in
+        ray_tpu.parallel.pipeline, which compiles the whole schedule into
+        one XLA program.)"""
+        if channels:
+            from ray_tpu.experimental.compiled_dag import ChannelCompiledDAG
+
+            return ChannelCompiledDAG(self, nslots=nslots, buffer_size_bytes=buffer_size_bytes)
         return CompiledDAG(self)
 
     def _execute_impl(self, memo):  # pragma: no cover - abstract

@@ -1,0 +1,225 @@
+"""Channel-compiled DAG execution (experimental/compiled_dag.py).
+
+Reference parity: python/ray/dag/compiled_dag_node.py tests
+(python/ray/dag/tests/experimental/test_accelerated_dag.py) — compile
+once, execute many times over persistent channels, error propagation,
+teardown, actor-death handling.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu.experimental.channels import ChannelError, ChannelFullError
+from ray_tpu.experimental.compiled_dag import compile_channel_dag
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=6, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Adder:
+    def __init__(self, k=0):
+        self.k = k
+
+    def add(self, x):
+        return x + self.k
+
+    def add2(self, x, y):
+        return x + y
+
+    def boom(self, x):
+        raise ValueError(f"boom on {x}")
+
+    def big(self, x):
+        return b"z" * (1 << 20)
+
+
+def test_linear_chain(rt):
+    a, b = Adder.remote(1), Adder.remote(10)
+    with InputNode() as inp:
+        dag = b.add.bind(a.add.bind(inp))
+    c = dag.experimental_compile(channels=True)
+    try:
+        for i in range(20):
+            assert c.execute(i).get(timeout=30) == i + 11
+    finally:
+        c.teardown(kill_actors=True)
+
+
+def test_diamond_fan_out_fan_in(rt):
+    a, b, c, d = Adder.remote(1), Adder.remote(10), Adder.remote(100), Adder.remote()
+    with InputNode() as inp:
+        mid = a.add.bind(inp)
+        dag = d.add2.bind(b.add.bind(mid), c.add.bind(mid))
+    comp = compile_channel_dag(dag)
+    try:
+        # (x+1+10) + (x+1+100)
+        assert comp.execute(5).get(timeout=30) == 16 + 106
+        assert comp.execute(0).get(timeout=30) == 11 + 101
+    finally:
+        comp.teardown(kill_actors=True)
+
+
+def test_multi_output_and_consts(rt):
+    a, b = Adder.remote(1), Adder.remote()
+    with InputNode() as inp:
+        x = a.add.bind(inp)
+        dag = MultiOutputNode([x, b.add2.bind(x, 1000)])
+    comp = compile_channel_dag(dag)
+    try:
+        out = comp.execute(5).get(timeout=30)
+        assert out == [6, 1006]
+    finally:
+        comp.teardown(kill_actors=True)
+
+
+def test_same_actor_two_steps(rt):
+    a = Adder.remote(3)
+    with InputNode() as inp:
+        dag = a.add.bind(a.add.bind(inp))  # self-edge: local queue, no socket
+    comp = compile_channel_dag(dag)
+    try:
+        assert comp.execute(4).get(timeout=30) == 10
+        assert comp.execute(0).get(timeout=30) == 6
+    finally:
+        comp.teardown(kill_actors=True)
+
+
+def test_cyclic_actor_reuse(rt):
+    """a -> b -> a: setup must not deadlock when an actor's reader waits
+    on a peer whose own reader waits on this actor's writer (two-phase
+    bind/dial/accept)."""
+    a, b = Adder.remote(1), Adder.remote(10)
+    with InputNode() as inp:
+        dag = a.add.bind(b.add.bind(a.add.bind(inp)))
+    comp = compile_channel_dag(dag)
+    try:
+        for i in range(10):
+            assert comp.execute(i).get(timeout=30) == i + 12
+    finally:
+        comp.teardown(kill_actors=True)
+
+
+def test_error_propagates_to_driver(rt):
+    a, b = Adder.remote(1), Adder.remote(2)
+    with InputNode() as inp:
+        dag = b.add.bind(a.boom.bind(inp))
+    comp = compile_channel_dag(dag)
+    try:
+        with pytest.raises(ValueError, match="boom on 7"):
+            comp.execute(7).get(timeout=30)
+        # pipeline survives an application error: next execute works?
+        # application errors drain through; the dag is NOT broken
+        with pytest.raises(ValueError, match="boom on 8"):
+            comp.execute(8).get(timeout=30)
+    finally:
+        comp.teardown(kill_actors=True)
+
+
+def test_in_flight_cap(rt):
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    comp = compile_channel_dag(dag, nslots=4)
+    try:
+        refs = [comp.execute(i) for i in range(4)]
+        with pytest.raises(ChannelError, match="in flight"):
+            comp.execute(99)
+        assert [r.get(timeout=30) for r in refs] == [1, 2, 3, 4]
+        assert comp.execute(50).get(timeout=30) == 51  # cap freed by gets
+    finally:
+        comp.teardown(kill_actors=True)
+
+
+def test_slot_overflow_raises(rt):
+    a = Adder.remote()
+    with InputNode() as inp:
+        dag = a.big.bind(inp)
+    comp = compile_channel_dag(dag, buffer_size_bytes=64 << 10)
+    try:
+        with pytest.raises(ChannelFullError, match="buffer_size_bytes"):
+            comp.execute(b"x" * (256 << 10))
+    finally:
+        comp.teardown(kill_actors=True)
+
+
+def test_execute_after_teardown_raises(rt):
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    comp = compile_channel_dag(dag)
+    assert comp.execute(1).get(timeout=30) == 2
+    comp.teardown(kill_actors=True)
+    with pytest.raises(ChannelError, match="torn down"):
+        comp.execute(2)
+
+
+def test_actor_death_breaks_dag(rt):
+    a, b = Adder.remote(1), Adder.remote(10)
+    with InputNode() as inp:
+        dag = b.add.bind(a.add.bind(inp))
+    comp = compile_channel_dag(dag)
+    assert comp.execute(0).get(timeout=30) == 11
+    ray_tpu.kill(a)
+    with pytest.raises(ChannelError):
+        # the dead stage surfaces as a closed channel on execute or get
+        for i in range(50):
+            comp.execute(i).get(timeout=10)
+            time.sleep(0.05)
+    comp.teardown(kill_actors=True)  # teardown after failure is safe
+
+
+def test_no_input_edge_rejected(rt):
+    a = Adder.remote(1)
+    dag = a.add.bind(42)  # constant-clocked node: would free-run
+    with pytest.raises(ValueError, match="in-edge"):
+        compile_channel_dag(dag)
+
+
+def test_plain_function_rejected(rt):
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    with InputNode() as inp:
+        dag = f.bind(inp)
+    with pytest.raises(ValueError, match="actor-method"):
+        compile_channel_dag(dag)
+
+
+def test_hop_latency_beats_task_roundtrip(rt):
+    """The compiled steady-state hop must be ~10x under the task round
+    trip (VERDICT round-3 item 2 acceptance bar)."""
+
+    @ray_tpu.remote
+    def nop():
+        return 0
+
+    ray_tpu.get([nop.remote() for _ in range(10)])
+    t0 = time.perf_counter()
+    for _ in range(30):
+        ray_tpu.get(nop.remote())
+    task_rt = (time.perf_counter() - t0) / 30
+
+    a, b, c = Adder.remote(1), Adder.remote(1), Adder.remote(1)
+    with InputNode() as inp:
+        dag = c.add.bind(b.add.bind(a.add.bind(inp)))
+    comp = compile_channel_dag(dag)
+    try:
+        comp.execute(0).get(timeout=30)  # warm
+        N = 300
+        t0 = time.perf_counter()
+        for i in range(N):
+            comp.execute(i).get(timeout=30)
+        per_exec = (time.perf_counter() - t0) / N
+        per_hop = per_exec / 4  # driver->a->b->c->driver
+        assert per_hop < task_rt / 10, f"hop {per_hop*1e6:.0f}us vs task rt {task_rt*1e6:.0f}us"
+    finally:
+        comp.teardown(kill_actors=True)
