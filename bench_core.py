@@ -179,6 +179,48 @@ def bench_actors(b: Bench):
     ray_tpu.kill(aa)
 
 
+def bench_metadata_ceiling(b: Bench):
+    """Head object-metadata throughput limit (VERDICT r3 item 6): every
+    object's refcount/lineage/location lives in the single head process
+    (reference distributes this to owners, core_worker/reference_counter.h:44),
+    so aggregate metadata ops/s across ALL clients is bounded by one
+    process. Measured by hammering inline put+free (pure metadata, no shm,
+    no scheduling) from increasing thread counts; the plateau IS the
+    ceiling, documented in README.md#scaling-limits."""
+    import threading
+
+    for nthreads in (1, 4):
+        def hammer_batch():
+            stop = [False]
+            counts = [0] * nthreads
+
+            def worker(i):
+                while not stop[0]:
+                    r = ray_tpu.put(i)
+                    ray_tpu.internal_free([r])
+                    counts[i] += 1
+
+            ts = [threading.Thread(target=worker, args=(i,)) for i in range(nthreads)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            time.sleep(1.0)
+            stop[0] = True
+            for t in ts:
+                t.join()
+            return sum(counts) / (time.perf_counter() - t0)
+
+        rate = hammer_batch()
+        rec = {
+            "metric": f"metadata_put_free_{nthreads}thread",
+            "value": round(rate, 2),
+            "unit": "ops/s",
+            "per_op_us": round(1e6 / max(rate, 1), 2),
+        }
+        b.results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+
 def bench_cross_node(b: Bench):
     """Cross-node pull over the TCP transfer service (shm-isolated node =
     a real second host: no same-host shm attach fast path)."""
@@ -219,6 +261,7 @@ def main(argv=None):
         bench_objects(b)
         bench_tasks(b)
         bench_actors(b)
+        bench_metadata_ceiling(b)
         bench_cross_node(b)
     finally:
         b.dump()

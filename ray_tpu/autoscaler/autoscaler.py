@@ -223,8 +223,11 @@ class Autoscaler:
             for nid, (tname, _) in self._managed.items():
                 counts[tname] = counts.get(tname, 0) + 1
 
-            # demand = queued tasks + min_workers floors
+            # demand = queued tasks + pending placement groups (gang/slice
+            # reservations surface here, e.g. TPU-{pod}-head) + floors
             demand = self.rt.scheduler.pending_demand()
+            if hasattr(self.rt, "pending_pg_demand"):
+                demand = demand + self.rt.pending_pg_demand()
             headroom = [dict(n.available) for n in nodes]
             launches: list[NodeTypeConfig] = []
             planned: list[dict] = []
@@ -279,9 +282,15 @@ class Autoscaler:
                 if entry is None:
                     continue
                 tname, _ = entry
-                busy = any(w.state in ("busy", "actor", "starting") for w in n.workers.values()) or bool(
-                    n.pg_bundles
-                ) or bool(n.dispatch_queue)
+                # group-aware: a slice is busy if ANY of its hosts is
+                # (the provider groups gang members, gke.nodes_in_group)
+                group = getattr(self.provider, "nodes_in_group", lambda x: [x])(n)
+                busy = any(
+                    any(w.state in ("busy", "actor", "starting") for w in g.workers.values())
+                    or bool(g.pg_bundles)
+                    or bool(g.dispatch_queue)
+                    for g in group
+                )
                 if busy:
                     self._idle_since.pop(n.node_id, None)
                     continue

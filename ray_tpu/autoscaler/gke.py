@@ -1,0 +1,127 @@
+"""GKE/TPU node provider: slice-granular, atomic, label-aware.
+
+Reference parity: python/ray/autoscaler/_private/gcp/node_provider.py:1
+(GCP REST provisioning) + python/ray/autoscaler/batching_node_provider.py:1
+(kuberay: desired-state patches against an API server, used for TPU
+slices). TPU-first redesign: the provisioning unit is a whole SLICE
+(one `create_tpu_node_pool` call brings up every host VM of the slice
+atomically), never an individual VM — a partial slice cannot run an SPMD
+program, so scaling by hosts is meaningless on TPU pods.
+
+The REST surface is injected (``api``), with the exact method shapes a
+GKE node-pool client exposes; production backs it with
+container.googleapis.com + tpu.googleapis.com, tests with a fake that
+"boots" agents into the cluster:
+
+    api.create_tpu_node_pool(name, pod_type, labels) -> {"hosts": N}
+    api.delete_tpu_node_pool(name)
+    api.list_tpu_node_pools() -> {name: {...}}
+
+Joined hosts carry the slice labels from accelerators/tpu.py
+(ray_tpu.io/tpu-slice-name / -worker-id / -pod-type), per-host chips as
+``TPU`` resources, and worker 0 the ``TPU-{pod}-head`` marker that gang
+reservations (util/tpu.py SlicePlacementGroup) key on. The autoscaler
+therefore scales SLICES whenever queued demand carries a head resource.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+
+from ray_tpu.autoscaler.autoscaler import NodeProvider, NodeTypeConfig
+
+logger = logging.getLogger(__name__)
+
+SLICE_LABEL = "ray_tpu.io/tpu-slice-name"
+POD_TYPE_LABEL = "ray_tpu.io/tpu-pod-type"
+
+
+def slice_node_type(pod_type: str, *, name: str | None = None, num_cpus_per_host: int = 8, max_slices: int = 4, min_slices: int = 0) -> NodeTypeConfig:
+    """NodeTypeConfig describing ONE slice of ``pod_type`` as the scaling
+    unit: resources are the slice AGGREGATE (so head-resource and whole-
+    slice demand match in _pick_type), labels carry the pod type for the
+    provider."""
+    from ray_tpu.accelerators.tpu import chips_per_host, num_hosts
+
+    hosts = num_hosts(pod_type)
+    chips = chips_per_host(pod_type)
+    return NodeTypeConfig(
+        name=name or f"tpu-{pod_type}",
+        resources={
+            "CPU": float(num_cpus_per_host * hosts),
+            "TPU": float(chips * hosts),
+            f"TPU-{pod_type}-head": 1.0,
+        },
+        min_workers=min_slices,
+        max_workers=max_slices,
+        labels={POD_TYPE_LABEL: pod_type},
+    )
+
+
+class GKETPUNodeProvider(NodeProvider):
+    """create_node provisions ONE whole slice; terminate_node tears the
+    whole slice down. The autoscaler tracks the slice through its
+    worker-0 node; ``nodes_in_group`` exposes the full membership for
+    idle/busy accounting."""
+
+    JOIN_TIMEOUT_S = 300.0
+
+    def __init__(self, runtime, api):
+        self.rt = runtime
+        self.api = api
+        self._slices: dict = {}  # slice_name -> [node_ids]
+
+    def _slice_nodes(self, slice_name: str):
+        return [n for n in self.rt.node_list() if n.labels.get(SLICE_LABEL) == slice_name]
+
+    def create_node(self, node_type: NodeTypeConfig):
+        pod_type = node_type.labels.get(POD_TYPE_LABEL)
+        if not pod_type:
+            raise ValueError(f"node type {node_type.name!r} has no {POD_TYPE_LABEL} label; use slice_node_type()")
+        slice_name = f"{node_type.name}-{uuid.uuid4().hex[:6]}"
+        info = self.api.create_tpu_node_pool(slice_name, pod_type, dict(node_type.labels))
+        want_hosts = int(info.get("hosts", 0)) or 1
+        deadline = time.monotonic() + self.JOIN_TIMEOUT_S
+        while time.monotonic() < deadline:
+            members = self._slice_nodes(slice_name)
+            if len(members) >= want_hosts:
+                members.sort(key=lambda n: int(n.labels.get("ray_tpu.io/tpu-worker-id", 0)))
+                for n in members:
+                    n.labels["ray_tpu.io/node-type"] = node_type.name
+                self._slices[slice_name] = [n.node_id for n in members]
+                logger.info("slice %s up: %d hosts of %s", slice_name, want_hosts, pod_type)
+                return members[0]  # worker 0 represents the slice
+            time.sleep(0.25)
+        # partial slice is useless: roll the pool back
+        try:
+            self.api.delete_tpu_node_pool(slice_name)
+        except Exception:
+            pass
+        raise TimeoutError(f"slice {slice_name} ({want_hosts} hosts) never fully joined")
+
+    def terminate_node(self, node):
+        slice_name = node.labels.get(SLICE_LABEL)
+        if slice_name is None:
+            self.rt.remove_node(node.node_id, graceful=True)
+            return
+        member_ids = self._slices.pop(slice_name, None) or [n.node_id for n in self._slice_nodes(slice_name)]
+        for nid in member_ids:
+            try:
+                self.rt.remove_node(nid, graceful=True)
+            except Exception:
+                logger.warning("failed removing slice member %s", nid.hex()[:8])
+        try:
+            self.api.delete_tpu_node_pool(slice_name)
+        except Exception:
+            logger.exception("GKE delete of slice %s failed", slice_name)
+        logger.info("slice %s terminated (%d hosts)", slice_name, len(member_ids))
+
+    def nodes_in_group(self, node):
+        """Every host of the node's slice (autoscaler busy/idle checks
+        must consider the whole gang, not just worker 0)."""
+        slice_name = node.labels.get(SLICE_LABEL)
+        if slice_name is None:
+            return [node]
+        return self._slice_nodes(slice_name) or [node]

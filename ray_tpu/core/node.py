@@ -205,6 +205,13 @@ class Node:
         with self._lock:
             total = self.pg_bundle_totals.get(pg_id, {}).pop(bundle_idx, None)
             self.pg_bundles.get(pg_id, {}).pop(bundle_idx, None)
+            # drop emptied pg entries: `bool(node.pg_bundles)` is the
+            # autoscaler's reserved-capacity signal, and a stale empty
+            # {pg_id: {}} would mark the node busy forever
+            if not self.pg_bundles.get(pg_id):
+                self.pg_bundles.pop(pg_id, None)
+            if not self.pg_bundle_totals.get(pg_id):
+                self.pg_bundle_totals.pop(pg_id, None)
             if total:
                 self.release(total)
 

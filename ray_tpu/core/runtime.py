@@ -975,6 +975,32 @@ class Runtime:
         self.gcs.events.record("pg_created", pg_id=pgs.pg_id.hex(), strategy=pgs.strategy)
         return True
 
+    def pending_pg_demand(self) -> list[dict]:
+        """Resource requests of PENDING placement groups, for the
+        autoscaler (reference: autoscaler v2 folds GCS placement-group
+        demand into cluster resource demand). STRICT_PACK bundles merge
+        into one per-node request — the whole group must fit one node —
+        while PACK/SPREAD bundles are independent per-node requests."""
+        out = []
+        for pg_id in list(self._pending_pgs):
+            pgs = self.placement_groups.get(pg_id)
+            if pgs is None:
+                continue
+            with pgs.cond:
+                if pgs.state != "PENDING":
+                    continue
+                bundles = [dict(b) for b in pgs.bundles]
+                strategy = pgs.strategy
+            if strategy == "STRICT_PACK" and len(bundles) > 1:
+                merged: dict = {}
+                for b in bundles:
+                    for k, v in b.items():
+                        merged[k] = merged.get(k, 0.0) + v
+                out.append(merged)
+            else:
+                out.extend(bundles)
+        return out
+
     def wait_placement_group(self, pg_id: PlacementGroupID, timeout: float | None = None) -> bool:
         pgs = self.placement_groups.get(pg_id)
         if pgs is None:

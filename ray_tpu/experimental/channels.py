@@ -213,7 +213,11 @@ class ChannelWriter(_Endpoint):
         self._connect(connect_timeout)
 
     def send(self, value) -> None:
-        payload = pickle.dumps(value, protocol=5)
+        self.send_bytes(pickle.dumps(value, protocol=5))
+
+    def send_bytes(self, payload: bytes) -> None:
+        """Send a pre-pickled payload (lets callers validate a whole batch
+        of sends before committing any — compiled_dag.execute)."""
         if len(payload) > self.slot_size - _HDR.size:
             raise ChannelFullError(
                 f"channel message of {len(payload)} bytes exceeds slot size "

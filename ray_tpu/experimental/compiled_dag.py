@@ -30,6 +30,7 @@ from ray_tpu.experimental.channels import (
     STOP,
     ChannelClosedError,
     ChannelError,
+    ChannelFullError,
     ChannelReader,
     ChannelWriter,
     _Stop,
@@ -262,13 +263,30 @@ class ChannelCompiledDAG:
                     f"{self.nslots} executions already in flight; get() results "
                     "first (or compile with a larger nslots)"
                 )
+            # validate EVERYTHING before any send — arity, picklability,
+            # slot fit — because a partial row in the input rings would
+            # desync every later execution; an unexpected mid-row failure
+            # after that still marks the DAG broken
+            needed = max(self._input_chans.values(), default=-1) + 1
+            if len(input_args) < needed:
+                raise ValueError(f"compiled DAG takes {needed} inputs, got {len(input_args)}")
+            import pickle as _pickle
+
+            payloads = {}
+            for name, idx in self._input_chans.items():
+                data = _pickle.dumps(input_args[idx], protocol=5)
+                w = self._writers[name]
+                if len(data) > w.slot_size - 8:
+                    raise ChannelFullError(
+                        f"input {idx} is {len(data)} bytes, exceeds slot size {w.slot_size}; "
+                        "raise experimental_compile(buffer_size_bytes=...)"
+                    )
+                payloads[name] = data
             try:
-                for name, idx in self._input_chans.items():
-                    if idx >= len(input_args):
-                        raise ValueError(f"compiled DAG takes input {idx}, got {len(input_args)} args")
-                    self._writers[name].send(input_args[idx])
-            except ChannelClosedError as e:
-                self._broken = e
+                for name, data in payloads.items():
+                    self._writers[name].send_bytes(data)
+            except BaseException as e:  # noqa: BLE001 - mid-row failure poisons the rings
+                self._broken = e if isinstance(e, ChannelError) else ChannelError(f"mid-row send failed: {e!r}")
                 raise
             seq = self._send_seq
             self._send_seq += 1

@@ -39,6 +39,8 @@ class RequestState:
     finish_reason: str | None = None
     # streaming consumers read from here
     out_queue: "queue.SimpleQueue | None" = None
+    # KV computed by a remote prefill engine (disaggregation)
+    prefilled: dict | None = None
 
 
 @dataclass
@@ -60,6 +62,109 @@ def _bucket(n: int, buckets) -> int:
     raise ValueError(f"prompt length {n} exceeds the largest prefill bucket {buckets[-1]}")
 
 
+class PrefixCache:
+    """Hash-prefix KV reuse across requests (reference capability:
+    enable_prefix_caching, python/ray/llm/_internal/serve/engines/vllm/
+    vllm_models.py:215-228 — vLLM hashes fixed-size blocks; here prefixes
+    are cached at block-aligned lengths as whole device arrays, matching
+    the slot cache's contiguous layout, and admission re-attends the
+    remaining suffix with model_runner.extend).
+
+    Entries: hash(tokens[:n]) -> (k [L, n, kv, hd], v, n) on device.
+    LRU-evicted under a byte budget. Stats drive tests and metrics.
+    """
+
+    def __init__(self, block: int = 64, max_bytes: int = 256 << 20):
+        self.block = block
+        self.max_bytes = max_bytes
+        # one GROUP per stored prompt: shared (k, v) device arrays; every
+        # block boundary of the prompt aliases into the group with its own
+        # valid length (insert masks the padded tail, so no slicing)
+        self._groups: dict = {}  # gid -> (k, v, nbytes, [keys])
+        self._keys: dict = {}  # hash(prefix) -> (gid, n)
+        self._order: deque = deque()  # LRU over gids: left = coldest
+        self._next_gid = 0
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.tokens_saved = 0
+        self.evictions = 0
+
+    def lookup(self, prompt_token_ids):
+        """Longest block-aligned cached prefix STRICTLY shorter than the
+        prompt (at least one token must remain to produce logits). Hits
+        are verified token-for-token — a hash collision must never serve
+        a foreign prompt's KV (the reference block cache exact-matches
+        too)."""
+        n = ((len(prompt_token_ids) - 1) // self.block) * self.block
+        while n >= self.block:
+            prefix = tuple(int(t) for t in prompt_token_ids[:n])
+            hit = self._keys.get(hash(prefix))
+            if hit is not None and hit[2] == prefix:
+                gid, n_valid, _ = hit
+                k, v, _, _ = self._groups[gid]
+                self._order.remove(gid)
+                self._order.append(gid)
+                self.hits += 1
+                self.tokens_saved += n_valid
+                return k, v, n_valid
+            n -= self.block
+        self.misses += 1
+        return None
+
+    def store(self, prompt_token_ids, ks, vs, buckets):
+        """Cache a freshly prefilled prompt's K/V once, keyed at EVERY
+        block boundary. ks/vs: [L, T_pad, kv, hd] device arrays, stored
+        padded to the prefix's PREFILL BUCKET so re-insert reuses the
+        already-compiled insert program (a raw per-length shape would mint
+        one XLA program per distinct n)."""
+        n_max = (len(prompt_token_ids) // self.block) * self.block
+        if n_max < self.block:
+            return
+        ids = tuple(int(t) for t in prompt_token_ids[:n_max])
+        new_keys = []
+        for n in range(self.block, n_max + 1, self.block):
+            prefix = ids[:n]
+            key = hash(prefix)
+            if key not in self._keys:
+                new_keys.append((key, n, prefix))
+        if not new_keys:
+            return
+        pad = _bucket(n_max, buckets)
+        k = ks[:, :pad]
+        v = vs[:, :pad]
+        nbytes = int(k.nbytes) + int(v.nbytes)
+        if nbytes > self.max_bytes:
+            return
+        while self._bytes + nbytes > self.max_bytes and self._order:
+            self._evict_one()
+        gid = self._next_gid
+        self._next_gid += 1
+        self._groups[gid] = (k, v, nbytes, [key for key, _, _ in new_keys])
+        for key, n, prefix in new_keys:
+            self._keys[key] = (gid, n, prefix)
+        self._order.append(gid)
+        self._bytes += nbytes
+
+    def _evict_one(self):
+        gid = self._order.popleft()
+        _, _, nbytes, keys = self._groups.pop(gid)
+        for key in keys:
+            self._keys.pop(key, None)
+        self._bytes -= nbytes
+        self.evictions += 1
+
+    def stats(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "tokens_saved": self.tokens_saved,
+            "evictions": self.evictions,
+            "entries": len(self._groups),
+            "bytes": self._bytes,
+        }
+
+
 class LLMEngine:
     """Continuous-batching engine over a slot KV cache.
 
@@ -78,6 +183,9 @@ class LLMEngine:
         seed: int = 0,
         cache_dtype: str | None = None,
         mesh=None,
+        enable_prefix_caching: bool = True,
+        prefix_cache_bytes: int = 256 << 20,
+        prefix_block: int = 64,
     ):
         import jax
         import jax.numpy as jnp
@@ -99,7 +207,7 @@ class LLMEngine:
             buckets.append(self.max_seq_len)
             prefill_buckets = tuple(buckets)
         self.prefill_buckets = tuple(sorted(prefill_buckets))
-        self._prefill, self._insert, self._decode = make_runner_fns(config)
+        self._prefill, self._insert, self._decode, self._extend = make_runner_fns(config)
         self._sample = jax.jit(sample)
 
         cache_cfg = kvc.CacheConfig(
@@ -140,6 +248,9 @@ class LLMEngine:
         self._requests: dict[str, RequestState] = {}
         self._lock = threading.Lock()
         self._auto_id = 0
+        self._prefix_cache = (
+            PrefixCache(block=prefix_block, max_bytes=prefix_cache_bytes) if enable_prefix_caching else None
+        )
 
     def _mesh_shardings(self, mesh):
         """Tensor-parallel serving (reference capability: the vLLM engine's
@@ -202,6 +313,61 @@ class LLMEngine:
             self._waiting.append(st)
             return request_id
 
+    def prefix_cache_stats(self) -> dict:
+        with self._lock:
+            return self._prefix_cache.stats() if self._prefix_cache else {}
+
+    # ------------------------------------------- prefill/decode disaggregation
+
+    def prefill_remote(self, prompt_token_ids) -> dict:
+        """Prefill-only: compute the prompt's KV and first-token logits and
+        return them as HOST arrays for a decode engine to admit
+        (reference: python/ray/llm/tests/serve/.../prefill_decode_disagg/ —
+        vLLM KV-connector handoff; here the payload rides the object store
+        between a prefill replica and its decode replicas)."""
+        import jax.numpy as jnp
+
+        n = len(prompt_token_ids)
+        T = _bucket(n, self.prefill_buckets)
+        toks = np.zeros((1, T), np.int32)
+        toks[0, :n] = prompt_token_ids
+        logits, ks, vs = self._prefill(self.params, jnp.asarray(toks), jnp.asarray([n], np.int32))
+        return {
+            "k": np.asarray(ks[:, 0]),
+            "v": np.asarray(vs[:, 0]),
+            "n": n,
+            "logits": np.asarray(logits[0]),
+            "prompt_token_ids": list(prompt_token_ids),
+        }
+
+    def add_prefilled(
+        self,
+        kv: dict,
+        params: SamplingParams | None = None,
+        request_id: str | None = None,
+        stream: bool = False,
+        out_queue=None,
+    ) -> str:
+        """Admit a sequence whose prefill ran on another engine; decoding
+        starts from the transferred KV without touching the prompt again."""
+        params = params or SamplingParams()
+        with self._lock:
+            if request_id is None:
+                request_id = f"req-{self._auto_id}"
+                self._auto_id += 1
+            prompt = list(kv["prompt_token_ids"])
+            if len(prompt) + params.max_tokens > self.max_seq_len:
+                raise ValueError(
+                    f"prompt ({len(prompt)}) + max_tokens ({params.max_tokens}) "
+                    f"exceeds max_seq_len ({self.max_seq_len})"
+                )
+            st = RequestState(request_id, prompt, params, prefilled=kv)
+            if stream or out_queue is not None:
+                st.out_queue = out_queue if out_queue is not None else queue.SimpleQueue()
+            self._requests[request_id] = st
+            self._waiting.append(st)
+            return request_id
+
     def abort_request(self, request_id: str) -> bool:
         with self._lock:
             st = self._requests.get(request_id)
@@ -238,11 +404,43 @@ class LLMEngine:
 
         slot = self._slots.index(None)
         n = len(st.prompt_token_ids)
-        T = _bucket(n, self.prefill_buckets)
-        toks = np.zeros((1, T), np.int32)
-        toks[0, :n] = st.prompt_token_ids
-        logits, ks, vs = self._prefill(self.params, jnp.asarray(toks), jnp.asarray([n], np.int32))
-        self.cache = self._insert(self.cache, slot, ks[:, 0], vs[:, 0], n)
+        if st.prefilled is not None:
+            # disaggregated admission: KV arrived from a prefill engine
+            kv = st.prefilled
+            st.prefilled = None
+            self.cache = self._insert(
+                self.cache, slot, jnp.asarray(kv["k"]), jnp.asarray(kv["v"]), int(kv["n"])
+            )
+            logits = jnp.asarray(kv["logits"])[None]
+        else:
+            pref = self._prefix_cache.lookup(st.prompt_token_ids) if self._prefix_cache else None
+            if pref is not None:
+                n_p = pref[2]
+                m = n - n_p
+                Tm = _bucket(m, self.prefill_buckets)
+                if n_p + Tm > self.max_seq_len:
+                    # the bucket-padded suffix would overrun the cache row
+                    # (dynamic_update_slice would CLAMP the start and
+                    # silently corrupt the prefix) — full prefill instead
+                    pref = None
+            if pref is not None:
+                # reuse the cached prefix KV; re-attend only the suffix
+                k_p, v_p, n_p = pref
+                self.cache = self._insert(self.cache, slot, k_p, v_p, n_p)
+                toks = np.zeros((Tm,), np.int32)
+                toks[:m] = st.prompt_token_ids[n_p:]
+                logits, self.cache = self._extend(
+                    self.params, self.cache, slot, jnp.asarray(toks), jnp.asarray(m, np.int32)
+                )
+                logits = logits[None]
+            else:
+                T = _bucket(n, self.prefill_buckets)
+                toks = np.zeros((1, T), np.int32)
+                toks[0, :n] = st.prompt_token_ids
+                logits, ks, vs = self._prefill(self.params, jnp.asarray(toks), jnp.asarray([n], np.int32))
+                if self._prefix_cache is not None:
+                    self._prefix_cache.store(st.prompt_token_ids, ks[:, 0], vs[:, 0], self.prefill_buckets)
+                self.cache = self._insert(self.cache, slot, ks[:, 0], vs[:, 0], n)
         st.slot = slot
         self._slots[slot] = st
         p = st.params

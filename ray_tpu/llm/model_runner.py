@@ -125,11 +125,75 @@ def decode_step(params, cache, tokens, cfg: LlamaConfig):
     return logits, new_cache
 
 
+def extend(params, cache, slot, tokens, length, cfg: LlamaConfig):
+    """Chunked prefill for ONE slot whose cache already holds a prefix.
+
+    The primitive behind prefix-cache reuse and prefill/decode
+    disaggregation (reference capabilities:
+    python/ray/llm/_internal/serve/engines/vllm/vllm_models.py:215-228
+    enable_prefix_caching, llm/tests/serve/.../prefill_decode_disagg/):
+    the suffix attends to the already-cached prefix plus itself causally,
+    with RoPE positions offset by the prefix length.
+
+    tokens: [T_pad] int32 (right-padded suffix); length: [] int32 real
+    suffix length; slot: [] int32. The cache's length[slot] is the prefix
+    length `start`. Writes suffix K/V at start..start+length, returns
+    (logits [vocab] f32 at the last real token, new cache) with
+    length[slot] = start + length.
+    """
+    T = tokens.shape[0]
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    rep = nh // nkv
+    S = cache["k"].shape[2]
+    slot = jnp.asarray(slot, jnp.int32)
+    start = cache["length"][slot]
+    positions = start + jnp.arange(T, dtype=jnp.int32)
+    cos, sin = rotary_embedding(positions, cfg.hd, cfg.rope_theta)
+    x = jnp.take(params["embed"], tokens[None, :], axis=0)  # [1, T, H]
+    # token i (at absolute pos start+i) sees cache pos j iff j <= start+i;
+    # stale cache beyond the suffix is masked out by the same bound
+    attn_ok = (jnp.arange(S, dtype=jnp.int32)[None, :] <= positions[:, None])[None, None]  # [1,1,T,S]
+    zero = jnp.zeros((), jnp.int32)
+
+    def layer_fn(x, xs):
+        layer, k_row, v_row = xs  # [S, nkv, hd] for this slot
+        xn = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q, k_t, v_t = _qkv(xn, layer, cfg)  # [1, T, nh/nkv, hd]
+        qh = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)  # [1, nh, T, hd]
+        kh = apply_rope(k_t.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)  # [1, T, nkv, hd]
+        k_row = jax.lax.dynamic_update_slice(k_row, kh[0].astype(k_row.dtype), (start, zero, zero))
+        v_row = jax.lax.dynamic_update_slice(v_row, v_t[0].astype(v_row.dtype), (start, zero, zero))
+        qg = qh[0].reshape(nkv, rep, T, hd)
+        kc = k_row.transpose(1, 0, 2)  # [nkv, S, hd]
+        vc = v_row.transpose(1, 0, 2)
+        scores = jnp.einsum("grth,gsh->grts", qg, kc, preferred_element_type=jnp.float32) / jnp.sqrt(hd)
+        scores = jnp.where(attn_ok[0], scores, -jnp.inf)  # [nkv, rep, T, S] vs [1, T, S]
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("grts,gsh->grth", probs, vc.astype(jnp.float32))
+        o = o.transpose(2, 0, 1, 3).reshape(1, T, nh * hd).astype(x.dtype)
+        x = x + jnp.dot(o, layer["wo"])
+        x = _mlp(x, layer, cfg)
+        return x, (k_row, v_row)
+
+    k_rows = cache["k"][:, slot]  # [L, S, nkv, hd]
+    v_rows = cache["v"][:, slot]
+    x, (k_new, v_new) = jax.lax.scan(layer_fn, x, (params["layers"], k_rows, v_rows))
+    x = rms_norm(x[0], params["final_norm"], cfg.rms_eps)  # [T, H]
+    x_last = x[jnp.maximum(length - 1, 0)]
+    unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
+    logits = jnp.dot(x_last, unembed, preferred_element_type=jnp.float32)
+    k = jax.lax.dynamic_update_slice(cache["k"], k_new[:, None], (zero, slot, zero, zero, zero))
+    v = jax.lax.dynamic_update_slice(cache["v"], v_new[:, None], (zero, slot, zero, zero, zero))
+    lens = cache["length"].at[slot].set(start + length)
+    return logits, {"k": k, "v": v, "length": lens}
+
+
 def make_runner_fns(cfg: LlamaConfig):
-    """Jitted (prefill, insert, decode) closures for an engine."""
+    """Jitted (prefill, insert, decode, extend) closures for an engine."""
     from ray_tpu.llm import kv_cache as kvc
 
     prefill_fn = jax.jit(partial(prefill, cfg=cfg))
     insert_fn = jax.jit(kvc.insert_sequence, donate_argnums=(0,))
     decode_fn = jax.jit(partial(decode_step, cfg=cfg), donate_argnums=(1,))
-    return prefill_fn, insert_fn, decode_fn
+    extend_fn = jax.jit(partial(extend, cfg=cfg), donate_argnums=(1,))
+    return prefill_fn, insert_fn, decode_fn, extend_fn

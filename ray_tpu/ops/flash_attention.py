@@ -467,7 +467,11 @@ def chunked_attention_bwd(q, k, v, g, lse, delta, causal: bool, scale: float, ch
         ds = p * (dp - delta[..., None]) * scale
         return dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_c), None
 
-    dq, _ = jax.lax.scan(dq_body, jnp.zeros((B, H, Tq, D), jnp.float32), (ks, vs, jnp.arange(nk)))
+    # carry zeros derive from q so they inherit any varying manual axes
+    # (vma) when this runs inside a shard_map region (e.g. ring attention
+    # under the pp x sp pipeline) — fresh jnp.zeros would be unvarying
+    # and lax.scan rejects the carry-type mismatch
+    dq, _ = jax.lax.scan(dq_body, (q32 * 0).astype(jnp.float32), (ks, vs, jnp.arange(nk)))
 
     Cq = _pick_chunk(Tq, chunk)
     nq = Tq // Cq
@@ -493,7 +497,7 @@ def chunked_attention_bwd(q, k, v, g, lse, delta, causal: bool, scale: float, ch
 
     (dk, dv), _ = jax.lax.scan(
         dkv_body,
-        (jnp.zeros((B, H, Tk, D), jnp.float32), jnp.zeros((B, H, Tk, D), jnp.float32)),
+        ((k32 * 0).astype(jnp.float32), (v32 * 0).astype(jnp.float32)),  # vma-inheriting zeros
         (qs, gs, lses, deltas, jnp.arange(nq)),
     )
     return dq, dk, dv

@@ -31,10 +31,12 @@ Design:
   correct: the transpose of the replicated-in/psum-out shard_map handles
   the stage-gated activations.
 
-Composition notes: pp × {dp, fsdp, tp} is supported. pp × sp is not —
-ring attention runs its own shard_map over `sp` and JAX does not nest
-manual regions; use Ulysses-style head sharding via tp for long sequences
-in pipelined configs.
+Composition notes: pp × {dp, fsdp, tp, sp} are all supported. pp × sp
+does NOT nest shard_maps (JAX forbids that): pipeline_apply(sp_axis=...)
+makes the ONE region manual over {pp, sp} and runs ring attention's
+local form (manual ppermute collectives, ring_attention_local) inside
+the stage body, with activations sequence-sharded and RoPE tables passed
+as sp-sharded seq_inputs. dp/fsdp/tp stay auto inside either way.
 """
 
 from __future__ import annotations
@@ -91,6 +93,8 @@ def pipeline_apply(
     num_microbatches: int,
     virtual_stages: int = 1,
     axis_name: str = "pp",
+    sp_axis: str | None = None,
+    seq_inputs: tuple = (),
 ):
     """Run stage-stacked layers over x with microbatch pipelining.
 
@@ -109,9 +113,20 @@ def pipeline_apply(
     groups of n keep every device on exactly one chunk per tick).
 
     stage_params: pytree with leading [n_stages, v, L/(n*v), ...] dims,
-      sharded P('pp') on dim 0. layer_fn(x, layer) applies ONE layer.
-    x: [B, ...] activations (NOT sharded over pp).
+      sharded P('pp') on dim 0. layer_fn(x, layer, *seq_locals) applies
+      ONE layer. x: [B, ...] activations (NOT sharded over pp).
     Returns [B, ...] outputs (replicated over pp after the closing psum).
+
+    pp x sp composition: with ``sp_axis`` set, the ONE shard_map region
+    goes manual over BOTH axes — ring attention cannot nest its own
+    shard_map inside the pp region, but its local form
+    (ring_attention_local, manual ppermute collectives over sp) runs
+    directly in the stage body. Activations shard their sequence dim
+    (axis 2 of the microbatched [M, mb, T, ...]) over sp; ``seq_inputs``
+    are per-position arrays ([T, ...], e.g. RoPE cos/sin) sharded over
+    sp on dim 0 and handed to layer_fn as extra args. dp/fsdp/tp stay
+    auto inside, exactly as without sp. (The reference cannot compose
+    these at all — SURVEY.md §5.7: it has no sequence parallelism.)
     """
     n = mesh.shape[axis_name]
     B = x.shape[0]
@@ -124,7 +139,7 @@ def pipeline_apply(
     mb = B // M
     x_mb = x.reshape(M, mb, *x.shape[1:])
 
-    def local(stage_p, xs):
+    def local(stage_p, xs, *seq_locals):
         # stage_p: [1, v, L/(n*v), ...] (this device's chunks); xs: [M, mb, ...]
         my = lax.axis_index(axis_name)
         stage_p = jax.tree.map(lambda t: t[0], stage_p)  # [v, per, ...]
@@ -133,7 +148,7 @@ def pipeline_apply(
             chunk = jax.tree.map(lambda t: lax.dynamic_index_in_dim(t, r, axis=0, keepdims=False), stage_p)
 
             def body(carry, layer):
-                return layer_fn(carry, layer), None
+                return layer_fn(carry, layer, *seq_locals), None
 
             out, _ = lax.scan(body, act, chunk)
             return out
@@ -178,14 +193,23 @@ def pipeline_apply(
         gated = jnp.where(my == n - 1, outputs, jnp.zeros_like(outputs)).astype(jnp.float32)
         return lax.psum(gated, axis_name).astype(outputs.dtype)
 
+    if sp_axis is None:
+        x_spec = P()
+        seq_specs = tuple(P() for _ in seq_inputs)
+        manual = {axis_name}
+    else:
+        # [M, mb, T, ...]: sequence dim sharded over sp
+        x_spec = P(None, None, sp_axis)
+        seq_specs = tuple(P(sp_axis) for _ in seq_inputs)
+        manual = {axis_name, sp_axis}
     fn = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(axis_name), P()),
-        out_specs=P(),
-        axis_names={axis_name},
+        in_specs=(P(axis_name), x_spec) + seq_specs,
+        out_specs=x_spec,
+        axis_names=manual,
     )
-    out_mb = fn(stage_params, x_mb)
+    out_mb = fn(stage_params, x_mb, *seq_inputs)
     return out_mb.reshape(B, *x.shape[1:])
 
 
@@ -213,8 +237,41 @@ def pp_init_params(config, key, n_stages: int, virtual_stages: int = 1):
     return params
 
 
+def _sp_local_layer_fn(x, layer, cos_l, sin_l, *, config):
+    """One llama layer on a LOCAL sequence shard, inside a region manual
+    over {pp, sp}: per-token ops (norms, projections, MLP) need no
+    communication; attention is the manual-collective ring
+    (ring_attention_local — ppermute over sp on ICI). cos_l/sin_l are
+    this shard's RoPE tables."""
+    from ray_tpu.ops.layers import apply_rope, rms_norm
+    from ray_tpu.parallel.ring_attention import ring_attention_local
+
+    B, Tl, H = x.shape
+    nh, nkv, hd = config.num_heads, config.num_kv_heads, config.hd
+    xn = rms_norm(x, layer["attn_norm"], config.rms_eps)
+    q = jnp.dot(xn, layer["wq"]).reshape(B, Tl, nh, hd).transpose(0, 2, 1, 3)
+    k = jnp.dot(xn, layer["wk"]).reshape(B, Tl, nkv, hd).transpose(0, 2, 1, 3)
+    v = jnp.dot(xn, layer["wv"]).reshape(B, Tl, nkv, hd).transpose(0, 2, 1, 3)
+    q = apply_rope(q, cos_l, sin_l)
+    k = apply_rope(k, cos_l, sin_l)
+    rep = nh // nkv
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    o = ring_attention_local(q, k, v, axis_name="sp", causal=True)
+    o = o.transpose(0, 2, 1, 3).reshape(B, Tl, nh * hd)
+    x = x + jnp.dot(o, layer["wo"])
+    xn = rms_norm(x, layer["mlp_norm"], config.rms_eps)
+    g = jnp.dot(xn, layer["w_gate"])
+    u = jnp.dot(xn, layer["w_up"])
+    return x + jnp.dot(jax.nn.silu(g) * u, layer["w_down"])
+
+
 def pp_forward(params, tokens, config, mesh: Mesh, num_microbatches: int, virtual_stages: int = 1):
-    """Pipelined llama forward: embed -> pp pipeline over layers -> unembed."""
+    """Pipelined llama forward: embed -> pp pipeline over layers -> unembed.
+    When the mesh also has an `sp` axis, the pipeline region goes manual
+    over {pp, sp} and runs ring attention per stage (pp x sp — see
+    pipeline_apply; the reference has no sequence parallelism at all)."""
     from ray_tpu.models.llama import _layer_fn
     from ray_tpu.ops.layers import rms_norm, rotary_embedding
 
@@ -223,7 +280,13 @@ def pp_forward(params, tokens, config, mesh: Mesh, num_microbatches: int, virtua
     cos, sin = rotary_embedding(positions, config.hd, config.rope_theta, dtype=jnp.float32)
     x = jnp.take(params["embed"], tokens, axis=0)
 
-    layer_fn = functools.partial(_layer_fn, config=config, cos=cos, sin=sin, positions=positions)
+    sp = "sp" if "sp" in mesh.axis_names and mesh.shape.get("sp", 1) > 1 else None
+    if sp is not None:
+        layer_fn = functools.partial(_sp_local_layer_fn, config=config)
+        seq_inputs = (cos, sin)
+    else:
+        layer_fn = functools.partial(_layer_fn, config=config, cos=cos, sin=sin, positions=positions)
+        seq_inputs = ()
     if config.remat:
         policy = getattr(jax.checkpoint_policies, config.remat_policy)
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
@@ -231,6 +294,7 @@ def pp_forward(params, tokens, config, mesh: Mesh, num_microbatches: int, virtua
     x = pipeline_apply(
         params["layers"], x, mesh=mesh, layer_fn=layer_fn,
         num_microbatches=num_microbatches, virtual_stages=virtual_stages,
+        sp_axis=sp, seq_inputs=seq_inputs,
     )
     x = rms_norm(x, params["final_norm"], config.rms_eps)
     unembed = params["embed"].T if config.tie_embeddings else params["unembed"]

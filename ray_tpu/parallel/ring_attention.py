@@ -98,8 +98,12 @@ def _ring_attn_fwd(q, k, v, axis_name, causal, scale, impl, chunk):
             return _local_fwd(q, k_i, v_i, False, scale, impl, chunk)
 
         def skip_step(k_i, v_i):
-            B, H, Tl, D = q.shape
-            return (jnp.zeros((B, H, Tl, D), jnp.float32), jnp.full((B, H, Tl), _NEG_INF, jnp.float32))
+            # zeros DERIVED from q/k_i so they inherit the region's varying
+            # manual axes (vma): fresh jnp.zeros would be unvarying and
+            # lax.switch rejects branch-type mismatch when this runs inside
+            # a wider manual region (e.g. pp x sp in parallel/pipeline.py)
+            zero_o = (q * 0 + k_i[..., :1, :] * 0).astype(jnp.float32)
+            return zero_o, jnp.full_like(zero_o[..., 0], _NEG_INF)
 
         def step(carry, i):
             (o, lse), kv = carry
@@ -141,8 +145,9 @@ def _ring_attn_bwd(axis_name, causal, scale, impl, chunk, res, g):
         return _local_bwd(q, k_i, v_i, g32, lse, delta, False, scale, impl, chunk)
 
     def skip_step(k_i, v_i):
-        z = jnp.zeros(q.shape, jnp.float32)
-        return z, jnp.zeros(k_i.shape, jnp.float32), jnp.zeros(v_i.shape, jnp.float32)
+        # vma-inheriting zeros (see forward skip_step)
+        z = (q * 0).astype(jnp.float32)
+        return z, (k_i * 0).astype(jnp.float32), (v_i * 0).astype(jnp.float32)
 
     def step(carry, i):
         dq, pkg = carry

@@ -1,0 +1,194 @@
+"""Async adapters over the blocking handle API.
+
+Reference parity: the reference's proxy awaits DeploymentResponses
+natively on uvicorn's loop (serve/_private/proxy.py). Here the runtime is
+thread-based, so two bridges make handle results awaitable WITHOUT a
+blocked thread per request:
+
+  * unary: the runtime's seal callback (Runtime.add_done_callback) fires
+    on the head's pool and resolves an asyncio future via
+    call_soon_threadsafe — no thread waits;
+  * streaming: ONE pump thread multiplexes ALL open streams, polling each
+    registered generator under the runtime's generator condition and
+    pushing ready values into per-stream asyncio queues. Thread count is
+    O(1) in the number of concurrent streams — the property that lets the
+    async proxy hold hundreds of SSE connections.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+import ray_tpu
+from ray_tpu.core import context
+from ray_tpu.exceptions import GetTimeoutError
+
+_SENTINEL = object()
+
+
+async def result_async(response, timeout_s: float | None = None):
+    """Await a DeploymentResponse without blocking a thread."""
+    loop = asyncio.get_running_loop()
+    fut: asyncio.Future = loop.create_future()
+
+    def cb(value, error):
+        def settle():
+            if fut.cancelled():
+                return
+            if error is not None:
+                fut.set_exception(error)
+            else:
+                fut.set_result(value)
+
+        loop.call_soon_threadsafe(settle)
+
+    rt = context.get_client()
+    rt.add_done_callback(response._ref.id, cb)
+    try:
+        value = await asyncio.wait_for(fut, timeout=timeout_s)
+    except asyncio.TimeoutError:
+        raise GetTimeoutError(f"request exceeded {timeout_s}s") from None
+    finally:
+        if fut.done() and not fut.cancelled():
+            response._settle()
+    return value
+
+
+class _StreamPump:
+    """Single background thread draining every registered stream."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._streams: dict[int, dict] = {}  # id -> state
+        self._next = 0
+        self._thread: threading.Thread | None = None
+        self._stop = False
+
+    def register(self, gen, loop) -> tuple[int, asyncio.Queue]:
+        """gen: core ObjectRefGenerator (has .generator_id)."""
+        q: asyncio.Queue = asyncio.Queue(maxsize=64)
+        with self._lock:
+            sid = self._next
+            self._next += 1
+            self._streams[sid] = {
+                "gen_id": gen.generator_id,
+                "index": 0,
+                "loop": loop,
+                "q": q,
+                "dead": False,
+                # puts scheduled via call_soon_threadsafe but not yet
+                # applied on the loop: qsize() alone can't see them, so
+                # backpressure counts both (guarded by cnt_lock)
+                "inflight": 0,
+                "cnt_lock": threading.Lock(),
+            }
+            if self._thread is None or not self._thread.is_alive():
+                self._stop = False
+                self._thread = threading.Thread(target=self._run, name="serve-stream-pump", daemon=True)
+                self._thread.start()
+        return sid, q
+
+    def unregister(self, sid: int):
+        with self._lock:
+            self._streams.pop(sid, None)
+
+    def _run(self):
+        from ray_tpu.core.ids import ObjectID
+
+        rt = context.get_client()
+        while not self._stop:
+            with self._lock:
+                streams = list(self._streams.items())
+            if not streams:
+                with self._lock:
+                    if not self._streams:
+                        self._thread = None
+                        return
+                continue
+            progressed = False
+            for sid, st in streams:
+                if st["dead"]:
+                    continue
+                # drain whatever is ready for this stream right now
+                while True:
+                    with st["cnt_lock"]:
+                        backlog = st["q"].qsize() + st["inflight"]
+                    if backlog >= 48:
+                        break  # backpressure: consumer lagging; headroom
+                        # below maxsize keeps sentinel/error pushes lossless
+                    try:
+                        item_id = rt.next_generator_item(st["gen_id"], st["index"], timeout=0)
+                    except GetTimeoutError:
+                        break  # nothing ready yet
+                    except Exception as e:  # noqa: BLE001
+                        self._push(st, e)
+                        st["dead"] = True
+                        break
+                    if item_id is None:
+                        self._push(st, _SENTINEL)
+                        st["dead"] = True
+                        break
+                    st["index"] += 1
+                    progressed = True
+                    try:
+                        value = rt.get_object(item_id, timeout=5.0)
+                    except BaseException as e:  # noqa: BLE001
+                        self._push(st, e)
+                        st["dead"] = True
+                        break
+                    self._push(st, value)
+            with self._lock:
+                for sid, st in list(self._streams.items()):
+                    if st["dead"]:
+                        del self._streams[sid]
+            if not progressed:
+                # sleep on the generator condition: any stream item or
+                # finish notifies it, so wakeups track real progress
+                with rt._gen_cond:
+                    rt._gen_cond.wait(timeout=0.05)
+
+    def _push(self, st, value):
+        loop, q = st["loop"], st["q"]
+
+        def put():
+            try:
+                q.put_nowait(value)
+            except asyncio.QueueFull:  # pragma: no cover - inflight accounting prevents this
+                pass
+            finally:
+                with st["cnt_lock"]:
+                    st["inflight"] -= 1
+
+        with st["cnt_lock"]:
+            st["inflight"] += 1
+        try:
+            loop.call_soon_threadsafe(put)
+        except RuntimeError:
+            with st["cnt_lock"]:
+                st["inflight"] -= 1
+            st["dead"] = True  # loop closed (proxy shutdown)
+
+
+_pump = _StreamPump()
+
+
+async def aiter_stream(gen_response, item_timeout_s: float | None = None):
+    """Async-iterate a DeploymentResponseGenerator through the shared
+    pump; cancels the producer on early exit (client disconnect)."""
+    loop = asyncio.get_running_loop()
+    sid, q = _pump.register(gen_response._gen, loop)
+    try:
+        while True:
+            item = await asyncio.wait_for(q.get(), timeout=item_timeout_s)
+            if item is _SENTINEL:
+                gen_response._exhausted = True
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    except asyncio.TimeoutError:
+        raise GetTimeoutError(f"stream item exceeded {item_timeout_s}s") from None
+    finally:
+        _pump.unregister(sid)
+        gen_response._settle()

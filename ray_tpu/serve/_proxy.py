@@ -39,25 +39,22 @@ class Request:
         return self.body.decode()
 
 
-class HTTPProxy:
-    def __init__(self, controller, http_options):
+class RouteTableMixin:
+    """Controller route table shared by the sync and async proxies: cached
+    refresh (one controller round-trip per interval; forced refreshes on
+    route miss are rate-limited too, or a 404 scanner would reintroduce a
+    controller RTT per request) + longest-prefix match."""
+
+    def _init_routes(self, controller):
         self._controller = controller
-        self._opts = http_options
         self._routes: dict[str, DeploymentHandle] = {}
         self._routes_lock = threading.Lock()
-        self._server: ThreadingHTTPServer | None = None
-        self._stop = threading.Event()
-
-    # -- route table --
+        self._routes_at = 0.0
 
     def _refresh_routes(self, force: bool = False):
-        # cached: one controller round-trip per interval, not per request
-        # (reference: proxy long-polls the route table). Forced refreshes
-        # (route misses) are rate-limited too, or a 404 scanner would
-        # reintroduce a controller RTT per request.
         now = time.time()
         interval = 0.25 if force else 1.0
-        if now - getattr(self, "_routes_at", 0.0) < interval:
+        if now - self._routes_at < interval:
             return
         self._routes_at = now
         apps = ray_tpu.get(self._controller.list_applications.remote())
@@ -79,6 +76,14 @@ class HTTPProxy:
                 if (path == p or path.startswith(p + "/") or prefix == "/") and len(prefix) > len(best_prefix):
                     best, best_prefix = handle, prefix
             return best, best_prefix
+
+
+class HTTPProxy(RouteTableMixin):
+    def __init__(self, controller, http_options):
+        self._init_routes(controller)
+        self._opts = http_options
+        self._server: ThreadingHTTPServer | None = None
+        self._stop = threading.Event()
 
     # -- server --
 

@@ -52,9 +52,12 @@ def start(http_options: HTTPOptions | None = None, proxy: bool = False, grpc_por
 def _ensure_proxy(controller, http_options: HTTPOptions):
     global _http_proxy
     if _http_proxy is None:
-        from ray_tpu.serve._proxy import HTTPProxy
+        if getattr(http_options, "async_proxy", True):
+            from ray_tpu.serve._async_proxy import AsyncHTTPProxy as _Proxy
+        else:
+            from ray_tpu.serve._proxy import HTTPProxy as _Proxy
 
-        _http_proxy = HTTPProxy(controller, http_options)
+        _http_proxy = _Proxy(controller, http_options)
         _http_proxy.start()
     return _http_proxy
 

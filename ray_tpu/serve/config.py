@@ -75,3 +75,8 @@ class HTTPOptions:
     # and cancels the replica task (reference: request_timeout_s in
     # HTTPOptions, proxy timeout -> cancellation)
     request_timeout_s: float = 60.0
+    # asyncio ingress (serve/_async_proxy.py): keep-alive + streaming
+    # backpressure with O(1) threads, like the reference's uvicorn proxy
+    # (serve/_private/proxy.py). False falls back to the stdlib
+    # thread-per-connection server (serve/_proxy.py).
+    async_proxy: bool = True

@@ -134,7 +134,7 @@ class LLMServer:
             raise RuntimeError(f"llm stepper died:\n{self._stepper_error}")
         params = SamplingParams(**(sampling_params or {}))
         ev = threading.Event()
-        rid = self.engine.add_request(list(prompt_token_ids), params)
+        rid = self._admit(list(prompt_token_ids), params)
         with self._lock:
             if rid in self._done:  # finished before we registered (tiny prompts)
                 ev.set()
@@ -158,8 +158,17 @@ class LLMServer:
             "finish_reason": out.finish_reason,
         }
 
+    def _admit(self, prompt_token_ids, params) -> str:
+        """Admission seam: monolithic replicas prefill locally; the
+        disaggregated DecodeServer overrides this to source KV from a
+        prefill replica."""
+        return self.engine.add_request(prompt_token_ids, params)
+
     def batch_stats(self) -> dict:
         return {"running": self.engine.num_running, "waiting": self.engine.num_waiting}
+
+    def prefix_cache_stats(self) -> dict:
+        return self.engine.prefix_cache_stats()
 
     def __call__(self, request):
         """HTTP entry: POST {"prompt_token_ids": [...], "sampling_params": {...}}."""
@@ -285,6 +294,71 @@ class OpenAIServer(LLMServer):
                 {"id": rid, "object": obj, "model": self.model_id, "choices": [{"index": 0, key: content}]}
             ) + "\n\n"
         yield "data: [DONE]\n\n"
+
+
+class PrefillServer:
+    """Prefill-only replica for disaggregated serving (reference:
+    python/ray/llm/tests/serve/deployments/prefill_decode_disagg/ — the
+    vLLM KV-connector split; here the KV payload is host numpy arrays
+    that ride the shm object plane between replicas)."""
+
+    def __init__(self, llm_config: LLMConfig):
+        from ray_tpu.llm import LLMEngine
+
+        cfg = llm_config.model_config
+        if cfg is None:
+            from ray_tpu.models.llama import LlamaConfig
+
+            cfg = LlamaConfig.tiny(dtype="float32")
+        kwargs = dict(llm_config.engine_kwargs)
+        kwargs.setdefault("enable_prefix_caching", False)  # prefill is stateless
+        self.engine = LLMEngine(cfg, params=llm_config.params, **kwargs)
+
+    def prefill(self, prompt_token_ids) -> dict:
+        return self.engine.prefill_remote(list(prompt_token_ids))
+
+    def check_health(self):
+        return True
+
+
+class DecodeServer(LLMServer):
+    """Decode replica fed by a separate prefill deployment: admission
+    fetches KV through the prefill handle, then continuous batching
+    decodes locally — prompt compute and token generation scale
+    independently (reference: prefill_decode_disagg test deployments)."""
+
+    def __init__(self, llm_config: LLMConfig, prefill_handle):
+        super().__init__(llm_config)
+        self.prefill_handle = prefill_handle
+
+    def _admit(self, prompt_token_ids, params) -> str:
+        kv = self.prefill_handle.prefill.remote(prompt_token_ids).result(timeout_s=180.0)
+        return self.engine.add_prefilled(kv, params)
+
+
+def build_pd_disagg_deployment(
+    llm_config: LLMConfig,
+    *,
+    num_prefill_replicas: int = 1,
+    num_decode_replicas: int = 1,
+    name: str = "LLM",
+):
+    """-> Application: decode ingress backed by a prefill deployment
+    (reference: prefill_decode_disagg serve graph). Call .generate on the
+    returned handle exactly like the monolithic deployment."""
+    from ray_tpu import serve
+
+    health = {"health_check_timeout_s": 180.0, "health_check_period_s": 2.0}
+    prefill_app = serve.deployment(
+        name=f"{name}-prefill", num_replicas=num_prefill_replicas, **health
+    )(PrefillServer).bind(llm_config)
+    decode_dep = serve.deployment(
+        name=f"{name}-decode",
+        num_replicas=num_decode_replicas,
+        max_ongoing_requests=llm_config.max_ongoing_requests,
+        **health,
+    )(DecodeServer)
+    return decode_dep.bind(llm_config, prefill_app)
 
 
 def _build_app(llm_config: LLMConfig, cls, name: str):

@@ -1,0 +1,139 @@
+"""GKE/TPU slice provider + autoscaler slice elasticity.
+
+Reference test strategy: python/ray/autoscaler/batching_node_provider.py
+(kuberay TPU slice scaling) and autoscaler/_private/gcp tests — here the
+REST surface is a fake that boots real node-agent processes, and the
+assertions are end-to-end: pending slice reservation -> slice node pool
+created atomically -> gang PG becomes ready -> release -> idle timeout
+-> whole slice torn down through the API.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler.autoscaler import Autoscaler
+from ray_tpu.autoscaler.gke import GKETPUNodeProvider, slice_node_type
+from ray_tpu.core import context
+from ray_tpu.util.tpu import SlicePlacementGroup, simulate_tpu_slice_nodes
+
+
+class FakeGKEAPI:
+    """Stands in for container.googleapis.com: create_tpu_node_pool
+    "boots VMs" by registering node agents shaped like the slice."""
+
+    def __init__(self, client):
+        self.client = client
+        self.pools: dict = {}
+        self.create_calls = 0
+        self.delete_calls = 0
+
+    def create_tpu_node_pool(self, name, pod_type, labels):
+        self.create_calls += 1
+        nodes = simulate_tpu_slice_nodes(self.client, pod_type, name, num_cpus_per_host=4)
+        self.pools[name] = pod_type
+        return {"hosts": len(nodes)}
+
+    def delete_tpu_node_pool(self, name):
+        self.delete_calls += 1
+        self.pools.pop(name, None)
+
+    def list_tpu_node_pools(self):
+        return dict(self.pools)
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    client = ray_tpu.init(num_cpus=2)
+    yield client
+    ray_tpu.shutdown()
+
+
+def test_slice_scale_up_on_gang_demand_and_down_on_idle(rt):
+    client = context.get_client()
+    api = FakeGKEAPI(client)
+    provider = GKETPUNodeProvider(client, api)
+    scaler = Autoscaler(
+        client,
+        [slice_node_type("v5litepod-16", num_cpus_per_host=4, max_slices=2)],
+        provider=provider,
+        idle_timeout_s=1.5,
+        interval_s=0.2,
+    ).start()
+    try:
+        # a gang reservation for a whole slice: its head resource exists on
+        # NO current node -> pending PG demand -> the autoscaler must
+        # provision a slice (ALL 4 hosts atomically), not individual
+        # hosts. The constructor blocks until the head resource appears.
+        spg = SlicePlacementGroup(topology="4x4", accelerator_version="v5e", timeout_s=120)
+        assert spg.wait(timeout_seconds=120), "slice PG never became ready"
+        assert api.create_calls == 1
+        assert len(api.pools) == 1
+        slice_nodes = [
+            n for n in client.node_list() if n.labels.get("ray_tpu.io/tpu-slice-name", "").startswith("tpu-v5litepod-16")
+        ]
+        assert len(slice_nodes) == 4  # v5litepod-16 = 4 hosts x 4 chips
+        # the gang PG holds the slice: no scale-down while reserved
+        time.sleep(3.0)
+        assert api.delete_calls == 0
+
+        # release -> idle timeout -> the WHOLE slice is torn down via the API
+        spg.remove()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and api.delete_calls == 0:
+            time.sleep(0.25)
+        assert api.delete_calls == 1
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            left = [n for n in client.node_list() if n.labels.get("ray_tpu.io/tpu-slice-name")]
+            if not left:
+                break
+            time.sleep(0.25)
+        assert not left, f"slice hosts survived teardown: {[n.node_id.hex()[:8] for n in left]}"
+    finally:
+        scaler.stop()
+
+
+def test_two_slices_scale_independently(rt):
+    client = context.get_client()
+    api = FakeGKEAPI(client)
+    provider = GKETPUNodeProvider(client, api)
+    scaler = Autoscaler(
+        client,
+        [slice_node_type("v5litepod-8", num_cpus_per_host=4, max_slices=2)],
+        provider=provider,
+        idle_timeout_s=30.0,
+        interval_s=0.2,
+    ).start()
+    try:
+        a = SlicePlacementGroup(topology="2x4", accelerator_version="v5e", timeout_s=90)
+        b = SlicePlacementGroup(topology="2x4", accelerator_version="v5e", timeout_s=120)
+        assert a.wait(timeout_seconds=90) and b.wait(timeout_seconds=120)
+        assert api.create_calls == 2 and len(api.pools) == 2
+        assert a.slice_name != b.slice_name
+    finally:
+        scaler.stop()
+
+
+def test_max_slices_cap(rt):
+    client = context.get_client()
+    api = FakeGKEAPI(client)
+    provider = GKETPUNodeProvider(client, api)
+    scaler = Autoscaler(
+        client,
+        [slice_node_type("v5litepod-8", num_cpus_per_host=4, max_slices=1)],
+        provider=provider,
+        idle_timeout_s=30.0,
+        interval_s=0.2,
+    ).start()
+    try:
+        a = SlicePlacementGroup(topology="2x4", accelerator_version="v5e", timeout_s=90)
+        assert a.wait(timeout_seconds=90)
+        with pytest.raises(TimeoutError):
+            # capped at 1 slice: the second reservation can never provision
+            SlicePlacementGroup(topology="2x4", accelerator_version="v5e", timeout_s=6)
+        assert api.create_calls == 1
+    finally:
+        scaler.stop()

@@ -334,12 +334,48 @@ def test_interleaved_pipeline_parity_and_training():
         pp_forward(pp_params, tokens, cfg, mesh, num_microbatches=1, virtual_stages=v)
 
 
+def test_pp_sp_ring_attention_parity():
+    """pp x sp composition: ONE shard_map region manual over {pp, sp}
+    runs ring attention inside each pipeline stage (pipeline_apply
+    sp_axis). Forward logits and layer grads match the unsharded model
+    exactly — the config the reference cannot express at all (it has no
+    sequence parallelism, SURVEY.md §5.7)."""
+    from ray_tpu.parallel.pipeline import (
+        from_stage_stacked,
+        pp_forward,
+        pp_loss_fn,
+        to_stage_stacked,
+    )
+
+    cfg = LlamaConfig.tiny(num_layers=4, dtype="float32", max_seq_len=64)
+    mesh = create_mesh(pp=2, sp=2, dp=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    pp_params = {**params, "layers": to_stage_stacked(params["layers"], 2)}
+    rng = np.random.default_rng(3)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 64)), jnp.int32)
+    batch = {"tokens": tokens, "targets": tokens}
+
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(lambda p, t: pp_forward(p, t, cfg, mesh, num_microbatches=4))(pp_params, tokens)),
+        np.asarray(forward(params, tokens, cfg)),
+        atol=2e-4,
+    )
+    g_ref = jax.grad(lambda p: loss_fn(p, batch, cfg))(params)
+    g_pp = jax.jit(jax.grad(lambda p: pp_loss_fn(p, batch, cfg, mesh, num_microbatches=4)))(pp_params)
+    g_pp = {**g_pp, "layers": from_stage_stacked(g_pp["layers"])}
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4),
+        g_ref,
+        g_pp,
+    )
+
+
 def test_pp_tp_long_sequence_head_sharded_attention():
-    """pp x sp is unsupported (ring attention owns its own manual region);
-    the documented fallback for long sequences in pipelined configs is
-    head sharding over tp (Ulysses-style resharding is what GSPMD inserts
-    for the sharded attention). End-to-end: a pp=2 x tp=2 x dp=2 train
-    step at a long-for-tests sequence length runs and converges."""
+    """Head sharding over tp remains an alternative to pp x sp for long
+    sequences in pipelined configs (Ulysses-style resharding is what
+    GSPMD inserts for the sharded attention). End-to-end: a pp=2 x tp=2
+    x dp=2 train step at a long-for-tests sequence length runs and
+    converges."""
     import optax
 
     from ray_tpu.parallel.pipeline import pp_init_params, pp_loss_fn, pp_param_logical_axes
