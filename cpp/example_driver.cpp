@@ -1,0 +1,50 @@
+// Minimal C++ driver against a ray_tpu cluster (see ray_tpu_client.hpp).
+//
+// Usage: example_driver <host> <port> <authkey_hex>
+// Exercises Put/Get round-trip and a cross-language task Call; prints
+// CPP_DRIVER_OK on success (the integration test greps for it).
+
+#include <cstdio>
+#include <cstdlib>
+
+#include "ray_tpu_client.hpp"
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    std::fprintf(stderr, "usage: %s <host> <port> <authkey_hex>\n", argv[0]);
+    return 2;
+  }
+  try {
+    ray_tpu::Client c(argv[1], std::atoi(argv[2]), argv[3]);
+
+    // object plane round trip
+    auto id = c.Put("hello from c++");
+    auto val = c.Get(id);
+    if (val != "hello from c++") {
+      std::fprintf(stderr, "Get mismatch: %s\n", val.c_str());
+      return 1;
+    }
+
+    // cross-language task: python-side @xlang.export("double_it")
+    auto rid = c.Call("double_it", "21");
+    auto out = c.Get(rid, 120.0);
+    if (out != "42") {
+      std::fprintf(stderr, "Call result mismatch: %s\n", out.c_str());
+      return 1;
+    }
+
+    // structured result: python returns a dict -> compact JSON here
+    auto sid = c.Call("describe", "tensor");
+    auto desc = c.Get(sid, 120.0);
+    if (desc.find("\"name\":\"tensor\"") == std::string::npos) {
+      std::fprintf(stderr, "JSON result mismatch: %s\n", desc.c_str());
+      return 1;
+    }
+
+    std::printf("CPP_DRIVER_OK %s\n", desc.c_str());
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "driver failed: %s\n", e.what());
+    return 1;
+  }
+}

@@ -1,0 +1,260 @@
+// ray_tpu C++ driver client.
+//
+// Reference parity: /root/reference/cpp/ (the C++ worker API). TPU-native
+// redesign: instead of binding the core worker into C++ (the reference
+// links a full core-worker library), this is a ~400-line header-only
+// client for the head's language-neutral xlang endpoint
+// (ray_tpu/core/xlang.py): HMAC-SHA256 challenge/response auth, then
+// length-prefixed frames carrying Put/Get/Call. Cluster-side semantics
+// (scheduling, retries, lineage) are identical to Python tasks because
+// Call() invokes a registered function as a normal cluster task.
+//
+//   ray_tpu::Client c("127.0.0.1", port, authkey_hex);
+//   auto id  = c.Put("hello");                 // 20-byte object id
+//   auto val = c.Get(id);                      // "hello"
+//   auto rid = c.Call("double_it", "21");      // python-side task
+//   auto out = c.Get(rid, /*timeout_s=*/60);   // "42"
+//
+// No dependencies beyond POSIX sockets; SHA-256/HMAC implemented inline.
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ray_tpu {
+
+// ------------------------------------------------------------------ sha256
+namespace detail {
+
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t len = 0;
+  uint8_t buf[64];
+  size_t buf_n = 0;
+
+  Sha256() {
+    static const uint32_t init[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                     0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                     0x1f83d9ab, 0x5be0cd19};
+    std::memcpy(h, init, sizeof(h));
+  }
+
+  static uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+  void block(const uint8_t* p) {
+    static const uint32_t K[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+        0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+        0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+        0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+        0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+        0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+        0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+        0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+        0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + mj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const uint8_t* p, size_t n) {
+    len += n;
+    while (n) {
+      size_t take = std::min(n, sizeof(buf) - buf_n);
+      std::memcpy(buf + buf_n, p, take);
+      buf_n += take; p += take; n -= take;
+      if (buf_n == 64) { block(buf); buf_n = 0; }
+    }
+  }
+
+  void final(uint8_t out[32]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t zero = 0;
+    while (buf_n != 56) update(&zero, 1);
+    uint8_t lb[8];
+    for (int i = 0; i < 8; i++) lb[i] = uint8_t(bits >> (56 - 8 * i));
+    update(lb, 8);
+    for (int i = 0; i < 8; i++) {
+      out[4 * i] = uint8_t(h[i] >> 24); out[4 * i + 1] = uint8_t(h[i] >> 16);
+      out[4 * i + 2] = uint8_t(h[i] >> 8); out[4 * i + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+inline void hmac_sha256(const std::string& key, const std::string& msg,
+                        uint8_t out[32]) {
+  uint8_t k[64] = {0};
+  if (key.size() > 64) {
+    Sha256 s; s.update((const uint8_t*)key.data(), key.size()); s.final(k);
+  } else {
+    std::memcpy(k, key.data(), key.size());
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; i++) { ipad[i] = k[i] ^ 0x36; opad[i] = k[i] ^ 0x5c; }
+  uint8_t inner[32];
+  Sha256 si;
+  si.update(ipad, 64);
+  si.update((const uint8_t*)msg.data(), msg.size());
+  si.final(inner);
+  Sha256 so;
+  so.update(opad, 64);
+  so.update(inner, 32);
+  so.final(out);
+}
+
+inline std::string unhex(const std::string& hex) {
+  std::string out;
+  for (size_t i = 0; i + 1 < hex.size(); i += 2)
+    out.push_back(char(std::stoi(hex.substr(i, 2), nullptr, 16)));
+  return out;
+}
+
+}  // namespace detail
+
+// ------------------------------------------------------------------ client
+using ObjectId = std::string;  // 20 raw bytes
+
+class Client {
+ public:
+  Client(const std::string& host, int port, const std::string& authkey_hex) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("socket() failed");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(uint16_t(port));
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      throw std::runtime_error("bad host " + host);
+    if (::connect(fd_, (sockaddr*)&addr, sizeof(addr)) != 0)
+      throw std::runtime_error("connect failed to " + host);
+    // challenge/response auth (transport.py _auth_server)
+    std::string challenge = recv_frame();
+    uint8_t mac[32];
+    detail::hmac_sha256(detail::unhex(authkey_hex), challenge, mac);
+    send_frame(std::string((char*)mac, 32));
+    if (recv_frame() != "OK") throw std::runtime_error("auth rejected");
+  }
+
+  ~Client() { if (fd_ >= 0) ::close(fd_); }
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  ObjectId Put(const std::string& bytes) {
+    std::string req;
+    req.push_back(char(0x01));
+    req += bytes;
+    return expect_id(roundtrip(req));
+  }
+
+  std::string Get(const ObjectId& id, double timeout_s = 60.0) {
+    if (id.size() != 20) throw std::runtime_error("object id must be 20 bytes");
+    std::string req;
+    req.push_back(char(0x02));
+    req += id;
+    char t[8];
+    std::memcpy(t, &timeout_s, 8);  // little-endian hosts (x86/arm)
+    req.append(t, 8);
+    return roundtrip(req);
+  }
+
+  // Invoke a python function exported via xlang.export(name); payload is
+  // handed to it as bytes. Returns the result's object id (Get it).
+  ObjectId Call(const std::string& name, const std::string& payload) {
+    if (name.size() > 0xFFFF) throw std::runtime_error("name too long");
+    std::string req;
+    req.push_back(char(0x03));
+    uint16_t n = uint16_t(name.size());
+    char nl[2];
+    std::memcpy(nl, &n, 2);
+    req.append(nl, 2);
+    req += name;
+    req += payload;
+    return expect_id(roundtrip(req));
+  }
+
+ private:
+  int fd_ = -1;
+
+  void send_all(const char* p, size_t n) {
+    while (n) {
+      ssize_t w = ::send(fd_, p, n, 0);
+      if (w <= 0) throw std::runtime_error("send failed");
+      p += w; n -= size_t(w);
+    }
+  }
+
+  void recv_all(char* p, size_t n) {
+    while (n) {
+      ssize_t r = ::recv(fd_, p, n, 0);
+      if (r <= 0) throw std::runtime_error("connection closed");
+      p += r; n -= size_t(r);
+    }
+  }
+
+  // frames are LITTLE-endian u32 length-prefixed (transport.py _send_frame)
+  void send_frame(const std::string& data) {
+    uint32_t len = uint32_t(data.size());
+    char lb[4];
+    std::memcpy(lb, &len, 4);  // x86/arm little-endian hosts
+    send_all(lb, 4);
+    send_all(data.data(), data.size());
+  }
+
+  std::string recv_frame() {
+    char lb[4];
+    recv_all(lb, 4);
+    uint32_t len;
+    std::memcpy(&len, lb, 4);
+    if (len > (1u << 30)) throw std::runtime_error("oversized frame");
+    std::string out(len, '\0');
+    recv_all(out.data(), len);
+    return out;
+  }
+
+  std::string roundtrip(const std::string& req) {
+    send_frame(req);
+    std::string resp = recv_frame();
+    if (resp.empty()) throw std::runtime_error("empty response");
+    if (resp[0] != 0) throw std::runtime_error("cluster error: " + resp.substr(1));
+    return resp.substr(1);
+  }
+
+  static ObjectId expect_id(const std::string& body) {
+    if (body.size() != 20) throw std::runtime_error("expected 20-byte object id");
+    return body;
+  }
+};
+
+}  // namespace ray_tpu

@@ -183,6 +183,7 @@ class Autoscaler:
         self.upscaling_speed = max(1, upscaling_speed)
         self._managed: dict = {}  # node_id -> (type_name, launched_at)
         self._idle_since: dict = {}  # node_id -> ts
+        self._launching: dict = {}  # type_name -> in-flight launch count
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
@@ -222,6 +223,10 @@ class Autoscaler:
             counts: dict[str, int] = {t: 0 for t in self.node_types}
             for nid, (tname, _) in self._managed.items():
                 counts[tname] = counts.get(tname, 0) + 1
+            # launches dispatched to threads but not yet joined count too,
+            # or every reconcile tick would double-launch a slow provider
+            for tname, n in self._launching.items():
+                counts[tname] = counts.get(tname, 0) + n
 
             # demand = queued tasks + pending placement groups (gang/slice
             # reservations surface here, e.g. TPU-{pod}-head) + floors
@@ -230,7 +235,15 @@ class Autoscaler:
                 demand = demand + self.rt.pending_pg_demand()
             headroom = [dict(n.available) for n in nodes]
             launches: list[NodeTypeConfig] = []
-            planned: list[dict] = []
+            # capacity already being launched counts as planned headroom:
+            # demand that an in-flight (async) launch will satisfy must
+            # not provision AGAIN on the next reconcile tick
+            planned: list[dict] = [
+                dict(self.node_types[tname].resources)
+                for tname, n in self._launching.items()
+                if tname in self.node_types
+                for _ in range(n)
+            ]
 
             def try_place(req: dict) -> bool:
                 for h in headroom + planned:
@@ -259,19 +272,32 @@ class Autoscaler:
 
             to_launch = launches[: self.upscaling_speed]
 
-        # launch OUTSIDE the lock: a command provider can take minutes per
-        # node (ssh, VM boot) and must not block adopt()/status()/stop()
-        for t in to_launch:
-            if self._stopped.is_set():
-                return
+        # launch on DETACHED threads: a cloud provider can take minutes
+        # per node/slice (VM boot, GKE node-pool creation), and one slow
+        # create must not stall other scaling decisions, idle teardown,
+        # or the reconcile loop itself (reference: the autoscaler's
+        # concurrent NodeLauncher workers)
+        def _launch(t: NodeTypeConfig):
+            node = None
             try:
                 node = self.provider.create_node(t)
             except Exception as e:  # noqa: BLE001
                 logger.warning("autoscaler launch of %s failed: %s", t.name, e)
-                continue
+            # one lock section: the in-flight count converts to a managed
+            # entry atomically, so no reconcile pass sees neither
             with self._lock:
-                self._managed[node.node_id] = (t.name, time.monotonic())
-            logger.info("autoscaler launched node %s type=%s", node.node_id.hex()[:8], t.name)
+                self._launching[t.name] = max(0, self._launching.get(t.name, 0) - 1)
+                if node is not None:
+                    self._managed[node.node_id] = (t.name, time.monotonic())
+            if node is not None:
+                logger.info("autoscaler launched node %s type=%s", node.node_id.hex()[:8], t.name)
+
+        for t in to_launch:
+            if self._stopped.is_set():
+                return
+            with self._lock:
+                self._launching[t.name] = self._launching.get(t.name, 0) + 1
+            threading.Thread(target=_launch, args=(t,), daemon=True, name="rt-launch").start()
 
         with self._lock:
             nodes = self.rt.node_list()

@@ -28,6 +28,7 @@ class KVStore:
         from ray_tpu.core.table_store import InMemoryTableStore
 
         self._lock = threading.Lock()
+        self._persist_lock = threading.Lock()  # see put(): ordered log appends
         self._data: dict[str, dict[bytes, bytes]] = defaultdict(dict)
         self._store = store or InMemoryTableStore()
         # re-hydrate from a persistent backend. Keys/values are arbitrary
@@ -50,27 +51,45 @@ class KVStore:
     def put(self, key: bytes, value: bytes, overwrite: bool = True, namespace: str = "default") -> bool:
         import pickle
 
+        # persist OUTSIDE the KV lock: with gcs_persist_path set, the
+        # table-store append fsyncs per record, and holding _lock across
+        # that would serialize every head KV read behind disk latency.
+        # _persist_lock is chained (acquired under _lock, released after
+        # the append) so log order always matches memory order; with >1
+        # concurrent WRITER this degenerates to the old serialization
+        # (the second writer waits inside _lock), but the common
+        # single-writer case frees readers entirely.
         with self._lock:
             ns = self._data[namespace]
             if not overwrite and key in ns:
                 return False
             ns[key] = value
-            try:
-                self._store.put("kv", self._skey(namespace, key), pickle.dumps(value))
-            except Exception:
-                pass  # unpicklable value: kept in memory only
-            return True
+            self._persist_lock.acquire()
+        try:
+            self._store.put("kv", self._skey(namespace, key), pickle.dumps(value))
+        except Exception:
+            pass  # unpicklable value: kept in memory only
+        finally:
+            self._persist_lock.release()
+        return True
 
     def get(self, key: bytes, namespace: str = "default") -> bytes | None:
         with self._lock:
             return self._data[namespace].get(key)
 
     def delete(self, key: bytes, namespace: str = "default") -> bool:
+        # same chained ordering as put(): a racing put's append must not
+        # land AFTER this tombstone and resurrect the key on restart
         with self._lock:
             existed = self._data[namespace].pop(key, None) is not None
-            if existed:
-                self._store.delete("kv", self._skey(namespace, key))
-            return existed
+            if not existed:
+                return False
+            self._persist_lock.acquire()
+        try:
+            self._store.delete("kv", self._skey(namespace, key))
+        finally:
+            self._persist_lock.release()
+        return True
 
     def exists(self, key: bytes, namespace: str = "default") -> bool:
         with self._lock:

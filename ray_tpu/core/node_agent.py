@@ -147,6 +147,21 @@ def agent_entry(
     GCS client reconnect backoff, test_gcs_fault_tolerance.py)."""
     import multiprocessing as mp
 
+    # The agent was itself spawned through the HEAD's forkserver, so the
+    # multiprocessing singletons it inherited (forkserver address,
+    # resource-tracker fd) point at the HEAD's helpers. Without a reset,
+    # the agent would spawn workers through the head's forkserver AND —
+    # fatally — its drain-path stop_forkserver() would shut down the
+    # head's forkserver and unlink its socket, wedging every later spawn
+    # in the head (elastic regrow after a node removal hit exactly this).
+    try:
+        from multiprocessing import forkserver as _fs, resource_tracker as _rt
+
+        _fs._forkserver = _fs.ForkServer()
+        _rt._resource_tracker = _rt.ResourceTracker()
+    except Exception:
+        pass
+
     if env:
         os.environ.update({k: str(v) for k, v in env.items()})
 

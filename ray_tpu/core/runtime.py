@@ -199,6 +199,7 @@ class Runtime:
         self._local_fn_cache: dict[str, object] = {}
         self._done_callbacks: dict[ObjectID, list] = {}
         self._dc_lock = threading.Lock()
+        self._stack_pending: dict[str, tuple] = {}  # req_id -> (Event, results)
         # reference counting (reference: reference_counter.h): remote
         # holders per object + pins from live task specs' args. The head
         # process's own refs are covered by object_ref's local registry.
@@ -525,6 +526,51 @@ class Runtime:
     def free_objects(self, obj_ids):
         for oid in obj_ids:
             self.store.delete(oid)
+
+    def dump_worker_stacks(self, worker_prefix: str = "", timeout: float = 10.0) -> dict:
+        """Live Python stacks of every (matching) worker — the on-demand
+        profiling attach (reference capability: dashboard/modules/
+        reporter/profile_manager.py:82 py-spy dump on live workers;
+        dependency-free here: workers self-report via sys._current_frames
+        on their always-free recv loop). Returns {worker_id_hex: {pid,
+        current_task, stacks: {thread: stack}}}; unresponsive workers are
+        reported with an 'unresponsive' marker instead of hanging the
+        call."""
+        import uuid
+
+        req_id = uuid.uuid4().hex[:12]
+        ev = threading.Event()
+        results: dict = {}
+        targets = []
+        for node in self.node_list():
+            for w in node.workers.values():
+                whex = w.worker_id.hex()
+                if worker_prefix and not whex.startswith(worker_prefix):
+                    continue
+                if w.state in ("starting", "dead", "retiring"):
+                    continue
+                targets.append((w, whex))
+        if not targets:
+            return {}
+        with self._dc_lock:
+            self._stack_pending[req_id] = (ev, results)
+        try:
+            for w, _ in targets:
+                try:
+                    w.send({"type": "stack_dump", "req_id": req_id})
+                except Exception:
+                    pass
+            deadline = time.monotonic() + timeout
+            while len(results) < len(targets) and time.monotonic() < deadline:
+                ev.wait(timeout=0.2)
+                ev.clear()
+        finally:
+            with self._dc_lock:
+                self._stack_pending.pop(req_id, None)
+        for _, whex in targets:
+            if whex not in results:
+                results[whex] = {"unresponsive": True, "stacks": {}}
+        return results
 
     def object_locations(self, obj_ids) -> dict:
         """Primary-copy node per object (reference:
@@ -1603,7 +1649,11 @@ class Runtime:
                     node.agent_send({"type": "ping", "seq": node.ping_seq})
 
     def _handle_worker_msg(self, node: Node, w: WorkerHandle, msg: dict):
+        from ray_tpu.core import rpc_chaos
+
         t = msg["type"]
+        if not rpc_chaos.apply(t):
+            return  # chaos: per-message-type fault injection (done, stream_item, ...)
         if t == "ready":
             if w.state == "starting":
                 w.state = "idle"
@@ -1623,6 +1673,16 @@ class Runtime:
         elif t == "ref_events":
             # ordered with this worker's done messages (same pipe)
             self.on_ref_events(w.worker_id.hex(), [(bytes.fromhex(h), reg) for h, reg in msg["events"]])
+        elif t == "stack_dump_result":
+            with self._dc_lock:
+                slot = self._stack_pending.get(msg.get("req_id"))
+            if slot is not None:
+                slot[1][w.worker_id.hex()] = {
+                    "pid": msg.get("pid"),
+                    "current_task": msg.get("current_task"),
+                    "stacks": msg.get("stacks", {}),
+                }
+                slot[0].set()
         elif t == "pong":
             pass
 

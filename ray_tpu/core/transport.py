@@ -24,9 +24,10 @@ import os
 import socket
 import struct
 import threading
+import time
 
 _STATS_LOCK = threading.Lock()
-STATS = {"pulls": 0, "pull_bytes": 0, "serves": 0, "serve_bytes": 0, "pull_errors": 0}
+STATS = {"pulls": 0, "pull_bytes": 0, "serves": 0, "serve_bytes": 0, "pull_errors": 0, "pull_retries": 0}
 
 
 def _bump(key: str, n: int = 1):
@@ -100,9 +101,17 @@ class ObjectTransferServer:
     other nodes, so a cross-host agent advertises the interface it reaches
     the head on, not the bind wildcard."""
 
-    def __init__(self, authkey: bytes, host: str = "0.0.0.0", advertise_host: str = "127.0.0.1", chunk_bytes: int = 1 << 20):
+    def __init__(self, authkey: bytes, host: str = "0.0.0.0", advertise_host: str = "127.0.0.1", chunk_bytes: int = 1 << 20, allowed_prefixes: tuple | None = None):
         self.authkey = authkey
         self.chunk_bytes = chunk_bytes
+        # only serve THIS node's namespaces: an authenticated peer must not
+        # be able to read /dev/shm segments of other sessions/clusters on
+        # the same host (default: the process's own session tag)
+        if allowed_prefixes is None:
+            from ray_tpu.core.object_store import _session_tag
+
+            allowed_prefixes = (f"rt{_session_tag()}_",)
+        self.allowed_prefixes = tuple(allowed_prefixes)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, 0))
@@ -128,7 +137,7 @@ class ObjectTransferServer:
             if not req.startswith(b"PULL"):
                 raise ConnectionError(f"bad transfer op {req[:8]!r}")
             name = req[4:].decode()
-            if "/" in name or not name.startswith("rt"):
+            if "/" in name or not name.startswith(self.allowed_prefixes):
                 raise ConnectionError("illegal segment name")
             path = "/dev/shm/" + name
             try:
@@ -138,10 +147,14 @@ class ObjectTransferServer:
                 _send_frame(conn, b"not found")
                 return
             with f:
+                from ray_tpu.core import rpc_chaos
+
                 size = os.fstat(f.fileno()).st_size
                 conn.sendall(struct.pack("<Q", size))
                 sent = 0
                 while sent < size:
+                    if not rpc_chaos.apply("transfer_chunk"):
+                        raise ConnectionError("chaos: transfer aborted mid-stream")
                     chunk = f.read(min(self.chunk_bytes, size - sent))
                     if not chunk:
                         break
@@ -165,13 +178,35 @@ class ObjectTransferServer:
             pass
 
 
-def pull_segment(addr, authkey: bytes, src_name: str, dst_name: str, timeout: float = 60.0) -> int:
+def pull_segment(addr, authkey: bytes, src_name: str, dst_name: str, timeout: float = 60.0, retries: int = 2) -> int:
     """Pull segment ``src_name`` from the transfer server at ``addr`` and
     install it atomically as /dev/shm/``dst_name``. Returns byte count.
-    Raises FileNotFoundError if the peer no longer has the segment (callers
-    treat that as object-lost and fall back to lineage reconstruction)."""
+
+    Transient transport failures (reset, truncation, timeout) RETRY with
+    backoff before surfacing: a network blip on a large expensive block
+    must not force a full lineage recompute. Only an authoritative
+    peer-side not-found — or exhausted retries — raises FileNotFoundError
+    (which callers treat as object-lost -> reconstruction)."""
     if os.path.exists("/dev/shm/" + dst_name):
         return os.path.getsize("/dev/shm/" + dst_name)
+    last: Exception | None = None
+    for attempt in range(retries + 1):
+        try:
+            return _pull_once(addr, authkey, src_name, dst_name, timeout)
+        except FileNotFoundError:
+            raise  # peer says gone: retrying cannot help
+        except (ConnectionError, socket.timeout, OSError) as e:
+            _bump("pull_errors")
+            last = e
+            if attempt < retries:
+                _bump("pull_retries")
+                time.sleep(0.1 * (attempt + 1))
+    raise FileNotFoundError(
+        f"pull of {src_name} from {addr} failed after {retries + 1} attempts: {last}"
+    ) from None
+
+
+def _pull_once(addr, authkey: bytes, src_name: str, dst_name: str, timeout: float) -> int:
     sock = socket.create_connection(tuple(addr), timeout=timeout)
     tmp = f"/dev/shm/{dst_name}.t{os.getpid()}.{threading.get_ident()}"
     try:
@@ -195,11 +230,6 @@ def pull_segment(addr, authkey: bytes, src_name: str, dst_name: str, timeout: fl
         _bump("pulls")
         _bump("pull_bytes", got)
         return got
-    except (ConnectionError, socket.timeout, OSError) as e:
-        _bump("pull_errors")
-        if isinstance(e, FileNotFoundError):
-            raise
-        raise FileNotFoundError(f"pull of {src_name} from {addr} failed: {e}") from None
     finally:
         try:
             os.unlink(tmp)

@@ -217,7 +217,20 @@ class WorkerClient:
         def one(a):
             if a.ref is not None:
                 return self.get_object(a.ref)
-            v, seg = decode_payload(a.payload, zero_copy=True)
+            try:
+                v, seg = decode_payload(a.payload, zero_copy=True)
+            except FileNotFoundError:
+                shm = getattr(a.payload, "shm", None)
+                if shm is None:
+                    raise
+                # the head resolved a ref into this descriptor but the
+                # bytes became unpullable (transfer failures past the
+                # retry budget, eviction race): recover the object id
+                # from the segment name and go through the owner-mediated
+                # get path, which re-pulls or reconstructs via lineage
+                from ray_tpu.core.ids import ObjectID as _OID
+
+                return self.get_object(_OID.from_hex(shm.shm_name.rsplit("_", 1)[-1]))
             if seg is not None:
                 segs.append(seg)
             return v
@@ -514,11 +527,39 @@ class WorkerClient:
                 break
             elif t == "ping":
                 self._send({"type": "pong"})
+            elif t == "stack_dump":
+                # on-demand profiling attach (reference capability:
+                # dashboard/modules/reporter/profile_manager.py py-spy
+                # attach — here dependency-free): the recv loop is free
+                # even while exec threads run user code, so live stacks
+                # of a busy/stuck worker always come back
+                self._send(
+                    {
+                        "type": "stack_dump_result",
+                        "req_id": msg.get("req_id"),
+                        "stacks": _format_all_stacks(),
+                        "pid": os.getpid(),
+                        "current_task": self.current_task_id.hex() if self.current_task_id else None,
+                    }
+                )
         try:
             self._exec_pool.shutdown(wait=False, cancel_futures=True)
         except Exception:
             pass
         os._exit(0)
+
+
+def _format_all_stacks() -> dict:
+    """{thread name: formatted stack} for every live thread."""
+    import sys
+    import traceback
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for ident, frame in sys._current_frames().items():
+        key = f"{names.get(ident, 'unknown')} ({ident})"
+        out[key] = "".join(traceback.format_stack(frame))
+    return out
 
 
 def _drain_async_gen(loop, agen):

@@ -1,0 +1,184 @@
+"""Cross-language driver protocol: non-Python clients on the cluster.
+
+Reference parity: the C++/Java worker APIs (/root/reference/cpp/,
+/root/reference/java/) let other languages drive a cluster. TPU-native
+redesign: instead of per-language core-worker bindings (Cython/JNI around
+the C++ core), the head exposes ONE language-neutral TCP endpoint whose
+wire format needs nothing but sockets and HMAC-SHA256 on the client side
+— the C++ client under /root/repo/cpp/ is a single ~400-line file with
+zero dependencies, and any other language can speak the same frames.
+
+Protocol (after the transport-layer challenge/response auth, shared with
+the object-transfer service):
+
+    request  frame: [op u8][body]
+    response frame: [status u8][body]     status 0 = ok, 1 = error(utf8)
+
+    PUT  (0x01) body = raw bytes             -> ok body = object id (20B)
+    GET  (0x02) body = [id 20B][timeout f64] -> ok body = value bytes
+    CALL (0x03) body = [u16 name_len][name][payload]
+                                             -> ok body = object id (20B)
+
+Semantics: PUT stores the raw bytes as a bytes object. CALL invokes a
+head-registered Python function (``@xlang.export("name")``) as a normal
+cluster task with the payload bytes as its single argument — placement,
+retries, and lineage all apply. GET fetches any object: bytes pass
+through raw; str encodes utf-8; anything else returns compact JSON, so
+structured results cross the language boundary without pickle.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+
+from ray_tpu.core.transport import _auth_server, _recv_exact, _send_frame
+
+OP_PUT = 0x01
+OP_GET = 0x02
+OP_CALL = 0x03
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    """Like transport._recv_frame but with a 1 GiB cap — xlang payloads
+    (PUT/GET values) are data, not control messages."""
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if n > 1 << 30:
+        raise ConnectionError("oversized xlang frame")
+    return _recv_exact(sock, n)
+
+
+def _to_wire_bytes(value) -> bytes:
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return bytes(value)
+    if isinstance(value, str):
+        return value.encode()
+    return json.dumps(value, separators=(",", ":")).encode()
+
+
+class XLangServer:
+    """Head-side endpoint serving cross-language drivers."""
+
+    def __init__(self, runtime, host: str = "0.0.0.0", port: int = 0, authkey: bytes | None = None):
+        import secrets
+
+        self.rt = runtime
+        self.authkey = authkey or secrets.token_bytes(16)
+        self._fns: dict[str, object] = {}  # name -> RemoteFunction
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True, name="rt-xlang")
+        self._thread.start()
+
+    def register(self, name: str, fn):
+        """Expose ``fn(payload: bytes)`` to cross-language CALLs."""
+        import ray_tpu
+
+        self._fns[name] = ray_tpu.remote(fn) if not hasattr(fn, "remote") else fn
+
+    def _accept_loop(self):
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        from ray_tpu.core.ids import ObjectID
+        from ray_tpu.core.object_ref import ObjectRef
+
+        try:
+            conn.settimeout(30.0)
+            _auth_server(conn, self.authkey)
+            conn.settimeout(None)  # keep-alive: many requests per connection
+            while True:
+                try:
+                    req = _recv_frame(conn)
+                except (ConnectionError, OSError):
+                    return
+                op, body = req[0], req[1:]
+                try:
+                    if op == OP_PUT:
+                        ref = self.rt.put_object(bytes(body))
+                        resp = bytes([0]) + ref.id.binary()
+                    elif op == OP_GET:
+                        oid = ObjectID(bytes(body[:20]))
+                        (timeout,) = struct.unpack("<d", body[20:28])
+                        value = self.rt.get_object(oid, timeout=timeout if timeout > 0 else None)
+                        resp = bytes([0]) + _to_wire_bytes(value)
+                    elif op == OP_CALL:
+                        (name_len,) = struct.unpack("<H", body[:2])
+                        name = body[2 : 2 + name_len].decode()
+                        payload = bytes(body[2 + name_len :])
+                        rf = self._fns.get(name)
+                        if rf is None:
+                            raise KeyError(f"no exported function {name!r} (xlang.export it on the head)")
+                        ref: ObjectRef = rf.remote(payload)
+                        # pin on behalf of the remote driver: the local
+                        # ObjectRef would otherwise free the result before
+                        # the client GETs it
+                        self._pinned = getattr(self, "_pinned", [])
+                        self._pinned.append(ref)
+                        if len(self._pinned) > 4096:
+                            del self._pinned[:2048]
+                        resp = bytes([0]) + ref.id.binary()
+                    else:
+                        raise ValueError(f"unknown xlang op {op:#x}")
+                except BaseException as e:  # noqa: BLE001
+                    resp = bytes([1]) + f"{type(e).__name__}: {e}".encode()
+                _send_frame(conn, resp)
+        except Exception:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def shutdown(self):
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------- public API
+_server: XLangServer | None = None
+
+
+def serve(port: int = 0, host: str = "0.0.0.0") -> dict:
+    """Start (or return) the head's cross-language endpoint. Returns
+    {host, port, authkey} — hand these to the C++/other-language driver."""
+    global _server
+    from ray_tpu.core import context
+
+    if _server is None:
+        _server = XLangServer(context.get_client(), host=host, port=port)
+    return {"host": "127.0.0.1" if host == "0.0.0.0" else host, "port": _server.port, "authkey": _server.authkey.hex()}
+
+
+def export(name: str):
+    """Decorator: expose a function to cross-language CALLs by name."""
+
+    def deco(fn):
+        if _server is None:
+            raise RuntimeError("call xlang.serve() before exporting functions")
+        _server.register(name, fn)
+        return fn
+
+    return deco
+
+
+def shutdown():
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server = None

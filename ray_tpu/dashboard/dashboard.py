@@ -114,6 +114,12 @@ class Dashboard:
 
                         jobs = _default_manager.list_jobs() if _default_manager else []
                         self._json([asdict(j) for j in jobs])
+                    elif path.startswith("/api/stacks"):
+                        # on-demand live stacks of (all|prefix) workers —
+                        # the py-spy-attach capability (reference:
+                        # dashboard/modules/reporter/profile_manager.py)
+                        prefix = path[len("/api/stacks"):].strip("/")
+                        self._json(c.dump_worker_stacks(prefix))
                     elif path == "/metrics":
                         from ray_tpu.util.metrics import export_prometheus
 

@@ -272,11 +272,13 @@ class ChannelCompiledDAG:
                 raise ValueError(f"compiled DAG takes {needed} inputs, got {len(input_args)}")
             import pickle as _pickle
 
+            from ray_tpu.experimental.channels import _HDR
+
             payloads = {}
             for name, idx in self._input_chans.items():
                 data = _pickle.dumps(input_args[idx], protocol=5)
                 w = self._writers[name]
-                if len(data) > w.slot_size - 8:
+                if len(data) > w.slot_size - _HDR.size:
                     raise ChannelFullError(
                         f"input {idx} is {len(data)} bytes, exceeds slot size {w.slot_size}; "
                         "raise experimental_compile(buffer_size_bytes=...)"

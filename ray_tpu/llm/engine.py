@@ -96,18 +96,23 @@ class PrefixCache:
         are verified token-for-token — a hash collision must never serve
         a foreign prompt's KV (the reference block cache exact-matches
         too)."""
-        n = ((len(prompt_token_ids) - 1) // self.block) * self.block
+        ids = tuple(int(t) for t in prompt_token_ids)  # tuple ONCE, slice per boundary
+        n = ((len(ids) - 1) // self.block) * self.block
         while n >= self.block:
-            prefix = tuple(int(t) for t in prompt_token_ids[:n])
-            hit = self._keys.get(hash(prefix))
-            if hit is not None and hit[2] == prefix:
-                gid, n_valid, _ = hit
-                k, v, _, _ = self._groups[gid]
-                self._order.remove(gid)
-                self._order.append(gid)
-                self.hits += 1
-                self.tokens_saved += n_valid
-                return k, v, n_valid
+            hit = self._keys.get(hash(ids[:n]))
+            if hit is not None:
+                gid, n_valid = hit
+                k, v, _, _, group_ids = self._groups[gid]
+                # token-for-token verification against the group's ONE
+                # stored tuple: a hash collision must never serve a
+                # foreign prompt's KV (the reference block cache
+                # exact-matches too)
+                if group_ids[:n_valid] == ids[:n_valid]:
+                    self._order.remove(gid)
+                    self._order.append(gid)
+                    self.hits += 1
+                    self.tokens_saved += n_valid
+                    return k, v, n_valid
             n -= self.block
         self.misses += 1
         return None
@@ -121,13 +126,15 @@ class PrefixCache:
         n_max = (len(prompt_token_ids) // self.block) * self.block
         if n_max < self.block:
             return
+        # ONE token tuple per group; boundary keys alias into it with
+        # their valid length (no O(n^2/block) host tuples — lookup
+        # verifies against slices of this single tuple)
         ids = tuple(int(t) for t in prompt_token_ids[:n_max])
         new_keys = []
         for n in range(self.block, n_max + 1, self.block):
-            prefix = ids[:n]
-            key = hash(prefix)
+            key = hash(ids[:n])
             if key not in self._keys:
-                new_keys.append((key, n, prefix))
+                new_keys.append((key, n))
         if not new_keys:
             return
         pad = _bucket(n_max, buckets)
@@ -140,15 +147,15 @@ class PrefixCache:
             self._evict_one()
         gid = self._next_gid
         self._next_gid += 1
-        self._groups[gid] = (k, v, nbytes, [key for key, _, _ in new_keys])
-        for key, n, prefix in new_keys:
-            self._keys[key] = (gid, n, prefix)
+        self._groups[gid] = (k, v, nbytes, [key for key, _ in new_keys], ids)
+        for key, n in new_keys:
+            self._keys[key] = (gid, n)
         self._order.append(gid)
         self._bytes += nbytes
 
     def _evict_one(self):
         gid = self._order.popleft()
-        _, _, nbytes, keys = self._groups.pop(gid)
+        _, _, nbytes, keys, _ = self._groups.pop(gid)
         for key in keys:
             self._keys.pop(key, None)
         self._bytes -= nbytes

@@ -61,6 +61,8 @@ class Algorithm:
             num_envs_per_env_runner=cfg.num_envs_per_env_runner,
             seed=cfg.seed,
             output=cfg.output,  # input_+output conflicts rejected above
+            env_to_module=cfg.env_to_module_connector,
+            module_to_env=cfg.module_to_env_connector,
         )
         from ray_tpu.rllib.core.learner import LearnerGroup
 

@@ -18,6 +18,10 @@ class AlgorithmConfig:
         self.num_env_runners: int = 0
         self.num_envs_per_env_runner: int = 1
         self.rollout_fragment_length: int = 200
+        # connector pipelines (rllib/connectors/; reference:
+        # config.env_runners(env_to_module_connector=...))
+        self.env_to_module_connector = None
+        self.module_to_env_connector = None
         # learners
         self.num_learners: int = 0
         # training
@@ -44,13 +48,17 @@ class AlgorithmConfig:
             self.env_config = dict(env_config)
         return self
 
-    def env_runners(self, *, num_env_runners: int | None = None, num_envs_per_env_runner: int | None = None, rollout_fragment_length: int | None = None):
+    def env_runners(self, *, num_env_runners: int | None = None, num_envs_per_env_runner: int | None = None, rollout_fragment_length: int | None = None, env_to_module_connector=None, module_to_env_connector=None):
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            self.module_to_env_connector = module_to_env_connector
         return self
 
     def learners(self, *, num_learners: int | None = None):

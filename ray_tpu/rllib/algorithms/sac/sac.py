@@ -1,0 +1,227 @@
+"""SAC: soft actor-critic for continuous control.
+
+Reference parity: rllib/algorithms/sac/sac.py:1 (SACConfig +
+training_step) with the loss structure of
+rllib/algorithms/sac/torch/sac_torch_learner.py — twin Q networks with
+polyak-averaged targets, a tanh-squashed gaussian policy, and
+automatically tuned entropy temperature (target entropy = -|A|).
+
+TPU-native shape: policy, twin critics, and log_alpha live in ONE param
+pytree; a single jitted grad computes all three losses with stop_gradient
+fencing (critic grads never reach pi, actor grads never reach the
+critics, alpha sees only the detached logp), so one optimizer step and
+one polyak map per update — no per-tower optimizer plumbing, and the
+whole update is one XLA program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.distributions import DiagGaussian, make_squashed_gaussian
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import MLPModule
+from ray_tpu.rllib.utils.replay_buffers import EpisodeReplayBuffer
+
+
+class SACModule(MLPModule):
+    """Policy tower (obs -> mean||log_std), twin Q towers (obs||act ->
+    scalar), and the entropy temperature. action_dist_inputs are the raw
+    gaussian params; the squashing lives in the distribution."""
+
+    def __init__(self, observation_space, action_space, model_config=None):
+        assert hasattr(action_space, "shape"), "SAC requires a continuous (Box) action space"
+        super().__init__(observation_space, action_space, model_config)
+        self.act_dim = int(np.prod(action_space.shape))
+        self.action_dist_cls = make_squashed_gaussian(action_space.low, action_space.high)
+
+    def init(self, key):
+        kp, k1, k2 = jax.random.split(key, 3)
+        qs = (self.obs_dim + self.act_dim, *self.hiddens, 1)
+        return {
+            "pi": self._mlp_init(kp, (self.obs_dim, *self.hiddens, 2 * self.act_dim), final_scale=0.01),
+            "q1": self._mlp_init(k1, qs, final_scale=1.0),
+            "q2": self._mlp_init(k2, qs, final_scale=1.0),
+            "log_alpha": jnp.zeros(()),
+        }
+
+    def forward(self, params, obs):
+        obs = obs.reshape(obs.shape[0], -1).astype(jnp.float32)
+        out = self._mlp_apply(params["pi"], obs)
+        return {"action_dist_inputs": out, "vf": jnp.zeros(obs.shape[0])}
+
+    def q_values(self, q_params, obs, actions):
+        obs = obs.reshape(obs.shape[0], -1).astype(jnp.float32)
+        x = jnp.concatenate([obs, actions.reshape(obs.shape[0], -1).astype(jnp.float32)], axis=-1)
+        return self._mlp_apply(q_params, x)[..., 0]
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.train_batch_size = 256
+        self.replay_buffer_capacity = 100_000
+        self.tau = 0.005  # polyak target mix-in per update
+        self.initial_alpha = 0.1
+        self.target_entropy = "auto"  # -> -act_dim
+        self.num_steps_sampled_before_learning_starts = 1_000
+        self.rollout_fragment_length = 64
+        # updates per iteration = new_env_steps * train_intensity /
+        # train_batch_size; the default equals batch size, i.e. ~ONE
+        # gradient step per env step — SAC's standard replay ratio
+        # (reference sac.py training_intensity semantics)
+        self.train_intensity = 256.0
+        self.module_class = SACModule
+
+    @property
+    def algo_class(self):
+        return SAC
+
+
+class SACLearner(Learner):
+    """One jitted update: combined actor/critic/alpha grad with
+    stop_gradient fencing + optimizer step + polyak target map."""
+
+    def build(self, seed: int = 0):
+        super().build(seed)
+        self.params["log_alpha"] = jnp.log(jnp.asarray(self.config.initial_alpha, jnp.float32))
+        self.opt_state = self.optimizer.init(self.params)
+        self.target_q = {"q1": jax.tree.map(jnp.array, self.params["q1"]), "q2": jax.tree.map(jnp.array, self.params["q2"])}
+        self._key = jax.random.PRNGKey(seed + 1)
+        cfg = self.config
+        act_dim = self.module.act_dim
+        target_entropy = -float(act_dim) if cfg.target_entropy == "auto" else float(cfg.target_entropy)
+        module = self.module
+        dist = module.action_dist_cls
+
+        def sample_squashed(params_pi, obs, key):
+            """Reparameterized squashed sample + its logp, computed from u
+            directly (no atanh round trip)."""
+            out = module._mlp_apply(params_pi, obs.reshape(obs.shape[0], -1).astype(jnp.float32))
+            mean, log_std = DiagGaussian._split(out)
+            u = mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
+            t = jnp.tanh(u)
+            scale = (dist.high - dist.low) * 0.5
+            a = (dist.high + dist.low) * 0.5 + scale * t
+            base = jnp.sum(-0.5 * (((u - mean) / jnp.exp(log_std)) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi)), axis=-1)
+            logp = base - jnp.sum(jnp.log(scale * (1.0 - t**2) + 1e-9), axis=-1)
+            return a, logp
+
+        def losses(params, target_q, batch, key):
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(params["log_alpha"])
+            alpha_sg = jax.lax.stop_gradient(alpha)
+
+            # -- critic: targets from the CURRENT policy at s', target Qs
+            a2, logp2 = sample_squashed(params["pi"], batch["next_obs"], k2)
+            q1_t = module.q_values(target_q["q1"], batch["next_obs"], a2)
+            q2_t = module.q_values(target_q["q2"], batch["next_obs"], a2)
+            soft_target = jnp.minimum(q1_t, q2_t) - alpha_sg * logp2
+            y = jax.lax.stop_gradient(
+                batch["rewards"] + cfg.gamma * (1.0 - batch["done"]) * soft_target
+            )
+            q1 = module.q_values(params["q1"], batch["obs"], batch["actions"])
+            q2 = module.q_values(params["q2"], batch["obs"], batch["actions"])
+            critic_loss = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+
+            # -- actor: maximize soft value through FROZEN critics
+            q_frozen = jax.tree.map(jax.lax.stop_gradient, {"q1": params["q1"], "q2": params["q2"]})
+            a_pi, logp_pi = sample_squashed(params["pi"], batch["obs"], k1)
+            q_pi = jnp.minimum(
+                module.q_values(q_frozen["q1"], batch["obs"], a_pi),
+                module.q_values(q_frozen["q2"], batch["obs"], a_pi),
+            )
+            actor_loss = jnp.mean(alpha_sg * logp_pi - q_pi)
+
+            # -- temperature: drive E[logp] toward -target_entropy
+            alpha_loss = -jnp.mean(params["log_alpha"] * jax.lax.stop_gradient(logp_pi + target_entropy))
+
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {
+                "total_loss": total,
+                "critic_loss": critic_loss,
+                "actor_loss": actor_loss,
+                "alpha_loss": alpha_loss,
+                "alpha": alpha,
+                "qf_mean": jnp.mean(q1),
+                "entropy_proxy": -jnp.mean(logp_pi),
+            }
+
+        grad_fn = jax.grad(losses, has_aux=True)
+
+        import optax
+
+        def update(params, opt_state, target_q, batch, key):
+            grads, aux = grad_fn(params, target_q, batch, key)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            tau = cfg.tau
+            target_q = jax.tree.map(
+                lambda t, o: (1.0 - tau) * t + tau * o,
+                target_q,
+                {"q1": params["q1"], "q2": params["q2"]},
+            )
+            return params, opt_state, target_q, aux
+
+        self._update_fn = jax.jit(update)
+
+    def update_sac(self, batch: dict) -> dict:
+        mb = {k: jnp.asarray(v) for k, v in batch.items() if k != "batch_indices"}
+        self._key, k = jax.random.split(self._key)
+        self.params, self.opt_state, self.target_q, aux = self._update_fn(
+            self.params, self.opt_state, self.target_q, mb, k
+        )
+        return {k2: float(v) for k2, v in aux.items()}
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        state["target_q"] = jax.tree.map(np.asarray, self.target_q)
+        return state
+
+    def set_state(self, state: dict):
+        super().set_state(state)
+        if "target_q" in state:
+            self.target_q = jax.tree.map(jnp.asarray, state["target_q"])
+
+
+class SAC(Algorithm):
+    learner_cls = SACLearner
+
+    def setup(self):
+        cfg = self.config
+        if cfg.num_learners > 0:
+            raise NotImplementedError("SAC runs a single (local) learner; scale sampling with num_env_runners")
+        super().setup()
+        self.replay = EpisodeReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
+
+    @property
+    def _learner(self) -> SACLearner:
+        return self.learner_group._local
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        segments, runner_metrics = self.env_runner_group.sample(cfg.rollout_fragment_length)
+        new_steps = 0
+        for seg in segments:
+            new_steps += len(self.replay.add(seg))
+        self._total_env_steps += new_steps
+
+        result = self._merge_runner_metrics(runner_metrics)
+        if self._total_env_steps < cfg.num_steps_sampled_before_learning_starts or len(self.replay) < cfg.train_batch_size:
+            result["learner"] = {"num_updates": 0}
+            return result
+
+        num_updates = max(1, int(new_steps * cfg.train_intensity / cfg.train_batch_size))
+        metrics = {}
+        for _ in range(num_updates):
+            batch = self.replay.sample(cfg.train_batch_size)
+            metrics = self._learner.update_sac(batch)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        result["learner"] = {"num_updates": num_updates, **metrics}
+        result["num_env_steps_sampled_lifetime"] = self._total_env_steps
+        return result

@@ -75,3 +75,54 @@ class DiagGaussian:
     def deterministic(inputs):
         mean, _ = DiagGaussian._split(inputs)
         return mean
+
+
+def make_squashed_gaussian(low, high):
+    """Tanh-squashed diagonal gaussian scaled to [low, high] — the SAC
+    policy distribution (reference: TorchSquashedGaussian in
+    rllib/models/torch/torch_distributions.py). Built per action space
+    like DQN's epsilon-greedy factory: the bounds are baked into the
+    class so env runners use it through the generic dist interface."""
+    import numpy as np
+
+    low_a = jnp.asarray(np.asarray(low, dtype=np.float32))
+    high_a = jnp.asarray(np.asarray(high, dtype=np.float32))
+    scale = (high_a - low_a) * 0.5
+    mid = (high_a + low_a) * 0.5
+
+    class SquashedGaussian:
+        low = low_a
+        high = high_a
+
+        @staticmethod
+        def _squash(u):
+            return mid + scale * jnp.tanh(u)
+
+        @staticmethod
+        def sample(key, inputs):
+            mean, log_std = DiagGaussian._split(inputs)
+            u = mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
+            return SquashedGaussian._squash(u)
+
+        @staticmethod
+        def logp(inputs, actions):
+            # invert the squash; clip keeps atanh finite at the bounds
+            t = jnp.clip((actions - mid) / scale, -0.999999, 0.999999)
+            u = jnp.arctanh(t)
+            base = DiagGaussian.logp(inputs, u)
+            # |d a / d u| = scale * (1 - tanh(u)^2)
+            correction = jnp.sum(jnp.log(scale * (1.0 - t**2) + 1e-9), axis=-1)
+            return base - correction
+
+        @staticmethod
+        def deterministic(inputs):
+            mean, _ = DiagGaussian._split(inputs)
+            return SquashedGaussian._squash(mean)
+
+        @staticmethod
+        def entropy(inputs):
+            # gaussian entropy upper bound (exact squashed entropy has no
+            # closed form; used only for metrics)
+            return DiagGaussian.entropy(inputs)
+
+    return SquashedGaussian

@@ -28,10 +28,18 @@ class SingleAgentEnvRunner:
     """Steps `num_envs` vectorized envs; actions from the module's
     exploration pass. Runs inline (local mode) or as a remote actor."""
 
-    def __init__(self, module_spec, env_id: str, env_config: dict | None = None, num_envs: int = 1, seed: int = 0, worker_idx: int = 0):
+    def __init__(self, module_spec, env_id: str, env_config: dict | None = None, num_envs: int = 1, seed: int = 0, worker_idx: int = 0, env_to_module=None, module_to_env=None):
         self.envs = _make_env(env_id, env_config, num_envs)
         self.num_envs = num_envs
         self.module = module_spec.build()
+        # connector pipelines (rllib/connectors/connector.py, reference
+        # connector_v2.py): obs transform applied ONCE at receipt so the
+        # module forward AND the learner (via stored episode obs) see the
+        # same representation; action transform applied only on the way
+        # into env.step (episodes keep module-space actions so replayed
+        # logp/Q inputs stay consistent)
+        self._env_to_module = env_to_module
+        self._module_to_env = module_to_env
         self.params = None
         # rollouts are latency-bound host loops: pin them to the CPU
         # backend when one is registered, even if the process default is a
@@ -44,6 +52,7 @@ class SingleAgentEnvRunner:
         self._key = self._put(jax.random.PRNGKey(seed + 10_000 * worker_idx))
         self._fwd = jax.jit(self.module.forward_exploration)
         obs, _ = self.envs.reset(seed=seed + 10_000 * worker_idx)
+        obs = self._obs_transform(obs)
         self._obs = obs
         self._building = [Episode() for _ in range(num_envs)]
         for ep, o in zip(self._building, obs):
@@ -57,6 +66,22 @@ class SingleAgentEnvRunner:
 
         self._episode_returns: deque = deque(maxlen=100)
         self._episodes_this_sample = 0
+
+    def _obs_transform(self, obs):
+        if self._env_to_module is None:
+            return obs
+        return self._env_to_module(obs, action_space=self.envs.single_action_space)
+
+    def _action_transform(self, actions):
+        if self._module_to_env is None:
+            return actions
+        return self._module_to_env(actions, action_space=self.envs.single_action_space)
+
+    def get_connector_states(self) -> dict:
+        return {
+            "env_to_module": self._env_to_module.get_state() if self._env_to_module else {},
+            "module_to_env": self._module_to_env.get_state() if self._module_to_env else {},
+        }
 
     def _put(self, x):
         return jax.device_put(x, self._device) if self._device is not None else jnp.asarray(x)
@@ -106,7 +131,8 @@ class SingleAgentEnvRunner:
             actions_np = np.asarray(actions)
             logp_np = np.asarray(logp)
             vf_np = np.asarray(out["vf"])
-            obs, rewards, terms, truncs, _ = self.envs.step(actions_np)
+            obs, rewards, terms, truncs, _ = self.envs.step(self._action_transform(actions_np))
+            obs = self._obs_transform(obs)
             for i in range(self.num_envs):
                 if self._pending_reset[i]:
                     # this step reset env i: obs[i] is the new episode's
@@ -161,7 +187,7 @@ class EnvRunnerGroup:
     num_env_runners == 0 (reference env_runner_group.py local-worker
     semantics)."""
 
-    def __init__(self, module_spec, env_id, env_config=None, num_env_runners: int = 0, num_envs_per_env_runner: int = 1, seed: int = 0, output: str | None = None):
+    def __init__(self, module_spec, env_id, env_config=None, num_env_runners: int = 0, num_envs_per_env_runner: int = 1, seed: int = 0, output: str | None = None, env_to_module=None, module_to_env=None):
         self.num_env_runners = num_env_runners
         # offline-data recording (reference: offline/json_writer.py via
         # config.offline_data(output=...)): every collected episode batch
@@ -172,12 +198,18 @@ class EnvRunnerGroup:
 
             self._writer = JsonWriter(output)
         if num_env_runners == 0:
-            self._local = SingleAgentEnvRunner(module_spec, env_id, env_config, num_envs_per_env_runner, seed)
+            self._local = SingleAgentEnvRunner(
+                module_spec, env_id, env_config, num_envs_per_env_runner, seed,
+                env_to_module=env_to_module, module_to_env=module_to_env,
+            )
             self._actors = []
         else:
             self._local = None
             self._actors = [
-                _EnvRunnerActor.remote(module_spec, env_id, env_config, num_envs_per_env_runner, seed, worker_idx=i + 1)
+                _EnvRunnerActor.remote(
+                    module_spec, env_id, env_config, num_envs_per_env_runner, seed, worker_idx=i + 1,
+                    env_to_module=env_to_module, module_to_env=module_to_env,
+                )
                 for i in range(num_env_runners)
             ]
 

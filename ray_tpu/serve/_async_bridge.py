@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time
 
 import ray_tpu
 from ray_tpu.core import context
@@ -117,26 +118,40 @@ class _StreamPump:
                     if backlog >= 48:
                         break  # backpressure: consumer lagging; headroom
                         # below maxsize keeps sentinel/error pushes lossless
-                    try:
-                        item_id = rt.next_generator_item(st["gen_id"], st["index"], timeout=0)
-                    except GetTimeoutError:
-                        break  # nothing ready yet
-                    except Exception as e:  # noqa: BLE001
-                        self._push(st, e)
-                        st["dead"] = True
-                        break
+                    item_id = st.pop("pending_item", None)
                     if item_id is None:
-                        self._push(st, _SENTINEL)
-                        st["dead"] = True
-                        break
-                    st["index"] += 1
+                        try:
+                            item_id = rt.next_generator_item(st["gen_id"], st["index"], timeout=0)
+                        except GetTimeoutError:
+                            break  # nothing ready yet
+                        except Exception as e:  # noqa: BLE001
+                            self._push(st, e)
+                            st["dead"] = True
+                            break
+                        if item_id is None:
+                            self._push(st, _SENTINEL)
+                            st["dead"] = True
+                            break
+                        st["index"] += 1
                     progressed = True
                     try:
-                        value = rt.get_object(item_id, timeout=5.0)
+                        # near-zero timeout: a value needing a slow
+                        # cross-node pull must not head-of-line block the
+                        # SHARED pump — park it and retry next pass while
+                        # other streams keep draining
+                        value = rt.get_object(item_id, timeout=0.05)
+                    except GetTimeoutError:
+                        st["pending_item"] = item_id
+                        st["pending_since"] = st.get("pending_since") or time.monotonic()
+                        if time.monotonic() - st["pending_since"] > 60.0:
+                            self._push(st, TimeoutError("stream item fetch stalled >60s"))
+                            st["dead"] = True
+                        break
                     except BaseException as e:  # noqa: BLE001
                         self._push(st, e)
                         st["dead"] = True
                         break
+                    st.pop("pending_since", None)
                     self._push(st, value)
             with self._lock:
                 for sid, st in list(self._streams.items()):

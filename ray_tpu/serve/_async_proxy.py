@@ -158,8 +158,10 @@ class AsyncHTTPProxy(RouteTableMixin):
         return req, keep_alive
 
     def _wants_stream(self, req: Request) -> bool:
-        accept = req.headers.get("Accept", "") or req.headers.get("accept", "")
-        if "text/event-stream" in accept or req.headers.get("X-Serve-Stream") == "1":
+        # header NAMES are case-insensitive (RFC 9110); Request preserves
+        # wire case for user code, so scan case-insensitively here
+        lower = {k.lower(): v for k, v in req.headers.items()}
+        if "text/event-stream" in lower.get("accept", "") or lower.get("x-serve-stream") == "1":
             return True
         if req.path.endswith(("/completions", "/chat/completions")) and req.body[:1] == b"{" and b'"stream"' in req.body:
             try:

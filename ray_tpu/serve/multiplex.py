@@ -150,9 +150,18 @@ class _ModelCache:
                 await asyncio.get_running_loop().run_in_executor(None, x.wait, 300.0)
                 continue
             try:
-                model = self._loader(obj, model_id)
-                if inspect.iscoroutine(model):
-                    model = await model
+                if inspect.iscoroutinefunction(self._loader):
+                    model = await self._loader(obj, model_id)
+                else:
+                    # a sync loader (multi-second weight load) must not
+                    # block every concurrent request on the replica's
+                    # event loop; the singleflight event already
+                    # serializes duplicate loads
+                    model = await asyncio.get_running_loop().run_in_executor(
+                        None, self._loader, obj, model_id
+                    )
+                    if inspect.iscoroutine(model):
+                        model = await model
             except BaseException:
                 self._abort(model_id, x)
                 raise

@@ -15,10 +15,9 @@ class Result:
     error: BaseException | None = None
     metrics_history: list = field(default_factory=list)
     best_checkpoints: list = field(default_factory=list)
-
-    @property
-    def config(self):
-        return None
+    # the trial's resolved param config (tune results; None for train,
+    # matching the reference's Result.config)
+    config: dict | None = None
 
     def get_best_checkpoint(self, metric: str, mode: str = "max") -> Checkpoint | None:
         best, best_v = None, None

@@ -18,7 +18,14 @@ from ray_tpu.tune.schedulers import (
     PopulationBasedTraining,
     TrialScheduler,
 )
-from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter, OptunaSearch, Searcher, TPESearcher
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    BayesOptSearcher,
+    ConcurrencyLimiter,
+    OptunaSearch,
+    Searcher,
+    TPESearcher,
+)
 from ray_tpu.tune.search_space import (
     choice,
     grid_search,
@@ -54,6 +61,7 @@ __all__ = [
     "ResultGrid",
     "Searcher",
     "TPESearcher",
+    "BayesOptSearcher",
     "TrialScheduler",
     "TuneConfig",
     "Tuner",

@@ -18,6 +18,7 @@ class ResultGrid:
                 path=path,
                 error=RuntimeError(t.error) if t.error else None,
                 metrics_history=t.metrics_history,
+                config=dict(t.config) if t.config else None,
             )
             for t in trials
         ]

@@ -239,3 +239,139 @@ class TPESearcher(Searcher):
             cfg[k] = d.sample(self.rng, cfg)
         self._configs[trial_id] = cfg
         return dict(cfg)
+
+
+class BayesOptSearcher(Searcher):
+    """Gaussian-process Bayesian optimization with Expected Improvement
+    (reference capability: tune/search/bayesopt/bayesopt_search.py wraps
+    the external bayesian-optimization package; here the GP — RBF kernel
+    with jitter over unit-cube-normalized inputs — and the EI acquisition
+    are implemented natively, so the searcher works with zero extra
+    dependencies).
+
+    Numeric dimensions normalize to [0, 1] (log-aware); categoricals
+    one-hot into the kernel. Suggestions before ``n_startup_trials``
+    observations are random; afterwards EI is maximized over
+    ``n_candidates`` sampled points.
+    """
+
+    def __init__(
+        self,
+        num_samples: int = 16,
+        *,
+        metric: str | None = None,
+        mode: str | None = None,
+        n_startup_trials: int = 5,
+        n_candidates: int = 256,
+        xi: float = 0.01,
+        noise: float = 1e-4,
+        seed: int | None = None,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.remaining = num_samples
+        self.n_startup = int(n_startup_trials)
+        self.n_candidates = int(n_candidates)
+        self.xi = float(xi)
+        self.noise = float(noise)
+        self.rng = np.random.default_rng(seed)
+        self._configs: dict[str, dict] = {}
+        self._observed: list[tuple[dict, float]] = []
+
+    def set_search_properties(self, metric, mode, space):
+        super().set_search_properties(self.metric or metric, self.mode or mode or "max", space)
+        for k, v in space.items():
+            if isinstance(v, dict):
+                raise ValueError(f"BayesOptSearcher supports flat search spaces; flatten nested key {k!r}")
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        cfg = self._configs.pop(trial_id, None)
+        if cfg is None or error or result is None or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        self._observed.append((cfg, score if self.mode == "max" else -score))
+
+    # -- featurization: config dict -> unit-cube vector --
+    def _dims(self):
+        from ray_tpu.tune.search_space import Categorical, Domain, Float, Integer, SampleFrom
+
+        out = []
+        for k, v in self.space.items():
+            if isinstance(v, (Float, Integer)):
+                out.append((k, v, "num"))
+            elif isinstance(v, Categorical):
+                out.append((k, v, "cat"))
+            elif isinstance(v, Domain) and not isinstance(v, SampleFrom):
+                out.append((k, v, "other"))
+        return out
+
+    def _encode(self, cfg, dims):
+        feats = []
+        for k, d, kind in dims:
+            if kind == "num":
+                log = bool(getattr(d, "log", False))
+                lo, hi = (np.log(d.lower), np.log(d.upper)) if log else (d.lower, d.upper)
+                x = np.log(cfg[k]) if log else cfg[k]
+                feats.append((float(x) - lo) / max(hi - lo, 1e-12))
+            elif kind == "cat":
+                cats = list(d.categories)
+                one = [0.0] * len(cats)
+                if cfg[k] in cats:
+                    one[cats.index(cfg[k])] = 1.0
+                feats.extend(one)
+            else:
+                feats.append(0.0)
+        return np.asarray(feats, np.float64)
+
+    @staticmethod
+    def _rbf(a, b, ls=0.2):
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (ls * ls))
+
+    def suggest(self, trial_id):
+        from ray_tpu.tune.search_space import Domain, SampleFrom
+
+        if self.remaining <= 0:
+            return None
+        self.remaining -= 1
+        dims = self._dims()
+        searched = {k for k, _, _ in dims}
+        derived = {k: v for k, v in self.space.items() if isinstance(v, SampleFrom)}
+        fixed = {k: v for k, v in self.space.items() if not isinstance(v, Domain) and k not in searched}
+
+        def random_cfg():
+            return {**fixed, **{k: d.sample(self.rng) for k, d, _ in dims}}
+
+        if len(self._observed) < self.n_startup or not dims:
+            cfg = random_cfg()
+        else:
+            X = np.stack([self._encode(c, dims) for c, _ in self._observed])
+            y = np.asarray([s for _, s in self._observed], np.float64)
+            y_mean, y_std = y.mean(), max(y.std(), 1e-12)
+            yn = (y - y_mean) / y_std
+            K = self._rbf(X, X) + self.noise * np.eye(len(X))
+            try:
+                L = np.linalg.cholesky(K)
+            except np.linalg.LinAlgError:
+                L = np.linalg.cholesky(K + 1e-6 * np.eye(len(X)))
+            alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+            cands = [random_cfg() for _ in range(self.n_candidates)]
+            Xc = np.stack([self._encode(c, dims) for c in cands])
+            Ks = self._rbf(Xc, X)  # [C, N]
+            mu = Ks @ alpha
+            v = np.linalg.solve(L, Ks.T)  # [N, C]
+            var = np.maximum(1.0 - (v * v).sum(0), 1e-12)
+            sigma = np.sqrt(var)
+            best = yn.max()
+            z = (mu - best - self.xi) / sigma
+            # EI = sigma * (z*Phi(z) + phi(z)) without scipy
+            phi = np.exp(-0.5 * z * z) / np.sqrt(2 * np.pi)
+            from math import erf
+
+            Phi = 0.5 * (1.0 + np.vectorize(erf)(z / np.sqrt(2.0)))
+            ei = sigma * (z * Phi + phi)
+            cfg = cands[int(np.argmax(ei))]
+        for k, d in derived.items():
+            cfg[k] = d.sample(self.rng, cfg)
+        self._configs[trial_id] = cfg
+        return cfg

@@ -267,7 +267,9 @@ def test_agent_reconnects_to_restarted_head(tmp_path):
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
         )
-        out, _ = head1.communicate(timeout=120)
+        # generous under suite load (the window was tight at 120s when the
+        # transport/spilling suites run alongside — ADVICE r3)
+        out, _ = head1.communicate(timeout=240)
         assert b"HEAD1_SAW_AGENT" in out, out[-4000:]
         assert head1.returncode == -signal.SIGKILL
         # head is gone; the agent is now redialing the fixed port

@@ -1,0 +1,164 @@
+"""SAC continuous control + connector pipelines.
+
+Reference test strategy: rllib/algorithms/sac/tests/test_sac.py
+(compilation + learning on Pendulum) and
+rllib/connectors/tests/test_connector_v2.py (pipeline editing, stateful
+connectors).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+gym = pytest.importorskip("gymnasium")
+
+from ray_tpu.rllib.connectors import (  # noqa: E402
+    CastToFloat32,
+    ClipActions,
+    ConnectorPipeline,
+    FlattenObs,
+    NormalizeObs,
+    RescaleActions,
+)
+
+
+# ---------------------------------------------------------------- connectors
+
+
+def test_pipeline_composition_and_editing():
+    p = ConnectorPipeline(FlattenObs(), CastToFloat32())
+    x = np.ones((4, 2, 3), dtype=np.float64)
+    out = p(x)
+    assert out.shape == (4, 6) and out.dtype == np.float32
+    p.prepend(NormalizeObs())
+    assert isinstance(p.connectors[0], NormalizeObs)
+    assert p.remove(NormalizeObs) and not p.remove(NormalizeObs)
+
+
+def test_normalize_obs_converges_to_unit_scale():
+    c = NormalizeObs()
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        c(rng.normal(5.0, 3.0, size=(32, 4)))
+    out = c(rng.normal(5.0, 3.0, size=(1000, 4)))
+    assert abs(float(out.mean())) < 0.2
+    assert 0.8 < float(out.std()) < 1.2
+    # state round-trips (per-worker connector state, reference parity)
+    c2 = NormalizeObs()
+    c2.set_state(c.get_state())
+    np.testing.assert_allclose(c2.mean, c.mean)
+
+
+def test_action_connectors():
+    clip = ClipActions(low=-1.0, high=1.0)
+    np.testing.assert_allclose(clip(np.array([[-3.0, 0.5, 2.0]])), [[-1.0, 0.5, 1.0]])
+    rescale = RescaleActions(low=np.array([0.0]), high=np.array([10.0]))
+    np.testing.assert_allclose(rescale(np.array([[-1.0], [0.0], [1.0]])), [[0.0], [5.0], [10.0]])
+
+
+def test_squashed_gaussian_logp_matches_sample():
+    from ray_tpu.rllib.core.distributions import make_squashed_gaussian
+
+    dist = make_squashed_gaussian(np.array([-2.0]), np.array([2.0]))
+    import jax.numpy as jnp
+
+    inputs = jnp.asarray([[0.3, -0.5]])  # mean, log_std
+    a = dist.sample(jax.random.PRNGKey(0), inputs)
+    assert float(dist.low[0]) <= float(a[0, 0]) <= float(dist.high[0])
+    lp = dist.logp(inputs, a)
+    assert np.isfinite(float(lp[0]))
+    # deterministic action is the squashed mean
+    det = dist.deterministic(inputs)
+    np.testing.assert_allclose(np.asarray(det), 2.0 * np.tanh([[0.3]]), atol=1e-5)
+
+
+def test_connectors_in_env_runner():
+    """Obs flow through env_to_module (stored transformed), actions
+    through module_to_env before env.step."""
+    from ray_tpu.rllib.core.rl_module import MLPModule, RLModuleSpec
+    from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+
+    env = gym.make("Pendulum-v1")
+    spec = RLModuleSpec(MLPModule, env.observation_space, env.action_space, {"fcnet_hiddens": (8,)})
+    norm = NormalizeObs()
+    runner = SingleAgentEnvRunner(
+        spec,
+        "Pendulum-v1",
+        num_envs=2,
+        env_to_module=ConnectorPipeline(norm, CastToFloat32()),
+        module_to_env=ClipActions(low=env.action_space.low, high=env.action_space.high),
+    )
+    module = spec.build()
+    runner.set_weights(module.init(jax.random.PRNGKey(0)))
+    segs, metrics = runner.sample(40)
+    assert metrics["num_env_steps"] >= 40
+    assert norm.count > 0  # the normalizer actually saw the obs stream
+    for s in segs:
+        assert np.all(np.abs(np.asarray(s["obs"])) <= norm.clip + 1e-6)
+
+
+# ----------------------------------------------------------------------- SAC
+
+
+def test_sac_module_shapes():
+    from ray_tpu.rllib.algorithms.sac import SACModule
+
+    env = gym.make("Pendulum-v1")
+    m = SACModule(env.observation_space, env.action_space, {"fcnet_hiddens": (16, 16)})
+    params = m.init(jax.random.PRNGKey(0))
+    assert set(params) == {"pi", "q1", "q2", "log_alpha"}
+    obs = np.zeros((5, 3), np.float32)
+    out = m.forward(params, obs)
+    assert out["action_dist_inputs"].shape == (5, 2)  # mean + log_std
+    q = m.q_values(params["q1"], obs, np.zeros((5, 1), np.float32))
+    assert q.shape == (5,)
+
+
+def test_sac_pendulum_learns():
+    """Reward-threshold learning test, like the PPO/DQN ones: random play
+    on Pendulum scores ~-1200; SAC must clearly beat it within budget."""
+    from ray_tpu.rllib.algorithms.sac import SACConfig
+
+    cfg = (
+        SACConfig()
+        .environment("Pendulum-v1")
+        .env_runners(num_envs_per_env_runner=4, rollout_fragment_length=256)
+        .training(lr=3e-4, train_batch_size=256)
+    )
+    cfg.num_steps_sampled_before_learning_starts = 1000
+    cfg.train_intensity = 128.0
+    cfg.model = {"fcnet_hiddens": (64, 64)}
+    cfg.seed = 0
+    algo = cfg.build()
+    best = -1e9
+    for i in range(130):
+        r = algo.train()
+        ret = r["env_runners"]["episode_return_mean"]
+        if np.isfinite(ret):
+            best = max(best, ret)
+        if best > -900.0:
+            break
+    assert best > -900.0, f"SAC failed to learn: best return {best:.1f}"
+    # alpha auto-tuning actually moved the temperature
+    assert r["learner"]["alpha"] != pytest.approx(0.1, abs=1e-4)
+
+
+def test_sac_checkpoint_roundtrip(tmp_path):
+    from ray_tpu.rllib.algorithms.sac import SACConfig
+
+    cfg = SACConfig().environment("Pendulum-v1").training(train_batch_size=32)
+    cfg.num_steps_sampled_before_learning_starts = 64
+    cfg.rollout_fragment_length = 64
+    cfg.model = {"fcnet_hiddens": (8,)}
+    algo = cfg.build()
+    algo.train()
+    algo.train()
+    path = algo.save_to_path(str(tmp_path / "ck"))
+    algo2 = cfg.build()
+    algo2.restore_from_path(path)
+    p1 = algo.learner_group._local.params
+    p2 = algo2.learner_group._local.params
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b)), p1, p2)
+    t1 = algo.learner_group._local.target_q
+    t2 = algo2.learner_group._local.target_q
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b)), t1, t2)

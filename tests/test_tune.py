@@ -427,6 +427,35 @@ def test_tpe_searcher_beats_random_on_quadratic(rt_start, tmp_path):
     assert min(losses[8:]) <= min(losses[:6]), losses
 
 
+def test_bayesopt_searcher_beats_random_on_quadratic(rt_start, tmp_path):
+    """Native GP-EI search (reference capability: tune/search/bayesopt
+    without the external package): converges near the optimum and
+    handles a categorical dimension through the one-hot kernel."""
+
+    def trainable(config):
+        bump = 0.0 if config["kind"] == "good" else 0.5
+        tune.report({"loss": (config["x"] - 0.7) ** 2 + (config["y"] - 0.2) ** 2 + bump})
+
+    space = {
+        "x": tune.uniform(0, 1),
+        "y": tune.uniform(0, 1),
+        "kind": tune.choice(["good", "bad"]),
+    }
+    bo = tune.BayesOptSearcher(num_samples=24, metric="loss", mode="min", n_startup_trials=6, seed=3)
+    res = tune.Tuner(
+        trainable,
+        param_space=space,
+        tune_config=tune.TuneConfig(metric="loss", mode="min", search_alg=bo, max_concurrent_trials=2),
+        run_config=_run_cfg(tmp_path / "bo"),
+    ).fit()
+    assert res.num_errors == 0 and len(res) == 24
+    best = res.get_best_result("loss", "min")
+    assert best.metrics["loss"] < 0.05, best.metrics["loss"]
+    assert best.config["kind"] == "good"
+    losses = [r.metrics["loss"] for r in res]
+    assert min(losses[8:]) <= min(losses[:6]), losses
+
+
 def test_tpe_with_asha_is_bohb_shaped(rt_start, tmp_path):
     """BOHB composition: TPE proposals + ASHA multi-fidelity elimination
     run together and find a good config."""
