@@ -59,6 +59,14 @@ class TaskManager:
         from collections import deque
 
         self._order: deque = deque()
+        # TRUE lifetime totals: record windows prune/cap, so metrics and
+        # the dashboard must not derive throughput from states() counts
+        self.lifetime_submitted = 0
+        self.lifetime_finished = 0
+
+    def lifetime_counts(self) -> dict:
+        with self._lock:
+            return {"submitted": self.lifetime_submitted, "finished": self.lifetime_finished}
 
     def register(self, spec: TaskSpec) -> TaskState:
         st = TaskState(spec)
@@ -66,6 +74,7 @@ class TaskManager:
         with self._lock:
             self._tasks[spec.task_id] = st
             self._order.append(spec.task_id)
+            self.lifetime_submitted += 1
             self._prune_locked()
         self.rt.gcs.events.record("task_submitted", task_id=spec.task_id.hex(), name=spec.name)
         return st
@@ -112,6 +121,8 @@ class TaskManager:
         st = self.get(task_id)
         if st:
             st.transition("FINISHED")
+            with self._lock:
+                self.lifetime_finished += 1
 
     def handle_app_error(self, task_id: TaskID, err: TaskError) -> bool:
         """Application-level exception. Returns True if the task will be

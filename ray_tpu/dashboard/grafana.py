@@ -1,0 +1,90 @@
+"""Grafana dashboard provisioning from the live metrics registry.
+
+Reference parity: python/ray/dashboard/modules/metrics/
+grafana_dashboard_factory.py:1 (generates the default Grafana dashboard
+JSON served by `ray metrics launch-prometheus` tooling). Here the panel
+set is DERIVED from the cluster's actual metric registry (util/metrics)
+plus the standard core series, so user-defined Counters/Gauges/
+Histograms get panels without editing any template.
+
+    from ray_tpu.dashboard.grafana import grafana_dashboard_json
+    open("ray_tpu_dashboard.json", "w").write(grafana_dashboard_json())
+
+Point Grafana's dashboard provisioning at the emitted file; the panels
+query the Prometheus datasource named by ``datasource`` scraping the
+head's /metrics endpoint (dashboard/dashboard.py).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def _panel(pid: int, title: str, exprs: list[tuple[str, str]], *, y: int, x: int = 0, w: int = 12, h: int = 8, unit: str = "short", datasource: str = "Prometheus") -> dict:
+    return {
+        "id": pid,
+        "title": title,
+        "type": "timeseries",
+        "datasource": {"type": "prometheus", "uid": datasource},
+        "gridPos": {"x": x, "y": y, "w": w, "h": h},
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "targets": [
+            {"expr": expr, "legendFormat": legend, "refId": chr(ord("A") + i)}
+            for i, (expr, legend) in enumerate(exprs)
+        ],
+    }
+
+
+def grafana_dashboard_json(client=None, *, datasource: str = "Prometheus", title: str = "ray_tpu") -> str:
+    """Build the dashboard JSON: core panels (tasks, objects, transfers)
+    plus one panel per registered application metric."""
+    from ray_tpu.util.metrics import get_metrics_snapshot
+
+    panels = []
+    pid = 1
+    y = 0
+
+    def add(title, exprs, **kw):
+        nonlocal pid, y
+        panels.append(_panel(pid, title, exprs, y=y, datasource=datasource, **kw))
+        pid += 1
+        if kw.get("x", 0) + kw.get("w", 12) >= 24:
+            y += kw.get("h", 8)
+
+    # -- core panels (series from the head's /metrics exposition) --
+    add("Task throughput", [("rate(rt_tasks_finished_total[1m])", "finished/s"), ("rate(rt_tasks_submitted_total[1m])", "submitted/s")], w=12, x=0)
+    add("Tasks in flight", [("rt_tasks_running", "running"), ("rt_tasks_pending", "pending")], w=12, x=12)
+    add("Object store", [("rt_object_store_bytes", "shm bytes"), ("rt_object_store_spilled_bytes", "spilled")], unit="bytes", w=12, x=0)
+    add("Object transfers", [("rate(rt_transfer_pull_bytes_total[1m])", "pull B/s"), ("rate(rt_transfer_serve_bytes_total[1m])", "serve B/s")], unit="Bps", w=12, x=12)
+
+    # -- one panel per registered metric (user Counters/Gauges/Histograms) --
+    try:
+        snapshot = get_metrics_snapshot(client)
+    except Exception:
+        snapshot = {}
+    for name, m in sorted(snapshot.items()):
+        if name.startswith("rt_"):
+            continue  # core series already have hand-built panels above
+        kind = m.get("kind", "gauge")
+        if kind == "counter":
+            exprs = [(f"rate({name}[1m])", f"{name}/s")]
+        elif kind == "histogram":
+            exprs = [
+                (f"histogram_quantile(0.5, rate({name}_bucket[5m]))", "p50"),
+                (f"histogram_quantile(0.99, rate({name}_bucket[5m]))", "p99"),
+            ]
+        else:
+            exprs = [(name, name)]
+        add(m.get("description") or name, exprs, w=12, x=(len(panels) % 2) * 12)
+
+    dashboard = {
+        "uid": "ray-tpu-default",
+        "title": title,
+        "tags": ["ray_tpu"],
+        "timezone": "browser",
+        "schemaVersion": 39,
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "panels": panels,
+    }
+    return json.dumps(dashboard, indent=1)

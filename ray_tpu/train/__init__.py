@@ -27,6 +27,13 @@ from ray_tpu.train.scaling_policy import (
 )
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, TorchTrainer
 from ray_tpu.train.errors import TrainingFailedError
+from ray_tpu.train import torch_utils as torch  # train.torch.prepare_model (reference API shape)
+
+import sys as _sys
+
+# make `import ray_tpu.train.torch` / `from ray_tpu.train.torch import
+# prepare_model` work too (the import style reference users port with)
+_sys.modules[__name__ + ".torch"] = torch
 
 __all__ = [
     "Checkpoint",

@@ -190,8 +190,56 @@ def get_metrics_snapshot(client=None) -> dict:
     return merged
 
 
+_core_counter_last: dict = {}
+_core_counter_lock = threading.Lock()  # concurrent scrapes must not double-inc
+
+
+def update_core_metrics(client) -> None:
+    """Refresh the core runtime series (rt_tasks_*, rt_object_store_*,
+    rt_transfer_*) from live cluster state — called on every /metrics
+    scrape so the Grafana panels (dashboard/grafana.py) are backed by
+    real data (reference: the autogenerated ray_* core metrics)."""
+    try:
+        states = client.cluster_info("tasks")
+        counts: dict[str, int] = {}
+        for t in states:
+            counts[t["status"]] = counts.get(t["status"], 0) + 1
+        Gauge("rt_tasks_running", description="tasks currently executing").set(float(counts.get("RUNNING", 0)))
+        Gauge("rt_tasks_pending", description="tasks queued or waiting").set(
+            float(counts.get("PENDING", 0) + counts.get("QUEUED", 0) + counts.get("WAITING", 0))
+        )
+        # lifetime totals, NOT windowed states() counts: record pruning
+        # would freeze a counter derived from the window
+        life = client.task_manager.lifetime_counts()
+        _bump_counter("rt_tasks_finished_total", "tasks finished", float(life["finished"]))
+        _bump_counter("rt_tasks_submitted_total", "tasks submitted", float(life["submitted"]))
+        obj = client.cluster_info("objects")
+        Gauge("rt_object_store_bytes", description="sealed shm bytes").set(float(obj.get("shm_bytes", 0)))
+        Gauge("rt_object_store_spilled_bytes", description="spilled bytes").set(float(obj.get("spilled_bytes", 0)))
+        from ray_tpu.core import transport
+
+        _bump_counter("rt_transfer_pull_bytes_total", "bytes pulled", float(transport.STATS.get("pull_bytes", 0)))
+        _bump_counter("rt_transfer_serve_bytes_total", "bytes served", float(transport.STATS.get("serve_bytes", 0)))
+    except Exception:
+        pass
+
+
+def _bump_counter(name: str, desc: str, absolute: float) -> None:
+    """Drive a Counter from an absolute external total (inc by delta)."""
+    c = Counter(name, description=desc)  # registers the series even at 0
+    c.inc(0.0)
+    with _core_counter_lock:
+        last = _core_counter_last.get(name, 0.0)
+        delta = absolute - last
+        _core_counter_last[name] = max(last, absolute)
+    if delta > 0:
+        c.inc(delta)
+
+
 def export_prometheus(client=None) -> str:
     """Prometheus text exposition of the merged snapshot."""
+    if client is not None:
+        update_core_metrics(client)
     lines = []
     for name, m in sorted(get_metrics_snapshot(client).items()):
         lines.append(f"# HELP {name} {m['description']}")

@@ -69,6 +69,9 @@ def cluster_status(client=None) -> dict:
         "available_resources": c.cluster_info("available_resources"),
         "pending_demand": c.scheduler.pending_demand() if hasattr(c, "scheduler") else [],
         "actors": dict(actors),
+        # lifetime totals (never pruned) — throughput must derive from
+        # these, not from the windowed task-record list
+        "task_counts": c.task_manager.lifetime_counts() if hasattr(c, "task_manager") else {},
     }
 
 

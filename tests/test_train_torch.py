@@ -1,0 +1,111 @@
+"""Torch DDP train-loop utilities (train.torch.prepare_model /
+prepare_data_loader).
+
+Reference test strategy: python/ray/train/tests/test_torch_trainer.py +
+train_loop_utils tests — DDP wrap under the gloo group, sampler
+sharding, and gradient synchronization verified by weight equality
+across workers.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import ray_tpu  # noqa: E402
+from ray_tpu import train  # noqa: E402
+from ray_tpu.train import DataParallelTrainer, RunConfig, ScalingConfig  # noqa: E402
+from ray_tpu.train.backend import TorchConfig  # noqa: E402
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_ddp_prepare_model_syncs_gradients(rt, tmp_path):
+    def loop(config):
+        import torch as T
+        from torch.utils.data import DataLoader, TensorDataset
+
+        T.manual_seed(0)  # same init everywhere; DDP keeps them in sync
+        model = train.torch.prepare_model(T.nn.Linear(4, 1))
+        is_ddp = isinstance(model, T.nn.parallel.DistributedDataParallel)
+
+        rank = train.get_context().get_world_rank()
+        g = T.Generator().manual_seed(42)
+        X = T.randn(64, 4, generator=g)
+        y = X @ T.tensor([[1.0], [-2.0], [3.0], [0.5]]) + 0.1
+        loader = train.torch.prepare_data_loader(DataLoader(TensorDataset(X, y), batch_size=8))
+        shard_rows = sum(len(b[0]) for b in loader)
+
+        opt = T.optim.SGD(model.parameters(), lr=0.05)
+        losses = []
+        for _ in range(40):
+            for xb, yb in loader:
+                opt.zero_grad()
+                loss = T.nn.functional.mse_loss(model(xb), yb)
+                train.torch.backward(loss)
+                opt.step()
+            losses.append(float(loss))
+        w = [p.detach().numpy().copy() for p in model.parameters()]
+        out = {
+            "rank": rank,
+            "is_ddp": is_ddp,
+            "shard_rows": shard_rows,
+            "first_loss": losses[0],
+            "last_loss": losses[-1],
+            "w0": float(np.asarray(w[0]).ravel()[0]),
+        }
+        # metrics_history carries rank-0 reports; per-rank facts go via a
+        # shared scratch file (same-host test workers)
+        import json as _json
+
+        with open(f"{config['out']}/rank{rank}.json", "w") as f:
+            _json.dump(out, f)
+        train.report(out)
+
+    trainer = DataParallelTrainer(
+        loop,
+        train_loop_config={"out": str(tmp_path)},
+        scaling_config=ScalingConfig(num_workers=2, resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="t", storage_path=str(tmp_path)),
+        backend_config=TorchConfig(),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    import json
+
+    per_worker = {}
+    for r in (0, 1):
+        with open(tmp_path / f"rank{r}.json") as f:
+            m = json.load(f)
+            per_worker[m["rank"]] = m
+    assert set(per_worker) == {0, 1}
+    for m in per_worker.values():
+        assert m["is_ddp"], "prepare_model did not wrap DDP at world_size 2"
+        assert m["shard_rows"] == 32, m  # DistributedSampler split 64 rows
+        assert m["last_loss"] < m["first_loss"]
+    # gradient sync: both replicas hold IDENTICAL weights after training
+    assert per_worker[0]["w0"] == pytest.approx(per_worker[1]["w0"], abs=1e-6)
+
+
+def test_prepare_model_noop_single_worker(rt, tmp_path):
+    def loop(config):
+        import torch as T
+
+        model = train.torch.prepare_model(T.nn.Linear(2, 1))
+        train.report({"is_plain": not isinstance(model, T.nn.parallel.DistributedDataParallel)})
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1, resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="t1", storage_path=str(tmp_path)),
+        backend_config=TorchConfig(),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["is_plain"]
