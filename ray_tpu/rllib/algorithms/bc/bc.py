@@ -1,0 +1,86 @@
+"""BC: behavior cloning from recorded episodes.
+
+Reference parity: rllib/algorithms/bc/bc.py:1 (BCConfig, offline-only
+training via the offline data pipeline; loss is the negative log
+likelihood of the dataset actions — bc_torch_learner). Works on any
+module whose action distribution exposes logp: discrete (Categorical
+logits) and continuous (DiagGaussian) both clone.
+
+Offline-only by definition: ``config.offline_data(input_=path)`` is
+required; env runners (if an env is configured) only evaluate the cloned
+policy greedily — no exploration data ever enters training.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.utils.replay_buffers import EpisodeReplayBuffer
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.updates_per_iter = 50
+        self.replay_buffer_capacity = 1_000_000
+
+    @property
+    def algo_class(self):
+        return BC
+
+
+class BCLearner(Learner):
+    """Supervised: maximize logp of dataset actions under the policy."""
+
+    def compute_losses(self, params, batch):
+        out = self.module.forward_train(params, batch)
+        logp = self.module.action_dist_cls.logp(out["action_dist_inputs"], batch["actions"])
+        loss = -jnp.mean(logp)
+        return loss, {"total_loss": loss, "bc_logp_mean": jnp.mean(logp)}
+
+
+class BC(Algorithm):
+    learner_cls = BCLearner
+    supports_offline_input = True
+
+    def setup(self):
+        cfg = self.config
+        if not cfg.input_:
+            raise ValueError("BC is offline-only: configure offline_data(input_=<episode dataset path>)")
+        if cfg.num_learners > 0:
+            raise NotImplementedError("BC runs a single (local) learner")
+        super().setup()
+        from ray_tpu.rllib.offline import JsonReader
+
+        self.replay = EpisodeReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
+        n = 0
+        for episode in JsonReader(cfg.input_):
+            n += len(self.replay.add(episode))
+        if n == 0:
+            raise ValueError(f"offline input {cfg.input_!r} contained no transitions")
+        self._dataset_transitions = n
+
+    @property
+    def _learner(self) -> BCLearner:
+        return self.learner_group._local
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        metrics: dict = {}
+        for _ in range(cfg.updates_per_iter):
+            batch = self.replay.sample(cfg.train_batch_size)
+            metrics = self._learner.update(batch)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        # greedy evaluation only (reference: BC evaluates, never explores)
+        _, runner_metrics = self.env_runner_group.sample(cfg.rollout_fragment_length, explore=False)
+        result = self._merge_runner_metrics(runner_metrics)
+        result["learner"] = {"num_updates": cfg.updates_per_iter, **metrics}
+        result["dataset_transitions"] = self._dataset_transitions
+        return result
