@@ -1,0 +1,76 @@
+"""CQL: conservative Q-learning for offline RL.
+
+Reference parity: rllib/algorithms/cql/cql.py:1 (CQLConfig; the
+conservative regularizer of cql_torch_learner). Discrete-action form
+over the DQN machinery: the TD loss gains the CQL(H) penalty
+
+    alpha_cql * E_s[ logsumexp_a Q(s, a) - Q(s, a_data) ]
+
+which pushes DOWN Q-values of actions absent from the dataset (the
+out-of-distribution overestimation that breaks naive offline DQN) while
+pushing UP the logged actions'. Offline-only: input_ is required and env
+runners evaluate greedily.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig, DQNLearner
+
+
+class CQLConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.cql_alpha = 1.0  # conservative penalty weight
+        self.lr = 5e-4
+
+    @property
+    def algo_class(self):
+        return CQL
+
+
+class CQLLearner(DQNLearner):
+    """DQN TD step + the conservative penalty, still ONE jitted grad."""
+
+    def build(self, seed: int = 0):
+        super().build(seed)
+        cfg = self.config
+
+        def cql_loss(params, target_params, batch):
+            q = self.module.forward(params, batch["obs"])["action_dist_inputs"]
+            q_taken = jnp.take_along_axis(q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+            q_next_target = self.module.forward(target_params, batch["next_obs"])["action_dist_inputs"]
+            if cfg.double_q:
+                q_next_online = self.module.forward(params, batch["next_obs"])["action_dist_inputs"]
+                next_a = jnp.argmax(q_next_online, axis=-1)
+                q_next = jnp.take_along_axis(q_next_target, next_a[:, None], axis=-1)[:, 0]
+            else:
+                q_next = jnp.max(q_next_target, axis=-1)
+            target = batch["rewards"] + cfg.gamma * (1.0 - batch["done"]) * jax.lax.stop_gradient(q_next)
+            td = q_taken - target
+            td_loss = jnp.mean(jnp.square(td))
+            # conservative regularizer: logsumexp over ALL actions minus
+            # the dataset action's Q — OOD actions get pushed down
+            conservative = jnp.mean(jax.scipy.special.logsumexp(q, axis=-1) - q_taken)
+            loss = td_loss + cfg.cql_alpha * conservative
+            return loss, {
+                "total_loss": loss,
+                "td_loss": td_loss,
+                "cql_penalty": conservative,
+                "qf_mean": jnp.mean(q_taken),
+                "td_abs": jnp.abs(td),
+            }
+
+        self._td_grad = jax.jit(jax.grad(cql_loss, has_aux=True))
+
+
+class CQL(DQN):
+    learner_cls = CQLLearner
+    supports_offline_input = True
+
+    def setup(self):
+        if not self.config.input_:
+            raise ValueError("CQL is offline-only: configure offline_data(input_=<episode dataset path>)")
+        super().setup()
