@@ -387,10 +387,22 @@ class AgentListener:
 
     def shutdown(self):
         self._stopped = True
+        # close() alone does not wake a blocked accept() on Linux (this
+        # thread leaked on every runtime shutdown): dial a throwaway
+        # connection so the loop observes _stopped — the failed mp auth
+        # handshake makes accept raise, which the loop treats as exit
+        try:
+            import socket as _socket
+
+            with _socket.create_connection(self.address, timeout=1.0):
+                pass
+        except Exception:
+            pass
         try:
             self._listener.close()
         except Exception:
             pass
+        self._thread.join(timeout=2.0)
 
 
 class _RemoteWorkerProc:

@@ -122,9 +122,18 @@ class ObjectTransferServer:
         self._thread.start()
 
     def _accept_loop(self):
+        # timeout-polling accept: close() from another thread does NOT
+        # reliably wake a blocked accept() on Linux, which leaked this
+        # thread on every runtime shutdown
+        try:
+            self._sock.settimeout(0.5)
+        except OSError:
+            return  # raced an immediate shutdown(): socket already closed
         while not self._stopped.is_set():
             try:
                 conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
             except OSError:
                 return
             threading.Thread(target=self._serve_one, args=(conn,), daemon=True).start()

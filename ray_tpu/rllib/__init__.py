@@ -7,16 +7,21 @@ functional modules and jitted optax updates; multi-learner gradient sync
 rides ray_tpu.collective (host allreduce) or a GSPMD mesh instead of NCCL.
 
 Public surface:
-  - AlgorithmConfig builder (`PPOConfig`, `IMPALAConfig`)
+  - AlgorithmConfig builders (`PPOConfig`, `IMPALAConfig`, `DQNConfig`,
+    `SACConfig`, `BCConfig`, `CQLConfig`)
   - `config.build()` -> Algorithm; `algo.train()` -> result dict
   - RLModule: functional JAX policy/value modules
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm  # noqa: F401
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.bc.bc import BC, BCConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.cql.cql import CQL, CQLConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.impala.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig  # noqa: F401
+from ray_tpu.rllib.connectors import ConnectorPipeline, ConnectorV2  # noqa: F401
 from ray_tpu.rllib.core.rl_module import MLPModule, RLModule, RLModuleSpec  # noqa: F401
 from ray_tpu.rllib.env.multi_agent import MultiAgentEnv, MultiAgentEnvRunner  # noqa: F401
 from ray_tpu.rllib.utils.replay_buffers import (  # noqa: F401

@@ -32,25 +32,15 @@ class CQLConfig(DQNConfig):
 
 
 class CQLLearner(DQNLearner):
-    """DQN TD step + the conservative penalty, still ONE jitted grad."""
+    """The DQN TD step (shared _td_core — incl. prioritized IS weights)
+    plus the conservative penalty, still ONE jitted grad."""
 
     def build(self, seed: int = 0):
         super().build(seed)
         cfg = self.config
 
         def cql_loss(params, target_params, batch):
-            q = self.module.forward(params, batch["obs"])["action_dist_inputs"]
-            q_taken = jnp.take_along_axis(q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
-            q_next_target = self.module.forward(target_params, batch["next_obs"])["action_dist_inputs"]
-            if cfg.double_q:
-                q_next_online = self.module.forward(params, batch["next_obs"])["action_dist_inputs"]
-                next_a = jnp.argmax(q_next_online, axis=-1)
-                q_next = jnp.take_along_axis(q_next_target, next_a[:, None], axis=-1)[:, 0]
-            else:
-                q_next = jnp.max(q_next_target, axis=-1)
-            target = batch["rewards"] + cfg.gamma * (1.0 - batch["done"]) * jax.lax.stop_gradient(q_next)
-            td = q_taken - target
-            td_loss = jnp.mean(jnp.square(td))
+            q, q_taken, td, td_loss = self._td_core(params, target_params, batch)
             # conservative regularizer: logsumexp over ALL actions minus
             # the dataset action's Q — OOD actions get pushed down
             conservative = jnp.mean(jax.scipy.special.logsumexp(q, axis=-1) - q_taken)

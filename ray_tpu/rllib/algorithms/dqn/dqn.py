@@ -108,26 +108,32 @@ class DQNLearner(Learner):
     an ARGUMENT of the jitted grad (it changes across updates), not a
     closure capture."""
 
+    def _td_core(self, params, target_params, batch):
+        """Shared (double-)Q TD computation: returns (q [B, A], q_taken,
+        td, weighted td loss). CQL reuses this verbatim and adds its
+        penalty — ONE definition of the TD math."""
+        cfg = self.config
+        q = self.module.forward(params, batch["obs"])["action_dist_inputs"]
+        q_taken = jnp.take_along_axis(q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+        q_next_target = self.module.forward(target_params, batch["next_obs"])["action_dist_inputs"]
+        if cfg.double_q:
+            # online net picks the argmax, target net evaluates it
+            q_next_online = self.module.forward(params, batch["next_obs"])["action_dist_inputs"]
+            next_a = jnp.argmax(q_next_online, axis=-1)
+            q_next = jnp.take_along_axis(q_next_target, next_a[:, None], axis=-1)[:, 0]
+        else:
+            q_next = jnp.max(q_next_target, axis=-1)
+        target = batch["rewards"] + cfg.gamma * (1.0 - batch["done"]) * jax.lax.stop_gradient(q_next)
+        td = q_taken - target
+        weights = batch.get("weights", jnp.ones_like(td))  # prioritized IS correction
+        return q, q_taken, td, jnp.mean(weights * jnp.square(td))
+
     def build(self, seed: int = 0):
         super().build(seed)
         self.target_params = jax.tree.map(jnp.array, self.params)
 
         def td_loss(params, target_params, batch):
-            cfg = self.config
-            q = self.module.forward(params, batch["obs"])["action_dist_inputs"]
-            q_taken = jnp.take_along_axis(q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
-            q_next_target = self.module.forward(target_params, batch["next_obs"])["action_dist_inputs"]
-            if cfg.double_q:
-                # online net picks the argmax, target net evaluates it
-                q_next_online = self.module.forward(params, batch["next_obs"])["action_dist_inputs"]
-                next_a = jnp.argmax(q_next_online, axis=-1)
-                q_next = jnp.take_along_axis(q_next_target, next_a[:, None], axis=-1)[:, 0]
-            else:
-                q_next = jnp.max(q_next_target, axis=-1)
-            target = batch["rewards"] + cfg.gamma * (1.0 - batch["done"]) * jax.lax.stop_gradient(q_next)
-            td = q_taken - target
-            weights = batch.get("weights", jnp.ones_like(td))
-            loss = jnp.mean(weights * jnp.square(td))
+            _, q_taken, td, loss = self._td_core(params, target_params, batch)
             return loss, {"total_loss": loss, "qf_mean": jnp.mean(q_taken), "td_abs": jnp.abs(td)}
 
         self._td_grad = jax.jit(jax.grad(td_loss, has_aux=True))
