@@ -233,6 +233,8 @@ def test_elastic_scaling_shrinks_on_node_loss_then_regrows(tmp_path):
         client = _core_ctx.get_client()
         extra = client.add_node({"CPU": 2.0})  # second worker's capacity, up-front
 
+        marker = str(tmp_path / "ws2_running")
+
         def loop(config):
             ckpt = train.get_checkpoint()
             start = 0
@@ -245,10 +247,17 @@ def test_elastic_scaling_shrinks_on_node_loss_then_regrows(tmp_path):
                 with open(os.path.join(d, "state.json"), "w") as f:
                     json.dump({"step": step}, f)
                 train.report({"step": step, "world_size": ws}, checkpoint=Checkpoint.from_directory(d))
+                if ws == 2 and step >= 1 and train.get_context().get_world_rank() == 0:
+                    open(config["marker"], "w").write("x")  # 2-worker phase is really running
                 _time.sleep(0.4)
 
         def chaos_capacity():
-            _time.sleep(2.0)
+            # inject the node loss only once the 2-worker phase has
+            # committed a step — under suite load the first group can take
+            # many seconds to start, and removing earlier would race it
+            deadline = _time.monotonic() + 120
+            while _time.monotonic() < deadline and not os.path.exists(marker):
+                _time.sleep(0.2)
             client.remove_node(extra.node_id, graceful=False)  # shrink mid-run
             _time.sleep(3.5)
             client.add_node({"CPU": 2.0})  # capacity returns: regrow
@@ -258,6 +267,7 @@ def test_elastic_scaling_shrinks_on_node_loss_then_regrows(tmp_path):
         scaling = ScalingConfig(num_workers=2, resources_per_worker={"CPU": 2})
         trainer = DataParallelTrainer(
             loop,
+            train_loop_config={"marker": marker},
             scaling_config=scaling,
             run_config=_run_cfg(tmp_path, failure_config=FailureConfig(max_failures=3)),
             scaling_policy=ElasticScalingPolicy(scaling, min_workers=1, max_workers=2),
