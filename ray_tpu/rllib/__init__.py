@@ -7,18 +7,20 @@ functional modules and jitted optax updates; multi-learner gradient sync
 rides ray_tpu.collective (host allreduce) or a GSPMD mesh instead of NCCL.
 
 Public surface:
-  - AlgorithmConfig builders (`PPOConfig`, `IMPALAConfig`, `DQNConfig`,
-    `SACConfig`, `BCConfig`, `CQLConfig`)
+  - AlgorithmConfig builders (`PPOConfig`, `APPOConfig`, `IMPALAConfig`,
+    `DQNConfig`, `SACConfig`, `BCConfig`, `CQLConfig`, `MARWILConfig`)
   - `config.build()` -> Algorithm; `algo.train()` -> result dict
   - RLModule: functional JAX policy/value modules
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm  # noqa: F401
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.bc.bc import BC, BCConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.cql.cql import CQL, CQLConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.impala.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.marwil.marwil import MARWIL, MARWILConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rllib.connectors import ConnectorPipeline, ConnectorV2  # noqa: F401

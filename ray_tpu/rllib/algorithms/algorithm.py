@@ -108,6 +108,28 @@ class Algorithm:
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
 
     # -- shared helpers --
+    def _require_offline_only(self):
+        """Guard for offline-only algorithms (BC/MARWIL; the reference
+        encodes this by subclassing — bc.py BCConfig validates input_)."""
+        cfg = self.config
+        if not cfg.input_:
+            raise ValueError(
+                f"{type(self).__name__} is offline-only: configure "
+                "offline_data(input_=<episode dataset path>)"
+            )
+        if cfg.num_learners > 0:
+            raise NotImplementedError(f"{type(self).__name__} runs a single (local) learner")
+
+    def _offline_eval_result(self, learner_metrics: dict, num_updates: int) -> dict:
+        """Tail of an offline training_step: push weights, evaluate the
+        policy GREEDILY (no exploration data ever enters offline
+        training), and package the result dict."""
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        _, runner_metrics = self.env_runner_group.sample(self.config.rollout_fragment_length, explore=False)
+        result = self._merge_runner_metrics(runner_metrics)
+        result["learner"] = {"num_updates": num_updates, **learner_metrics}
+        return result
+
     def _merge_runner_metrics(self, metrics: list[dict]) -> dict:
         returns = [m["episode_return_mean"] for m in metrics if np.isfinite(m.get("episode_return_mean", float("nan")))]
         return {

@@ -52,10 +52,7 @@ class BC(Algorithm):
 
     def setup(self):
         cfg = self.config
-        if not cfg.input_:
-            raise ValueError("BC is offline-only: configure offline_data(input_=<episode dataset path>)")
-        if cfg.num_learners > 0:
-            raise NotImplementedError("BC runs a single (local) learner")
+        self._require_offline_only()
         super().setup()
         from ray_tpu.rllib.offline import JsonReader
 
@@ -77,10 +74,6 @@ class BC(Algorithm):
         for _ in range(cfg.updates_per_iter):
             batch = self.replay.sample(cfg.train_batch_size)
             metrics = self._learner.update(batch)
-        self.env_runner_group.sync_weights(self.learner_group.get_weights())
-        # greedy evaluation only (reference: BC evaluates, never explores)
-        _, runner_metrics = self.env_runner_group.sample(cfg.rollout_fragment_length, explore=False)
-        result = self._merge_runner_metrics(runner_metrics)
-        result["learner"] = {"num_updates": cfg.updates_per_iter, **metrics}
+        result = self._offline_eval_result(metrics, cfg.updates_per_iter)
         result["dataset_transitions"] = self._dataset_transitions
         return result

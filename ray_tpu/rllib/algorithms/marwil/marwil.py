@@ -1,0 +1,142 @@
+"""MARWIL: monotonic advantage re-weighted imitation learning (offline).
+
+Reference parity: rllib/algorithms/marwil/marwil.py:1 (MARWILConfig:
+beta / vf_coeff / moving-average advantage normalization) with the loss
+of rllib/algorithms/marwil/torch/marwil_torch_learner.py — a value head
+regresses Monte-Carlo returns of the recorded episodes, and the policy
+clones dataset actions weighted by exp(beta * normalized advantage), so
+better-than-baseline transitions are imitated harder. beta=0 degenerates
+to plain BC (the reference's BC subclasses MARWIL for exactly this
+reason; here BC stands alone and MARWIL mirrors its offline plumbing).
+
+TPU-native shape: returns are precomputed per episode at load time (a
+reversed cumulative sum on host — data prep, not model math), the whole
+dataset lives as flat [M, ...] arrays, and one jitted grad covers the
+policy + value losses. The advantage-normalization moving average is
+host-side state threaded through the batch as a column (same trick as
+APPO's kl_coeff), so the jitted loss never closes over a mutable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.updates_per_iter = 50
+        self.beta = 1.0  # 0 => behavior cloning
+        self.vf_coeff = 1.0
+        # update rate of the squared-advantage moving average used to
+        # normalize exponent scale (reference marwil.py
+        # moving_average_sqd_adv_norm_update_rate)
+        self.ma_adv_norm_rate = 1e-2
+        self.ma_adv_norm_start = 100.0
+
+    @property
+    def algo_class(self):
+        return MARWIL
+
+
+class MARWILLearner(Learner):
+    def build(self, seed: int = 0):
+        super().build(seed)
+        self.ma_adv_norm = float(self.config.ma_adv_norm_start)
+
+    def compute_losses(self, params, batch):
+        cfg = self.config
+        out = self.module.forward_train(params, batch)
+        logp = self.module.action_dist_cls.logp(out["action_dist_inputs"], batch["actions"])
+        adv = batch["returns"] - out["vf"]
+        vf_loss = jnp.mean(adv**2)
+        # exponent uses the running scale, not the per-batch one, so the
+        # weighting is stable across minibatches (reference learner's
+        # update_averaged_weights); clip the exponent for safety
+        scale = jax.lax.rsqrt(jnp.maximum(batch["ma_adv_norm"][0], 1e-8))
+        weights = jnp.exp(jnp.clip(cfg.beta * jax.lax.stop_gradient(adv) * scale, -20.0, 20.0))
+        policy_loss = -jnp.mean(weights * logp)
+        total = policy_loss + cfg.vf_coeff * vf_loss
+        return total, {
+            "total_loss": total,
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "mean_sqd_adv": jnp.mean(jax.lax.stop_gradient(adv) ** 2),
+            "mean_weight": jnp.mean(weights),
+        }
+
+    def update_marwil(self, batch: dict) -> dict:
+        batch = dict(batch)
+        batch["ma_adv_norm"] = np.full((len(batch["returns"]),), self.ma_adv_norm, np.float32)
+        metrics = self.update(batch)
+        rate = self.config.ma_adv_norm_rate
+        self.ma_adv_norm += rate * (metrics["mean_sqd_adv"] - self.ma_adv_norm)
+        metrics["ma_adv_norm"] = self.ma_adv_norm
+        return metrics
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        state["ma_adv_norm"] = self.ma_adv_norm
+        return state
+
+    def set_state(self, state: dict):
+        super().set_state(state)
+        self.ma_adv_norm = float(state.get("ma_adv_norm", self.ma_adv_norm))
+
+
+class MARWIL(Algorithm):
+    learner_cls = MARWILLearner
+    supports_offline_input = True
+
+    def setup(self):
+        cfg = self.config
+        self._require_offline_only()
+        super().setup()
+        from ray_tpu.rllib.offline import JsonReader
+
+        obs_rows, act_rows, ret_rows = [], [], []
+        for ep in JsonReader(cfg.input_):
+            rewards = np.asarray(ep["rewards"], np.float32)
+            T = len(rewards)
+            if T == 0:
+                continue
+            # Monte-Carlo return-to-go; an episode cut by the horizon (not
+            # terminated) still uses its observed return — offline data has
+            # no bootstrap target (reference marwil postprocessing)
+            returns = np.zeros(T, np.float32)
+            acc = 0.0
+            for t in range(T - 1, -1, -1):
+                acc = rewards[t] + cfg.gamma * acc
+                returns[t] = acc
+            obs_rows.append(np.asarray(ep["obs"], np.float32)[:T])
+            act_rows.append(np.asarray(ep["actions"]))
+            ret_rows.append(returns)
+        if not obs_rows:
+            raise ValueError(f"offline input {cfg.input_!r} contained no transitions")
+        self._obs = np.concatenate(obs_rows)
+        self._actions = np.concatenate(act_rows)
+        self._returns = np.concatenate(ret_rows)
+        self._rng = np.random.default_rng(cfg.seed)
+
+    @property
+    def _learner(self) -> MARWILLearner:
+        return self.learner_group._local
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        metrics: dict = {}
+        for _ in range(cfg.updates_per_iter):
+            idx = self._rng.integers(0, len(self._returns), cfg.train_batch_size)
+            batch = {"obs": self._obs[idx], "actions": self._actions[idx], "returns": self._returns[idx]}
+            metrics = self._learner.update_marwil(batch)
+        result = self._offline_eval_result(metrics, cfg.updates_per_iter)
+        result["dataset_transitions"] = int(len(self._returns))
+        return result

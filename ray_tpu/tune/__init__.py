@@ -12,10 +12,12 @@ from ray_tpu.train._checkpoint import Checkpoint  # noqa: F401
 from ray_tpu.tune.result_grid import ResultGrid
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
+    DistributeResources,
     FIFOScheduler,
     MedianStoppingRule,
     PB2,
     PopulationBasedTraining,
+    ResourceChangingScheduler,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
@@ -58,6 +60,8 @@ __all__ = [
     "OptunaSearch",
     "PB2",
     "PopulationBasedTraining",
+    "ResourceChangingScheduler",
+    "DistributeResources",
     "ResultGrid",
     "Searcher",
     "TPESearcher",

@@ -318,3 +318,136 @@ class PB2(PopulationBasedTraining):
         new = dict(config)
         new.update(self._denormalize(best, config))
         return new
+
+
+class DistributeResources:
+    """Default resources_allocation_function (reference:
+    tune/schedulers/resource_changing_scheduler.py DistributeResources):
+    split the cluster's CPUs evenly across unfinished trials — finished
+    trials release their share, so survivors grow over time.
+
+    The integer remainder goes to the earliest live trials in submission
+    order, NOT to the best-ranked ones (a deliberate deviation from the
+    reference): a metric-rank flip between two trials' reports would make
+    BOTH claim the same slack CPU, and the oversubscribed relaunch could
+    never be placed — deadlocking the experiment. Submission order is
+    stable between reports, so the proposed totals never exceed the
+    cluster."""
+
+    def __init__(self, metric: str | None = None, mode: str = "max"):
+        # metric/mode kept for call-site compatibility with the reference
+        # signature; allocation is metric-independent (see class docstring)
+        self.metric = metric
+        self.mode = mode
+
+    def __call__(self, controller, trial, result: dict) -> dict | None:
+        import ray_tpu
+
+        total = int(ray_tpu.cluster_resources().get("CPU", 1))
+        live = [t for t in controller.trials if not t.is_finished]
+        if not live:
+            return None
+        # while the searcher may still suggest trials, keep one 1-CPU slot
+        # free: growing the lone live trial to the whole cluster would make
+        # a later suggestion unplaceable (and the controller's blocking
+        # poll would never shrink the hog)
+        slots = len(live) if getattr(controller, "_exhausted", True) else len(live) + 1
+        if total < slots:
+            return None
+        base, slack = divmod(total, slots)
+        bonus = 1 if trial in live[:slack] else 0
+        return {"CPU": base + bonus}
+
+
+class ResourceChangingScheduler(TrialScheduler):
+    """Wrap a base scheduler and grow/shrink each trial's resources at
+    checkpoint boundaries (reference:
+    tune/schedulers/resource_changing_scheduler.py).
+
+    Every `reallocate_interval` results per trial, the allocation function
+    proposes a resource dict; if it differs from the trial's current one,
+    the trial is PAUSED (checkpointing it) and relaunched by the
+    controller with the new footprint — the same pause/resume seam PBT
+    exploitation uses, so no new trial-actor machinery."""
+
+    def __init__(self, base_scheduler: TrialScheduler | None = None, resources_allocation_function=None, metric: str | None = None, mode: str | None = None, reallocate_interval: int = 1):
+        # metric/mode default to None (NOT "max") so a base scheduler's own
+        # explicit mode survives construction; the Tuner injects the
+        # experiment's metric/mode into None attributes, which the setters
+        # below then propagate
+        self.base = base_scheduler or FIFOScheduler()
+        self.alloc = resources_allocation_function or DistributeResources(metric, mode or "max")
+        self.interval = max(1, reallocate_interval)
+        self._since: dict[str, int] = {}
+        self.metric = metric  # via the propagating setters below
+        self.mode = mode
+
+    # the Tuner injects its metric/mode into the scheduler when unset
+    # (tuner.py); this wrapper IS the experiment's scheduler, so those
+    # values must reach the wrapped scheduler and the default allocator
+    # too — or a metric-less base ASHA silently no-ops (result.get(None)).
+    # A base constructed with an EXPLICIT metric is treated as fully
+    # self-configured: neither its metric nor its mode is ever overwritten
+    # (the user may deliberately schedule on a different metric than the
+    # experiment reports best on).
+    def _base_self_configured(self) -> bool:
+        return getattr(self.base, "metric", None) is not None and not getattr(self, "_base_adopted", False)
+
+    @property
+    def metric(self):
+        return self._metric
+
+    @metric.setter
+    def metric(self, value):
+        self._metric = value
+        if value is not None:
+            if hasattr(self.base, "metric") and not self._base_self_configured():
+                self.base.metric = value
+                self._base_adopted = True  # keep following wrapper updates
+            if isinstance(self.alloc, DistributeResources):
+                self.alloc.metric = value
+
+    @property
+    def mode(self):
+        return self._mode
+
+    @mode.setter
+    def mode(self, value):
+        self._mode = value
+        if value is not None:
+            if hasattr(self.base, "mode") and not self._base_self_configured():
+                self.base.mode = value
+            if isinstance(self.alloc, DistributeResources):
+                self.alloc.mode = value
+
+    def on_trial_result(self, controller, trial, result):
+        decision = self.base.on_trial_result(controller, trial, result)
+        if decision != CONTINUE:
+            return decision
+        current = trial.resources or controller.resources
+        if not isinstance(current, dict):
+            # PlacementGroupFactory trials gang-reserve a fixed footprint;
+            # _start_trial ignores per-trial overrides there, so pausing
+            # would only burn progress — no-op (the reference's PGF path
+            # rebuilds factories instead; out of scope here)
+            return CONTINUE
+        if trial.checkpoint_path is None:
+            # resizing relaunches from the last checkpoint; without one the
+            # trial would restart from scratch (same guard as PBT exploit)
+            return CONTINUE
+        self._since[trial.trial_id] = self._since.get(trial.trial_id, 0) + 1
+        if self._since[trial.trial_id] < self.interval:
+            return CONTINUE
+        self._since[trial.trial_id] = 0
+        new = self.alloc(controller, trial, result)
+        if new is None:
+            return CONTINUE
+        if new == {k: current.get(k) for k in new}:
+            return CONTINUE
+        # merge: keys the allocator didn't mention (e.g. TPU) keep their
+        # current values rather than being dropped
+        trial.resources = {**current, **new}
+        return PAUSE
+
+    def on_trial_complete(self, controller, trial):
+        self.base.on_trial_complete(controller, trial)

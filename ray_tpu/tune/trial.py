@@ -24,6 +24,9 @@ class Trial:
     iteration: int = 0
     # PBT bookkeeping
     restore_config: dict | None = None
+    # per-trial resource override (ResourceChangingScheduler); None ->
+    # the controller-wide resources_per_trial
+    resources: dict | None = None
 
     @property
     def is_finished(self) -> bool:

@@ -172,6 +172,11 @@ class TuneController:
         """Adopt a saved experiment: live trials resume from their last
         checkpoint; terminal ones keep their results."""
         self.trials = state["trials"]
+        for t in self.trials:
+            # snapshots from before the Trial.resources field unpickle
+            # without it (dataclass __init__ is skipped on unpickle)
+            if not hasattr(t, "resources"):
+                t.resources = None
         self.searcher = state["searcher"]
         if state.get("scheduler") is not None:
             self.scheduler = state["scheduler"]
@@ -285,9 +290,13 @@ class TuneController:
                 opts["num_tpus"] = head["TPU"]
             pg_hex = pg.id.hex()
         else:
-            opts = {"num_cpus": self.resources.get("CPU", 1)}
-            if self.resources.get("TPU"):
-                opts["num_tpus"] = self.resources["TPU"]
+            # per-trial override (ResourceChangingScheduler) wins over the
+            # experiment-wide resources_per_trial; getattr covers Trial
+            # objects unpickled from pre-`resources`-field snapshots
+            res = getattr(trial, "resources", None) or self.resources
+            opts = {"num_cpus": res.get("CPU", 1)}
+            if res.get("TPU"):
+                opts["num_tpus"] = res["TPU"]
             pg_hex = None
         actor = TrialActor.options(**opts).remote(trial.trial_id, self.experiment_name)
         config = trial.restore_config if trial.restore_config else trial.config
