@@ -144,6 +144,14 @@ class Scheduler:
         self._wake.set()
 
     def on_object_sealed(self, obj_id):
+        # lock-free fast path: most seals (puts, task returns nobody waits
+        # on yet) have no registered waiter, and taking the scheduler lock
+        # + waking the dispatch loop per seal dominated put_small in
+        # bench_core. Safe because submit() re-checks store.contains(dep)
+        # UNDER the lock after registering: a seal that misses the index
+        # here is seen by that re-check (dict reads are GIL-atomic).
+        if obj_id not in self._dep_index:
+            return
         with self._lock:
             self._resolve_dep_locked(obj_id)
         self._wake.set()
