@@ -79,13 +79,23 @@ def main():
     }
     sb = shard_batch(data, mesh)
 
+    # loss parity: the sharded+jitted train step must report the SAME loss
+    # an unsharded direct loss_fn eval computes on the initial params —
+    # catches masking/scaling/sharding wiring bugs that a plausibility
+    # range check cannot (an MFU number on a subtly-wrong loss is void)
+    ref_loss = float(jax.jit(partial(loss_fn, config=cfg))(state.params, data))
+    state, metrics = step(state, sb)
+    first_loss = float(metrics["loss"])
+    assert abs(first_loss - ref_loss) < 0.05, (
+        f"sharded step loss {first_loss} != unsharded reference {ref_loss}"
+    )
+
     # warmup/compile. NOTE: on the axon PJRT platform block_until_ready
     # returns without synchronizing, so every sync below is a *host fetch*
     # of a scalar — the only reliable execution barrier here. A scalar
     # fetch costs ~nothing; fetching big arrays would hide compute behind
     # tunnel transfer time (the round-1 failure mode, in both directions).
-    for _ in range(2):
-        state, metrics = step(state, sb)
+    state, metrics = step(state, sb)
     float(metrics["loss"])  # drain the dispatch queue before timing
 
     t0 = time.perf_counter()

@@ -47,6 +47,31 @@ def init(
             if ignore_reinit_error:
                 return context.get_client()
             raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True to allow")
+        # driver attach: explicit address, or RT_HEAD_ADDRESS exported by
+        # the job manager so submitted entrypoints join the RUNNING
+        # cluster (reference: ray.init(address=...) / RAY_ADDRESS)
+        import os as _os
+
+        wants_own_runtime = local_mode or num_cpus is not None or num_tpus is not None or resources
+        if address is not None and wants_own_runtime:
+            # the reference errors on address + resource-arg conflicts too:
+            # an attached driver cannot size or localize the cluster
+            raise ValueError(
+                "init(address=...) attaches to an existing cluster; "
+                "num_cpus/num_tpus/resources/local_mode cannot apply there"
+            )
+        if address is None and not wants_own_runtime:
+            # env-derived attach (jobs) only when the caller didn't ask for
+            # a self-contained runtime — explicit sizing args win over env
+            address = _os.environ.get("RT_HEAD_ADDRESS") or None
+        if address is not None:
+            from ray_tpu.core.driver_client import connect_driver
+
+            client = connect_driver(address)
+            if namespace != "default":
+                client.namespace = namespace
+            context.set_client(client)
+            return client
         res = dict(resources or {})
         if num_cpus is not None:
             res["CPU"] = float(num_cpus)
@@ -66,6 +91,15 @@ def init(
 def shutdown():
     client = context.maybe_client()
     if client is not None and hasattr(client, "shutdown"):
+        # head runtimes only: an attached driver's sparse view must not
+        # clobber the head's usage_stats.json (same session dir)
+        if not getattr(client, "is_driver_attach", False):
+            from ray_tpu.util import usage
+
+            try:
+                usage.write_usage_stats(client)  # no-op unless RT_USAGE_STATS_ENABLED=1
+            except Exception:
+                pass
         client.shutdown()
     context.set_client(None)
 

@@ -1,3 +1,6 @@
+from ray_tpu.util.usage import record_library_usage as _rlu
+
+_rlu("collective")
 from ray_tpu.collective.collective import (  # noqa: F401
     allgather,
     allreduce,

@@ -1,0 +1,164 @@
+"""Driver attach: connect an external process to a running cluster.
+
+Reference parity: ``ray.init(address="auto" | "host:port")`` — the
+driver registers with the control plane and submits work against the
+SHARED cluster (python/ray/_private/worker.py init address handling,
+gcs_client driver registration). The reference's separate "Ray Client"
+(grpc proxy, ray.init("ray://...")) is deprecated there in favor of this
+direct-driver path plus job submission; this module is both in one.
+
+TPU-native/runtime shape: the driver dials the head's AgentListener (the
+same authkey-gated TCP rendezvous ``rt agent`` uses), sends a
+``driver_ready`` hello, and from then on speaks the exact worker RPC
+protocol (core/worker_main.WorkerClient) — put/get/submit/actors/PGs all
+reuse the worker client implementation verbatim; only the recv pump
+differs (drivers execute no tasks). Same-host drivers attach shm
+segments zero-copy from the head namespace; object fetches ride the
+head-as-agent path (_handle_agent_req_local).
+
+Job entrypoints get this automatically: JobManager exports
+``RT_HEAD_ADDRESS``/``RT_HEAD_AUTHKEY`` into the job env, so a plain
+``ray_tpu.init()`` inside a submitted job attaches to the running
+cluster instead of booting a private one.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ray_tpu.core.worker_main import WorkerClient
+
+
+class DriverClient(WorkerClient):
+    """WorkerClient over an attached TCP channel + response pump."""
+
+    is_driver_attach = True
+
+    def __init__(self, conn, welcome: dict):
+        super().__init__(conn, welcome["worker_id"], welcome["node_id"])
+        # session addressing (shm namespaces, session dirs) keys off the
+        # head's pid in this runtime
+        os.environ["RT_SESSION_PID"] = str(welcome["session_pid"])
+        self.namespace = welcome.get("namespace", "default")
+        self._head_down = threading.Event()
+        self._pump = threading.Thread(target=self._recv_loop, daemon=True, name="rt-driver-pump")
+        self._pump.start()
+        from ray_tpu._config import get_config
+        from ray_tpu.core.object_ref import set_ref_counting
+
+        if get_config().object_ref_counting:
+            threading.Thread(target=self._ref_pump_loop, daemon=True, name="rt-ref-pump").start()
+        else:
+            set_ref_counting(False)
+
+    def call(self, method: str, timeout: float | None = None, _kind: str = "req", **params):
+        # fail fast BEFORE registering a slot: after the pump exits there
+        # is nobody left to complete it, and conn.send into a half-closed
+        # socket can still succeed into the kernel buffer
+        if self._shutdown or self._head_down.is_set():
+            raise ConnectionError("driver connection to head lost")
+        return super().call(method, timeout=timeout, _kind=_kind, **params)
+
+    def _recv_loop(self):
+        while not self._shutdown:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                break
+            t = msg.get("type")
+            if t == "resp":
+                self._handle_resp(msg)
+            elif t == "ping":
+                try:
+                    self._send({"type": "pong", "seq": msg.get("seq")})
+                except Exception:
+                    pass
+            elif t == "head_shutdown":
+                break
+        self._head_down.set()
+        self._shutdown = True
+        # fail fast: anyone blocked in call() would otherwise wait forever
+        with self._req_lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for slot in pending:
+            slot[1] = False
+            slot[2] = ConnectionError("driver connection to head lost")
+            slot[0].set()
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        try:
+            self._send({"type": "driver_bye"})
+        except Exception:
+            pass
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
+
+def resolve_address(address: str) -> tuple[tuple[str, int], bytes]:
+    """Resolve an init(address=...) string to ((host, port), authkey).
+
+    - "auto": newest live session's cluster_info.json on this machine
+      (reference: ray.init("auto") via the address file).
+    - "host:port": authkey from RT_HEAD_AUTHKEY (hex) or, same-host, from
+      cluster_info.json when the address matches.
+    """
+    from ray_tpu.util.state import load_latest_cluster_info
+
+    env_key = os.environ.get("RT_HEAD_AUTHKEY", "")
+    if address == "auto":
+        info = load_latest_cluster_info()
+        if info is None:
+            raise ConnectionError("init(address='auto'): no running session found on this machine")
+        return tuple(info["agent_address"]), bytes.fromhex(info["authkey"])
+    host, _, port = address.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"init address must be 'auto' or 'host:port', got {address!r}")
+    addr = (host, int(port))
+    if env_key:
+        return addr, bytes.fromhex(env_key)
+    info = load_latest_cluster_info()
+    if info is not None and tuple(info["agent_address"]) == addr:
+        return addr, bytes.fromhex(info["authkey"])
+    raise ConnectionError(
+        f"init(address={address!r}): no authkey — set RT_HEAD_AUTHKEY (hex from the "
+        "head's cluster_info.json) or run on the head's machine"
+    )
+
+
+def connect_driver(address: str, timeout: float = 30.0) -> DriverClient:
+    from multiprocessing import connection as mp_connection
+
+    addr, authkey = resolve_address(address)
+    conn = mp_connection.Client(tuple(addr), "AF_INET", authkey=authkey)
+    conn.send({"type": "driver_ready", "pid": os.getpid()})
+    if not conn.poll(timeout):
+        conn.close()
+        raise ConnectionError(f"driver attach to {addr} timed out waiting for welcome")
+    welcome = conn.recv()
+    if welcome.get("type") != "driver_welcome":
+        conn.close()
+        raise ConnectionError(f"driver attach to {addr}: unexpected reply {welcome.get('type')!r}")
+    import socket as _socket
+
+    head_host = welcome.get("hostname")
+    if head_host and head_host != _socket.gethostname():
+        # the object plane of an attached driver rides the HEAD host's
+        # /dev/shm namespace; from another machine every non-inline
+        # put/get would fail (and could mark healthy objects lost).
+        # Cross-host work goes through jobs (which run on the head host)
+        # or `rt agent` nodes — refuse loudly instead of corrupting state.
+        conn.close()
+        raise ConnectionError(
+            f"driver attach from {_socket.gethostname()!r} to head on {head_host!r}: "
+            "cross-host driver attach is not supported — submit a job "
+            "(JobSubmissionClient; entrypoints run on the head host) or join "
+            "the machine as a node with `rt agent --address`"
+        )
+    return DriverClient(conn, welcome)

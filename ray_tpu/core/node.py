@@ -324,15 +324,19 @@ class AgentListener:
 
     Spawned agents are matched to their waiting ``RemoteNode`` by node id;
     hellos with unknown node ids go to ``on_join`` (standalone agents
-    started with ``rt agent --address`` on another host)."""
+    started with ``rt agent --address`` on another host). Hellos of type
+    ``driver_ready`` go to ``on_driver`` — external driver processes
+    attaching to the running cluster (reference: ``ray.init(address=...)``
+    joining via GCS; same authkey gate as agents)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, authkey: bytes | None = None, on_join=None):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, authkey: bytes | None = None, on_join=None, on_driver=None):
         from multiprocessing import connection as mp_connection
 
         self.authkey = authkey or __import__("os").urandom(16)
         self._listener = mp_connection.Listener((host, port), "AF_INET", authkey=self.authkey)
         self.address = self._listener.address  # (host, port)
         self.on_join = on_join
+        self.on_driver = on_driver
         self._pending: dict[str, list] = {}  # node_id_hex -> [Event, conn, hello]
         self._lock = threading.Lock()
         self._stopped = False
@@ -367,6 +371,15 @@ class AgentListener:
                 conn.close()
             except Exception:
                 pass
+            return
+        if hello.get("type") == "driver_ready":
+            if self.on_driver is not None:
+                try:
+                    self.on_driver(conn, hello)
+                except Exception:
+                    conn.close()
+            else:
+                conn.close()
             return
         if hello.get("type") != "agent_ready":
             conn.close()

@@ -176,6 +176,7 @@ class Runtime:
                 port=self.cfg.node_manager_port,
                 authkey=self._listener_authkey,
                 on_join=self._on_agent_join,
+                on_driver=self._on_driver_join,
             )
             try:
                 from ray_tpu.util.state import dump_cluster_info
@@ -188,6 +189,8 @@ class Runtime:
         from ray_tpu.core.lock_sanitizer import make_lock
 
         self._nodes_lock = make_lock("runtime.nodes")
+        self._drivers: dict = {}  # attached external drivers (worker_id hex -> handle)
+        self._drivers_lock = threading.Lock()
         self.nodes: dict[NodeID, Node] = {}
         self.actors: dict[ActorID, ActorState] = {}
         self.placement_groups: dict[PlacementGroupID, PlacementGroupState] = {}
@@ -348,6 +351,60 @@ class Runtime:
         if ns and getattr(node, "transfer_addr", None):
             self._ns_addrs.setdefault(ns, node.transfer_addr)
             self._ns_nodes[ns] = node.node_id
+
+    def _on_driver_join(self, conn, hello: dict):
+        """An external driver process attached over the agent listener
+        (reference: ray.init(address=...) joining through the GCS — here
+        the driver speaks the same RPC protocol a worker does, minus task
+        execution). Each driver gets its own recv pump; its ref-count
+        holder entry is dropped on disconnect exactly like a dead
+        worker's."""
+        from ray_tpu.core.ids import WorkerID
+
+        import socket as _socket
+
+        wid = WorkerID.from_random()
+        handle = _DriverHandle(conn, wid)
+        handle.send(
+            {
+                "type": "driver_welcome",
+                "worker_id": wid.hex(),
+                "node_id": self.node_id.hex(),
+                "session_pid": os.getpid(),
+                "namespace": self.namespace,
+                "hostname": _socket.gethostname(),
+            }
+        )
+        # register only after the welcome went through: a dialer that died
+        # mid-handshake must not leave a stale handle behind (the pump's
+        # finally is the sole removal path)
+        with self._drivers_lock:
+            self._drivers[wid.hex()] = handle
+        threading.Thread(
+            target=self._driver_pump, args=(handle,), daemon=True, name=f"rt-driver-{wid.hex()[:8]}"
+        ).start()
+        self.gcs.events.record("driver_attached", worker_id=wid.hex(), pid=hello.get("pid"))
+
+    def _driver_pump(self, handle: "_DriverHandle"):
+        wid_hex = handle.worker_id.hex()
+        try:
+            while not self._stopped:
+                try:
+                    msg = handle.conn.recv()
+                except (EOFError, OSError):
+                    break
+                if msg.get("type") == "driver_bye":
+                    break
+                self._dispatch_client_msg(handle, msg)
+        finally:
+            with self._drivers_lock:
+                self._drivers.pop(wid_hex, None)
+            self._drop_holder(wid_hex)
+            try:
+                handle.conn.close()
+            except Exception:
+                pass
+            self.gcs.events.record("driver_detached", worker_id=wid_hex)
 
     def _on_agent_join(self, conn, hello: dict):
         """A standalone agent (``rt agent --address head:port``, typically
@@ -1663,16 +1720,8 @@ class Runtime:
             self._on_task_done(node, w, msg)
         elif t == "stream_item":
             self._on_stream_item(msg)
-        elif t == "req":
-            self._req_pool.submit(self._handle_client_req, w, msg)
-        elif t == "agent_req":
-            # head-node workers have no agent; the head fills the role
-            # (fetch_object pulls into the head namespace, which head-node
-            # workers share)
-            self._req_pool.submit(self._handle_agent_req_local, w, msg)
-        elif t == "ref_events":
-            # ordered with this worker's done messages (same pipe)
-            self.on_ref_events(w.worker_id.hex(), [(bytes.fromhex(h), reg) for h, reg in msg["events"]])
+        elif self._dispatch_client_msg(w, msg):
+            pass  # shared client-protocol subset (req/agent_req/ref_events)
         elif t == "stack_dump_result":
             with self._dc_lock:
                 slot = self._stack_pending.get(msg.get("req_id"))
@@ -1685,6 +1734,23 @@ class Runtime:
                 slot[0].set()
         elif t == "pong":
             pass
+
+    def _dispatch_client_msg(self, handle, msg: dict) -> bool:
+        """The client-protocol subset shared by worker pipes and attached
+        drivers: req (control-plane RPC), agent_req (the head filling the
+        agent role for same-namespace peers), ref_events (borrow-protocol
+        flushes, ordered with the sender's other messages on one channel).
+        Returns True when handled."""
+        t = msg.get("type")
+        if t == "req":
+            self._req_pool.submit(self._handle_client_req, handle, msg)
+        elif t == "agent_req":
+            self._req_pool.submit(self._handle_agent_req_local, handle, msg)
+        elif t == "ref_events":
+            self.on_ref_events(handle.worker_id.hex(), [(bytes.fromhex(h), reg) for h, reg in msg["events"]])
+        else:
+            return False
+        return True
 
     def _handle_agent_req_local(self, w: WorkerHandle, msg: dict):
         resp = {"type": "resp", "req_id": msg["req_id"], "ok": True, "payload": None, "error": None}
@@ -2214,6 +2280,17 @@ class Runtime:
         t = getattr(self, "_prestart_thread", None)
         if t is not None and t.is_alive():
             t.join(timeout=15.0)
+        with self._drivers_lock:
+            drivers = list(self._drivers.values())
+        for d in drivers:
+            try:
+                d.send({"type": "head_shutdown"})
+            except Exception:
+                pass
+            try:
+                d.conn.close()
+            except Exception:
+                pass
         for node in list(self.nodes.values()):
             node.shutdown()
         self.store.shutdown()
@@ -2349,3 +2426,22 @@ def _picklable_error(e: BaseException) -> BaseException:
         return TaskError(cause=None, tb_str=str(e), task_desc="rpc")
 
 
+
+
+class _DriverHandle:
+    """Head-side record of an attached external driver: just enough of
+    WorkerHandle's surface (send + worker_id) for _handle_client_req and
+    the ref-event plumbing (reference: the GCS's registered-driver table,
+    gcs_job_manager; drivers here are protocol peers, never execution
+    targets)."""
+
+    __slots__ = ("conn", "worker_id", "_send_lock")
+
+    def __init__(self, conn, worker_id):
+        self.conn = conn
+        self.worker_id = worker_id
+        self._send_lock = threading.Lock()
+
+    def send(self, msg: dict):
+        with self._send_lock:
+            self.conn.send(msg)

@@ -6,6 +6,10 @@ by a pull-driven streaming pipeline with bounded in-flight windows;
 feeds ray_tpu.train via streaming_split / get_dataset_shard.
 """
 
+from ray_tpu.util.usage import record_library_usage as _rlu
+
+_rlu("data")
+
 from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data.dataset import (
     Dataset,

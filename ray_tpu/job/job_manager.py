@@ -93,6 +93,18 @@ class JobManager:
         renv = runtime_env or {}
         env.update({str(k): str(v) for k, v in (renv.get("env_vars") or {}).items()})
         env["RT_JOB_SUBMISSION_ID"] = job_id
+        # export the attach credentials so the entrypoint's plain
+        # ray_tpu.init() joins THIS cluster as a driver instead of booting
+        # a private head (reference: job supervisor sets RAY_ADDRESS)
+        try:
+            import json as _json
+
+            with open(os.path.join(_session_dir(), "cluster_info.json")) as f:
+                ci = _json.load(f)
+            env["RT_HEAD_ADDRESS"] = f"{ci['agent_address'][0]}:{ci['agent_address'][1]}"
+            env["RT_HEAD_AUTHKEY"] = ci["authkey"]
+        except Exception:
+            pass  # local_mode / no listener: jobs run self-contained
         cwd = renv.get("working_dir") if renv.get("working_dir") and os.path.isdir(renv["working_dir"]) else None
 
         def run():

@@ -7,6 +7,10 @@ kv_cache.py). Serve integration (batched LLM deployments with
 autoscaling replicas) lives in ray_tpu.serve.llm.
 """
 
+from ray_tpu.util.usage import record_library_usage as _rlu
+
+_rlu("llm")
+
 from ray_tpu.llm.engine import LLMEngine, RequestOutput
 from ray_tpu.llm.sampling import SamplingParams
 

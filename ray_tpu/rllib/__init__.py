@@ -13,6 +13,10 @@ Public surface:
   - RLModule: functional JAX policy/value modules
 """
 
+from ray_tpu.util.usage import record_library_usage as _rlu
+
+_rlu("rllib")
+
 from ray_tpu.rllib.algorithms.algorithm import Algorithm  # noqa: F401
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig  # noqa: F401

@@ -6,6 +6,10 @@ replicas pin TPU resources and keep a warm JAX engine (see
 ray_tpu.serve.llm for the LLM deployment builder).
 """
 
+from ray_tpu.util.usage import record_library_usage as _rlu
+
+_rlu("serve")
+
 from ray_tpu.serve.api import (
     delete,
     get_app_handle,

@@ -8,6 +8,10 @@ configs, not wrapper modules (reference equivalents:
 train/v2/api/data_parallel_trainer.py, train/v2/jax/jax_trainer.py:19).
 """
 
+from ray_tpu.util.usage import record_library_usage as _rlu
+
+_rlu("train")
+
 from ray_tpu.train._checkpoint import Checkpoint
 from ray_tpu.train.config import (
     CheckpointConfig,

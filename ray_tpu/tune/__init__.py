@@ -6,6 +6,10 @@ Optuna), schedulers (ASHA, PBT, median stopping), experiment checkpoints,
 Train integration (Tuner(trainer)).
 """
 
+from ray_tpu.util.usage import record_library_usage as _rlu
+
+_rlu("tune")
+
 from ray_tpu.train.session import report  # shared session API  # noqa: F401
 from ray_tpu.train.session import get_checkpoint  # noqa: F401
 from ray_tpu.train._checkpoint import Checkpoint  # noqa: F401

@@ -1,0 +1,106 @@
+"""Usage stats: local-only, opt-in telemetry.
+
+Reference parity: python/ray/_common/usage/usage_lib.py — the reference
+collects cluster metadata, library usage markers, and extra tags, writes
+them to ``usage_stats.json`` in the session dir, and (when enabled)
+reports them to a telemetry endpoint.
+
+TPU-native/no-egress shape: collection is OPT-IN via
+``RT_USAGE_STATS_ENABLED=1`` and the report NEVER leaves the machine —
+``usage_stats.json`` lands in the session dir for operators who want a
+machine-readable record of what ran (versions, cluster shape, which
+libraries were imported). There is no phone-home code path at all; this
+module exists so tooling built against the reference's usage schema has
+a local equivalent to read.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import sys
+import threading
+import time
+
+SCHEMA_VERSION = "0.1"
+
+_lock = threading.Lock()
+_library_usages: set[str] = set()
+_extra_tags: dict[str, str] = {}
+_session_start_ms = int(time.time() * 1000)
+
+
+def usage_stats_enabled() -> bool:
+    """Disabled unless RT_USAGE_STATS_ENABLED=1 — the inverse of the
+    reference's on-by-default posture, because there is no prompt flow
+    here and silent collection is the wrong default for a library."""
+    return os.environ.get("RT_USAGE_STATS_ENABLED", "0") == "1"
+
+
+def record_library_usage(library: str):
+    """Mark a library as used this session (reference:
+    usage_lib.record_library_usage — called from lib __init__s)."""
+    with _lock:
+        _library_usages.add(str(library))
+
+
+def record_extra_usage_tag(key: str, value: str):
+    """Attach a custom key=value to the report (reference:
+    usage_lib.record_extra_usage_tag / TagKey)."""
+    with _lock:
+        _extra_tags[str(key)] = str(value)
+
+
+def _cluster_shape(client) -> dict:
+    try:
+        total = client.cluster_info("cluster_resources")
+        nodes = client.cluster_info("nodes")
+    except Exception:
+        return {}
+    return {
+        "total_num_cpus": total.get("CPU"),
+        "total_num_tpus": total.get("TPU"),
+        "total_memory_gb": round(total.get("memory", 0) / (1 << 30), 2) or None,
+        "total_num_nodes": len(nodes),
+    }
+
+
+def generate_report_data(client=None) -> dict:
+    """Build the report dict (reference: usage_lib.generate_report_data,
+    UsageStatsToReport fields — the locally-meaningful subset)."""
+    import ray_tpu
+
+    with _lock:
+        libs = sorted(_library_usages)
+        tags = dict(_extra_tags)
+    data = {
+        "schema_version": SCHEMA_VERSION,
+        "source": "LOCAL",  # never reported anywhere
+        "collect_timestamp_ms": int(time.time() * 1000),
+        "session_start_timestamp_ms": _session_start_ms,
+        "ray_tpu_version": getattr(ray_tpu, "__version__", "0.0.0"),
+        "python_version": platform.python_version(),
+        "os": sys.platform,
+        "library_usages": libs,
+        "extra_usage_tags": tags,
+    }
+    if client is not None:
+        data.update(_cluster_shape(client))
+    return data
+
+
+def write_usage_stats(client=None, path: str | None = None) -> str | None:
+    """Write usage_stats.json into the session dir (reference:
+    UsageStatsToWrite / _write_usage_data). No-op unless enabled."""
+    if not usage_stats_enabled():
+        return None
+    from ray_tpu.util.state import session_dir
+
+    out = path or os.path.join(session_dir(), "usage_stats.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(generate_report_data(client), f, indent=1)
+    os.replace(tmp, out)
+    return out

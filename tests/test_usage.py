@@ -1,0 +1,48 @@
+"""Usage stats: local-only, opt-in (reference test strategy:
+python/ray/tests/test_usage_stats.py — enabledness gating, library
+markers, report schema)."""
+
+import json
+import os
+
+import pytest
+
+
+def test_disabled_by_default(monkeypatch, tmp_path):
+    from ray_tpu.util import usage
+
+    monkeypatch.delenv("RT_USAGE_STATS_ENABLED", raising=False)
+    assert not usage.usage_stats_enabled()
+    assert usage.write_usage_stats(path=str(tmp_path / "u.json")) is None
+    assert not (tmp_path / "u.json").exists()
+
+
+def test_report_schema_and_library_markers(monkeypatch, tmp_path):
+    import ray_tpu.data  # noqa: F401 — registers the "data" marker
+    import ray_tpu.tune  # noqa: F401
+    from ray_tpu.util import usage
+
+    monkeypatch.setenv("RT_USAGE_STATS_ENABLED", "1")
+    usage.record_extra_usage_tag("test_tag", "42")
+    out = usage.write_usage_stats(path=str(tmp_path / "usage_stats.json"))
+    data = json.load(open(out))
+    assert data["schema_version"]
+    assert data["source"] == "LOCAL"
+    assert "data" in data["library_usages"] and "tune" in data["library_usages"]
+    assert data["extra_usage_tags"]["test_tag"] == "42"
+    assert data["python_version"].count(".") == 2
+
+
+def test_shutdown_writes_report_with_cluster_shape(monkeypatch):
+    import ray_tpu
+    from ray_tpu.util.state import session_dir
+
+    monkeypatch.setenv("RT_USAGE_STATS_ENABLED", "1")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    path = os.path.join(session_dir(), "usage_stats.json")
+    ray_tpu.shutdown()
+    assert os.path.exists(path)
+    data = json.load(open(path))
+    assert data["total_num_cpus"] == 2
+    assert data["total_num_nodes"] >= 1
