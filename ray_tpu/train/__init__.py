@@ -30,6 +30,7 @@ from ray_tpu.train.scaling_policy import (
     ScalingPolicy,
 )
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, TorchTrainer
+from ray_tpu.train.gbdt import GBDTTrainer, HistGBDT, LightGBMTrainer, XGBoostTrainer
 from ray_tpu.train.errors import TrainingFailedError
 from ray_tpu.train import torch_utils as torch  # train.torch.prepare_model (reference API shape)
 
@@ -44,7 +45,11 @@ __all__ = [
     "CheckpointConfig",
     "DataParallelTrainer",
     "FailureConfig",
+    "GBDTTrainer",
+    "HistGBDT",
     "JaxTrainer",
+    "LightGBMTrainer",
+    "XGBoostTrainer",
     "Result",
     "RunConfig",
     "ScalingConfig",

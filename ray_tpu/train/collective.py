@@ -60,3 +60,10 @@ def _unpickle(b: bytes):
     import pickle
 
     return pickle.loads(b)
+
+
+def allreduce(array):
+    """Elementwise SUM-allreduce of a numpy array across the train worker
+    group (reference: the rabit allreduce xgboost's hist method rides in
+    train/xgboost; here the GBDT trainer's histogram sync)."""
+    return col.allreduce(array, group_name=_ensure_group())
