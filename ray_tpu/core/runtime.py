@@ -191,6 +191,10 @@ class Runtime:
         self._nodes_lock = make_lock("runtime.nodes")
         self._drivers: dict = {}  # attached external drivers (worker_id hex -> handle)
         self._drivers_lock = threading.Lock()
+        # dead-worker pipes waiting for the io loop to close them (see
+        # _retire_conn: fd-reuse vs mp_connection.wait)
+        self._conn_graveyard: list = []
+        self._conn_graveyard_lock = threading.Lock()
         self.nodes: dict[NodeID, Node] = {}
         self.actors: dict[ActorID, ActorState] = {}
         self.placement_groups: dict[PlacementGroupID, PlacementGroupState] = {}
@@ -1445,8 +1449,35 @@ class Runtime:
     # ------------------------------------------------------------------
     # worker IO loop
     # ------------------------------------------------------------------
+    def _retire_conn(self, conn):
+        """Queue a dead worker's pipe for closing ON the io-loop thread.
+
+        Closing it here (possibly from a kill/submit-failure thread) frees
+        the fd while the io loop's current mp_connection.wait() may still
+        list this Connection; a NEW worker's pipe can then be allocated
+        the SAME fd number, and the stale Connection object steals the new
+        worker's bytes — the head misreads the framing and declares a
+        perfectly healthy worker dead (observed as a second Trainer.fit
+        dying with 'worker process exited' while the process lived on).
+        Only the io loop closes pipes it waits on."""
+        with self._conn_graveyard_lock:
+            self._conn_graveyard.append(conn)
+
+    def _drain_conn_graveyard(self):
+        with self._conn_graveyard_lock:
+            conns, self._conn_graveyard = self._conn_graveyard, []
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+
     def _io_loop(self):
         while not self._stopped:
+            # safe point: the previous wait() has returned, so no listed
+            # fd is still being polled — dead pipes can close without
+            # their fd numbers being reused under the poll
+            self._drain_conn_graveyard()
             conn_map = {}
             for node in self.node_list():
                 if getattr(node, "remote", False):
@@ -1478,7 +1509,19 @@ class Runtime:
                 try:
                     msg = c.recv()
                 except (EOFError, OSError):
-                    self._on_worker_death(node, w, "worker process exited")
+                    # a broken channel from a STILL-LIVE process (observed
+                    # after a sibling worker segfaults mid-read) must not
+                    # leave a zombie holding an actor: kill it so the
+                    # death handling below matches reality
+                    if w.proc.is_alive():
+                        try:
+                            w.proc.terminate()
+                        except Exception:
+                            pass
+                        reason = "worker channel broke (process terminated)"
+                    else:
+                        reason = "worker process exited"
+                    self._on_worker_death(node, w, reason)
                     continue
                 except Exception:
                     logger.exception("bad message from worker")
@@ -1902,10 +1945,7 @@ class Runtime:
             anode.return_tpu_chips(chips)
         w.state = "dead"
         node.remove_worker(w.worker_id)
-        try:
-            w.conn.close()
-        except Exception:
-            pass
+        self._retire_conn(w.conn)
         self.scheduler.wake()
 
     # ---- worker death / actor restart ----
@@ -1919,10 +1959,7 @@ class Runtime:
         was_actor = w.state == "actor"
         w.state = "dead"
         node.remove_worker(w.worker_id)
-        try:
-            w.conn.close()
-        except Exception:
-            pass
+        self._retire_conn(w.conn)
         running = dict(w.running_tasks)
         w.running_tasks.clear()
         for task_id, (spec, allocation) in running.items():
@@ -2298,6 +2335,7 @@ class Runtime:
             self._agent_listener.shutdown()
         if getattr(self, "_transfer_server", None) is not None:
             self._transfer_server.shutdown()
+        self._drain_conn_graveyard()  # io loop is stopped; close stragglers
         from ray_tpu.core import object_store as _os_mod
 
         _os_mod.set_fetch_hook(None)

@@ -146,14 +146,15 @@ class Scheduler:
     def on_object_sealed(self, obj_id):
         # lock-free fast path: most seals (puts, task returns nobody waits
         # on yet) have no registered waiter, and taking the scheduler lock
-        # + waking the dispatch loop per seal dominated put_small in
-        # bench_core. Safe because submit() re-checks store.contains(dep)
-        # UNDER the lock after registering: a seal that misses the index
-        # here is seen by that re-check (dict reads are GIL-atomic).
-        if obj_id not in self._dep_index:
-            return
-        with self._lock:
-            self._resolve_dep_locked(obj_id)
+        # per seal dominated put_small in bench_core. Safe because
+        # submit() re-checks store.contains(dep) UNDER the lock after
+        # registering: a seal that misses the index here is seen by that
+        # re-check (dict reads are GIL-atomic). The wake stays
+        # unconditional: it is cheap once set, and dispatch latency should
+        # not regress to the loop's 100ms poll between seals.
+        if obj_id in self._dep_index:
+            with self._lock:
+                self._resolve_dep_locked(obj_id)
         self._wake.set()
 
     def _resolve_dep_locked(self, obj_id):
