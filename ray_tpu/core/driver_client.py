@@ -52,32 +52,14 @@ class DriverClient(WorkerClient):
         else:
             set_ref_counting(False)
 
-    def call(self, method: str, timeout: float | None = None, _kind: str = "req", **params):
-        # Registration and the liveness check happen under the SAME lock
-        # the pump's fail-fast flush takes: a slot can only be registered
-        # while the pump is still alive to complete (or fail) it, closing
-        # the race where a call lands between the pump's exit and its
-        # pending-flush and then waits forever on a slot nobody owns.
-        with self._req_lock:
-            if self._shutdown or self._head_down.is_set():
-                raise ConnectionError("driver connection to head lost")
-            self._req_seq += 1
-            req_id = self._req_seq
-            slot = [threading.Event(), False, None]
-            self._pending[req_id] = slot
-        try:
-            self._send({"type": _kind, "req_id": req_id, "method": method, "params": params})
-        except Exception as e:
-            with self._req_lock:
-                self._pending.pop(req_id, None)
-            raise ConnectionError(f"driver connection to head lost: {e}") from e
-        if not slot[0].wait(timeout=timeout):
-            with self._req_lock:
-                self._pending.pop(req_id, None)
-            raise TimeoutError(f"driver RPC {method} timed out")
-        if not slot[1]:
-            raise slot[2]
-        return slot[2]
+    def _check_alive_locked(self):
+        # Runs under the SAME lock the pump's fail-fast flush takes: a
+        # slot can only be registered while the pump is still alive to
+        # complete (or fail) it, closing the race where a call lands
+        # between the pump's exit and its pending-flush and then waits
+        # forever on a slot nobody owns.
+        if self._shutdown or self._head_down.is_set():
+            raise ConnectionError("driver connection to head lost")
 
     def _recv_loop(self):
         while not self._shutdown:

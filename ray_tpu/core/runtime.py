@@ -1285,15 +1285,24 @@ class Runtime:
                 if wkey == renv_key or wkey is None:
                     idle.append(w)
             idle.sort(key=lambda w: w.env_binding.get("runtime_env") != renv_key)
-            if chips:
-                # chip-isolation env must be set before the worker can ever
-                # import jax: only never-used workers qualify
+            if chips or spec.is_actor_creation:
+                # never-used workers only: chip-isolation env must precede
+                # any jax import, and actors get a dedicated fresh process
+                # (reference parity: the raylet does not recycle task
+                # workers into actors). The actor rule is load-bearing
+                # here too: an actor placed on a worker that previously
+                # executed Data block tasks intermittently segfaulted in
+                # pyarrow reading its dataset shard (the second-fit crash;
+                # tests/test_train.py::test_second_dataset_fit_same_session).
                 idle = [w for w in idle if w.fresh]
             if not idle:
                 starting = sum(1 for w in node.workers.values() if w.state == "starting")
                 nonactor = sum(1 for w in node.workers.values() if w.state in ("starting", "idle", "busy"))
                 limit = int(node.total_resources.get("CPU", 1)) + self._worker_count_limit_extra
-                if (nonactor < limit or chips) and starting < len(node.dispatch_queue):
+                # actor creations (like chip-bound tasks) need a FRESH
+                # worker and may find the pool full of used idle ones —
+                # they must be allowed to spawn past the soft limit
+                if (nonactor < limit or chips or spec.is_actor_creation) and starting < len(node.dispatch_queue):
                     try:
                         node.start_worker()
                     except RuntimeError:
@@ -1327,7 +1336,7 @@ class Runtime:
                         x
                         for x in idle
                         if x.state == "idle"
-                        and (not chips or x.fresh)
+                        and (not (chips or spec.is_actor_creation) or x.fresh)
                         and "TPU_VISIBLE_CHIPS" not in x.env_binding
                         and x.env_binding.get("runtime_env") in (renv_key, None)
                     ),
@@ -1495,6 +1504,13 @@ class Runtime:
                 continue
             for c in ready:
                 node, w = conn_map[c]
+                if w is not None and w.state == "dead":
+                    # died (on another thread) after this wait() started:
+                    # its conn is graveyarded but still open, so buffered
+                    # messages would otherwise be applied for a holder
+                    # whose state was already dropped (e.g. ref_events
+                    # re-registering borrows after _drop_holder)
+                    continue
                 if w is None:  # node-agent socket
                     try:
                         msg = c.recv()
@@ -2335,7 +2351,13 @@ class Runtime:
             self._agent_listener.shutdown()
         if getattr(self, "_transfer_server", None) is not None:
             self._transfer_server.shutdown()
-        self._drain_conn_graveyard()  # io loop is stopped; close stragglers
+        t_io = getattr(self, "_io_thread", None)
+        if t_io is not None and t_io.is_alive():
+            # the loop exits within ~70ms of _stopped; closing graveyarded
+            # conns while its current wait() still lists them would recreate
+            # the fd-reuse hazard _retire_conn exists to prevent
+            t_io.join(timeout=2.0)
+        self._drain_conn_graveyard()
         from ray_tpu.core import object_store as _os_mod
 
         _os_mod.set_fetch_hook(None)

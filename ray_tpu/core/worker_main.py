@@ -84,13 +84,24 @@ class WorkerClient:
         with self._send_lock:
             self.conn.send(msg)
 
+    def _check_alive_locked(self):
+        """Called under _req_lock before registering a request slot;
+        subclasses whose response pump can die (DriverClient) raise here
+        so no slot is ever registered with nobody left to complete it."""
+
     def call(self, method: str, timeout: float | None = None, _kind: str = "req", **params):
         with self._req_lock:
+            self._check_alive_locked()
             self._req_seq += 1
             req_id = self._req_seq
             slot = [threading.Event(), False, None]
             self._pending[req_id] = slot
-        self._send({"type": _kind, "req_id": req_id, "method": method, "params": params})
+        try:
+            self._send({"type": _kind, "req_id": req_id, "method": method, "params": params})
+        except Exception:
+            with self._req_lock:
+                self._pending.pop(req_id, None)
+            raise
         if not slot[0].wait(timeout=timeout):
             with self._req_lock:
                 self._pending.pop(req_id, None)

@@ -341,18 +341,14 @@ def test_elastic_scaling_grows_group_when_node_joins(tmp_path):
         ray_tpu.shutdown()
 
 
-@pytest.mark.skip(
-    reason="KNOWN ISSUE: the second dataset-fed Trainer.fit in one session "
-    "intermittently (~50%) segfaults a train worker inside the pyarrow "
-    "block read (block.py to_numpy) and cascades into false worker-death "
-    "diagnoses. Pre-existing since round 3 (reproduces at 0e665da). "
-    "Root cause not yet isolated: ruled out shm frees (no FREE_SHM at "
-    "crash), object-id collisions, zero-copy decode (copying decode "
-    "still crashes), refcount frees (keeping ds0 alive still crashes), "
-    "and the memory monitor. Workaround: one dataset-fed fit per "
-    "session, or shutdown/init between fits (see test_gbdt.py)."
-)
 def test_second_dataset_fit_same_session(rt_start, tmp_path):
+    """Regression: the second dataset-fed fit in one session used to
+    segfault a train worker ~50% of the time inside the pyarrow block
+    read (pre-existing since round 3; reproduces at 0e665da). The
+    trigger was the train actor being placed on a RECYCLED worker that
+    had previously executed Data block tasks — fixed by giving actors a
+    never-used worker process (reference parity: the raylet dedicates a
+    fresh worker per actor). See runtime._dispatch_node."""
     from ray_tpu import data as rd
     from ray_tpu.train import DataParallelTrainer, RunConfig, ScalingConfig
 
