@@ -119,6 +119,16 @@ class Config:
     # Format: "method1=N,method2=M" — fail the first N calls of method1.
     testing_rpc_failure: str = ""
 
+    # --- direct call plane (ownership model; core/direct.py) ---
+    # Caller->worker direct actor calls, worker leases for stateless tasks
+    # and owner-local small objects (reference: reference_counter.h
+    # per-owner metadata + cluster_lease_manager.h lease scheduling).
+    # RT_DIRECT_CALLS=0 routes everything through the head (round-3 mode).
+    direct_calls: bool = True
+    # Seconds an owned object lingers after its last reference drops
+    # (absorbs the async borrow-registration race).
+    owned_object_grace_s: float = 1.0
+
     # --- collective / mesh ---
     collective_timeout_s: float = 120.0
 

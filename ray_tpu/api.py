@@ -152,7 +152,12 @@ def wait(refs, *, num_returns: int = 1, timeout: float | None = None, fetch_loca
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
-    _auto_init().cancel_task(ref.id, force=force)
+    from ray_tpu.core import direct as _direct
+
+    client = _auto_init()
+    if _direct.cancel_owned(client, ref.id, force=force):
+        return  # direct-plane call: cancel delivered to its worker
+    client.cancel_task(ref.id, force=force)
 
 
 def internal_free(refs):
@@ -214,19 +219,32 @@ def _check_options(opts: dict):
 
 
 def _encode_args(args, kwargs):
-    arg_specs = []
-    for a in args:
+    """Encode call arguments into ArgSpecs. Owned refs (direct call plane)
+    are tagged with their owner address so the executing worker pulls them
+    straight from the owner; `pins` are live ObjectRefs held until the call
+    completes (the caller-side analogue of the head's pin_spec_args)."""
+    from ray_tpu.core import direct as _direct
+
+    pins = []
+
+    def one(a):
         if isinstance(a, ObjectRef):
-            arg_specs.append(ArgSpec(ref=a.id))
-        else:
-            arg_specs.append(ArgSpec(payload=encode_value(a)))
-    kw_specs = {}
-    for k, v in (kwargs or {}).items():
-        if isinstance(v, ObjectRef):
-            kw_specs[k] = ArgSpec(ref=v.id)
-        else:
-            kw_specs[k] = ArgSpec(payload=encode_value(v))
-    return arg_specs, kw_specs
+            pins.append(a)
+            k = a.id.binary()
+            st = _direct.state()
+            if st is not None and st.owned.owns(k):
+                owner = st.self_owner
+            else:
+                owner = _direct.get_hint(k)
+            return ArgSpec(ref=a.id, owner=owner)
+        payload = encode_value(a)
+        for c in payload.contained or []:
+            pins.append(ObjectRef(c))
+        return ArgSpec(payload=payload)
+
+    arg_specs = [one(a) for a in args]
+    kw_specs = {k: one(v) for k, v in (kwargs or {}).items()}
+    return arg_specs, kw_specs, pins
 
 
 def _num_returns(opts, default=1):
@@ -270,19 +288,39 @@ class RemoteFunction:
         return rf
 
     def remote(self, *args, **kwargs):
+        from ray_tpu.core import direct as _direct
+
         client = _auto_init()
         blob = self._ensure_registered(client)
-        arg_specs, kw_specs = _encode_args(args, kwargs)
+        name = getattr(self._fn, "__name__", "task")
         num_returns, streaming = _num_returns(self._options)
+        opts = _with_trace(self._options, name)
+        if not streaming and _direct.state() is not None:
+            # direct plane fast path: plain args ride the frame as one
+            # pickle — no per-arg encoding at all (core/direct.py)
+            packed = _direct.pack_raw(args, kwargs)
+            if packed is not None:
+                raw, rpins = packed
+                refs = _direct.try_task_call(client, name, self._func_id, self._blob, None, None, opts, pins=rpins, raw=raw)
+                if refs is not None:
+                    return refs[0] if num_returns == 1 else refs
+        arg_specs, kw_specs, pins = _encode_args(args, kwargs)
+        if not streaming:
+            # direct plane: stream the task onto a leased worker, head out
+            # of the loop (returns None -> head path)
+            refs = _direct.try_task_call(client, name, self._func_id, self._blob, arg_specs, kw_specs, opts, pins=pins)
+            if refs is not None:
+                return refs[0] if num_returns == 1 else refs
+        _direct.promote_argspecs(client, arg_specs, kw_specs)
         ids = client.submit_task(
-            name=getattr(self._fn, "__name__", "task"),
+            name=name,
             func_id=self._func_id,
             args=arg_specs,
             kwargs=kw_specs,
             num_returns=num_returns,
             streaming=streaming,
             func_blob=blob,
-            options=_with_trace(self._options, getattr(self._fn, "__name__", "task")),
+            options=opts,
         )
         if hasattr(client, "mark_function_sent"):
             client.mark_function_sent(self._func_id)
@@ -313,9 +351,29 @@ class ActorMethod:
         return ActorMethod(self._handle, self._name, {**self._options, **opts})
 
     def remote(self, *args, **kwargs):
+        from ray_tpu.core import direct as _direct
+
         client = _auto_init()
-        arg_specs, kw_specs = _encode_args(args, kwargs)
         num_returns, streaming = _num_returns(self._options)
+        opts = _with_trace(self._options, self._name)
+        if not streaming and _direct.state() is not None:
+            # direct plane fast path: plain args ride the frame directly
+            packed = _direct.pack_raw(args, kwargs)
+            if packed is not None:
+                raw, rpins = packed
+                refs = _direct.try_actor_call(client, self._handle._actor_id, self._name, None, None, opts, pins=rpins, raw=raw)
+                if refs is not None:
+                    return refs[0] if num_returns == 1 else refs
+        arg_specs, kw_specs, pins = _encode_args(args, kwargs)
+        if not streaming:
+            # direct plane: straight to the actor's worker (core/direct.py)
+            refs = _direct.try_actor_call(client, self._handle._actor_id, self._name, arg_specs, kw_specs, opts, pins=pins)
+            if refs is not None:
+                return refs[0] if num_returns == 1 else refs
+        # head path: owned args move to the head store first, and the
+        # direct lane drains so per-caller ordering holds across lanes
+        _direct.promote_argspecs(client, arg_specs, kw_specs)
+        _direct.head_lane_submit(self._handle._actor_id)
         ids = client.submit_actor_task(
             actor_id=self._handle._actor_id,
             method_name=self._name,
@@ -323,7 +381,7 @@ class ActorMethod:
             kwargs=kw_specs,
             num_returns=num_returns,
             streaming=streaming,
-            options=_with_trace(self._options, self._name),
+            options=opts,
         )
         if streaming:
             return ObjectRefGenerator(ids[0])
@@ -397,9 +455,12 @@ class ActorClass:
         return None
 
     def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu.core import direct as _direct
+
         client = _auto_init()
         blob = self._ensure_registered(client)
-        arg_specs, kw_specs = _encode_args(args, kwargs)
+        arg_specs, kw_specs, _pins = _encode_args(args, kwargs)
+        _direct.promote_argspecs(client, arg_specs, kw_specs)  # creation is head-path
         opts = dict(self._options)
         if any(inspect.iscoroutinefunction(m) for _, m in inspect.getmembers(self._cls, inspect.isfunction)):
             opts.setdefault("max_concurrency", 8)

@@ -1,0 +1,1747 @@
+"""Direct call plane: ownership-based metadata + caller->worker RPC that
+keeps the head out of the hot path.
+
+This is the TPU-native equivalent of the reference's ownership model:
+
+- Small objects live in the OWNER process (the process that created them),
+  not in a central store; the owner serves gets, counts borrows, and frees
+  on last release (reference: src/ray/core_worker/reference_counter.h:44
+  per-owner refcounts; src/ray/object_manager/ownership_object_directory.cc
+  owner-directed lookup; the reference keeps returns < 100KB "in the
+  owner's in-process store").
+- Actor calls go straight from the caller to the actor's worker process on
+  a persistent authenticated TCP connection; the head only answers the
+  one-time endpoint lookup and handles failure cleanup (reference:
+  direct actor call path of core_worker's ActorTaskSubmitter).
+- Stateless tasks use worker LEASES: the caller asks the head for a leased
+  worker once, then streams task executions to it directly (reference:
+  src/ray/raylet/scheduling/cluster_lease_manager.h:41 lease-based
+  scheduling; normal_task_submitter.h pipelining onto a leased worker).
+
+The head path remains for everything constrained (placement groups,
+runtime_env, streaming generators, labels, TPU resources) and is the
+fallback on ANY direct-path failure, so semantics degrade to round-3
+behavior rather than erroring.
+
+Wire protocol: length-prefixed pickled dicts over TCP with the cluster's
+HMAC challenge/response auth (same scheme as core/transport.py).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+from ray_tpu.core.ids import ObjectID, TaskID
+from ray_tpu.core.object_ref import ObjectRef as _ObjRef
+from ray_tpu.core.task_spec import ArgSpec, Payload
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+_MAX_FRAME = 256 << 20
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+def _send_frame(sock: socket.socket, data: bytes, lock: threading.Lock):
+    with lock:
+        sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv_exact(rf, n: int) -> bytes:
+    data = rf.read(n)
+    if data is None or len(data) < n:
+        raise ConnectionError("direct peer closed")
+    return data
+
+
+def _recv_frame(rf) -> dict:
+    (n,) = struct.unpack("<I", _recv_exact(rf, 4))
+    if n > _MAX_FRAME:
+        raise ConnectionError("oversized direct frame")
+    return pickle.loads(_recv_exact(rf, n))
+
+
+def _dumps(msg: dict) -> bytes:
+    return pickle.dumps(msg, protocol=5)
+
+
+def _auth_server(sock: socket.socket, authkey: bytes):
+    import hmac
+
+    lock = threading.Lock()
+    challenge = os.urandom(20)
+    _send_frame(sock, challenge, lock)
+    rf = sock.makefile("rb")
+    (n,) = struct.unpack("<I", _recv_exact(rf, 4))
+    resp = _recv_exact(rf, n)
+    if not hmac.compare_digest(resp, hmac.new(authkey, challenge, "sha256").digest()):
+        raise ConnectionError("direct auth failed")
+    _send_frame(sock, b"OK", lock)
+    return rf
+
+
+def _auth_client(sock: socket.socket, authkey: bytes):
+    import hmac
+
+    lock = threading.Lock()
+    rf = sock.makefile("rb")
+    (n,) = struct.unpack("<I", _recv_exact(rf, 4))
+    challenge = _recv_exact(rf, n)
+    _send_frame(sock, hmac.new(authkey, challenge, "sha256").digest(), lock)
+    (n,) = struct.unpack("<I", _recv_exact(rf, 4))
+    if _recv_exact(rf, n) != b"OK":
+        raise ConnectionError("direct auth rejected")
+    return rf
+
+
+# ---------------------------------------------------------------------------
+# owned object store (per process)
+# ---------------------------------------------------------------------------
+PENDING, READY, VALUE, ERROR, REDIRECT = range(5)
+
+
+class _Entry:
+    __slots__ = ("state", "payload", "value", "error", "event", "borrows", "zero_since", "callbacks", "contained")
+
+    def __init__(self, state: int):
+        self.state = state
+        self.payload = None
+        self.value = None
+        self.error = None
+        self.event = threading.Event() if state == PENDING else None
+        self.borrows = 0
+        self.zero_since = None  # monotonic ts when local count hit 0
+        self.callbacks = None
+        # live ObjectRefs pickled inside this value: the entry pins them
+        # while it lives, releasing on free (cascading GC — the owned-store
+        # analogue of the head store's contained_refs wrapping)
+        self.contained = None
+
+
+class OwnedStore:
+    """The owner half of the per-owner metadata protocol: values (or their
+    shm descriptors) created by this process, served to borrowers, freed on
+    last release plus a short grace window (the grace absorbs the in-flight
+    register race inherent to async borrow registration)."""
+
+    def __init__(self, grace_s: float = 1.0):
+        self._lock = threading.Lock()
+        self._objects: dict[bytes, _Entry] = {}
+        self.grace_s = grace_s
+
+    def __contains__(self, k: bytes) -> bool:
+        with self._lock:
+            return k in self._objects
+
+    def owns(self, k: bytes) -> bool:
+        """True when this process is the live owner (REDIRECT entries are
+        head-owned leftovers kept only for promote idempotency)."""
+        with self._lock:
+            e = self._objects.get(k)
+            return e is not None and e.state != REDIRECT
+
+    def drop_redirect(self, k: bytes):
+        with self._lock:
+            e = self._objects.get(k)
+            if e is not None and e.state == REDIRECT:
+                del self._objects[k]
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+    def put_ready(self, k: bytes, payload: Payload, contained=None):
+        with self._lock:
+            e = self._objects.get(k)
+            if e is None:
+                e = self._objects[k] = _Entry(READY)
+            e.state = READY
+            e.payload = payload
+            e.contained = contained or None
+
+    def create_pending(self, k: bytes):
+        with self._lock:
+            if k not in self._objects:
+                self._objects[k] = _Entry(PENDING)
+
+    def reset_pending(self, k: bytes):
+        """Force an entry back to PENDING (lineage replay of a lost
+        result): getters block until the replay completes it again."""
+        with self._lock:
+            e = _Entry(PENDING)
+            old = self._objects.get(k)
+            if old is not None:
+                e.borrows = old.borrows
+            self._objects[k] = e
+
+    def complete(self, k: bytes, payload: Payload | None = None, value=None, error=None, redirect=False):
+        with self._lock:
+            e = self._objects.get(k)
+            if e is None:
+                e = self._objects[k] = _Entry(PENDING)
+                e.event = threading.Event()
+            if error is not None:
+                e.state, e.error = ERROR, error
+            elif redirect:
+                e.state = REDIRECT
+            elif payload is not None:
+                e.state, e.payload = READY, payload
+                if payload.contained:
+                    # pin objects pickled inside the result while the entry
+                    # lives; our ref pump registers the borrow with their
+                    # owner/head
+                    from ray_tpu.core.object_ref import ObjectRef
+
+                    e.contained = [ObjectRef(c) for c in payload.contained]
+            else:
+                e.state, e.value = VALUE, value
+            ev, cbs = e.event, e.callbacks
+            e.callbacks = None
+        if ev is not None:
+            ev.set()
+        if cbs:
+            for cb in cbs:
+                try:
+                    cb()
+                except Exception:
+                    pass
+
+    def entry(self, k: bytes) -> _Entry | None:
+        with self._lock:
+            return self._objects.get(k)
+
+    def wait_entry(self, k: bytes, timeout: float | None) -> _Entry | None:
+        """Block until the entry leaves PENDING (or timeout). None =
+        unknown id (never owned here / already freed)."""
+        with self._lock:
+            e = self._objects.get(k)
+        if e is None:
+            return None
+        if e.state != PENDING:
+            return e
+        if not e.event.wait(timeout=timeout):
+            return e  # still pending; caller decides on timeout semantics
+        return e
+
+    def add_callback(self, k: bytes, cb) -> bool:
+        """Run cb() once the entry completes (immediately if done).
+        Returns False for unknown ids."""
+        with self._lock:
+            e = self._objects.get(k)
+            if e is None:
+                return False
+            if e.state == PENDING:
+                if e.callbacks is None:
+                    e.callbacks = []
+                e.callbacks.append(cb)
+                return True
+        try:
+            cb()
+        except Exception:
+            pass
+        return True
+
+    def is_ready(self, k: bytes) -> bool:
+        with self._lock:
+            e = self._objects.get(k)
+            return e is not None and e.state != PENDING
+
+    # -- borrow protocol (owner side) --
+    def on_borrow(self, k: bytes, registered: bool):
+        with self._lock:
+            e = self._objects.get(k)
+            if e is None:
+                return
+            e.borrows += 1 if registered else -1
+            if e.borrows > 0:
+                e.zero_since = None
+
+    def on_local_zero(self, k: bytes):
+        from ray_tpu.core.object_ref import local_ref_count
+
+        with self._lock:
+            e = self._objects.get(k)
+            if e is None:
+                return
+            if local_ref_count(ObjectID(k)) == 0 and e.borrows <= 0:
+                e.zero_since = time.monotonic()
+
+    def on_local_reregister(self, k: bytes):
+        with self._lock:
+            e = self._objects.get(k)
+            if e is not None:
+                e.zero_since = None
+
+    def free(self, k: bytes):
+        self._drop(k)
+
+    def _drop(self, k: bytes):
+        with self._lock:
+            e = self._objects.pop(k, None)
+        if e is not None and e.payload is not None and e.payload.shm is not None:
+            from ray_tpu.core.object_store import local_shm_name, unlink_shm
+
+            try:
+                unlink_shm(e.payload.shm.shm_name)
+                unlink_shm(local_shm_name(e.payload.shm))
+            except Exception:
+                pass
+        if e is not None and e.event is not None and not e.event.is_set():
+            e.error = ObjectLostError("object was freed by its owner")
+            e.state = ERROR
+            e.event.set()
+        if e is not None:
+            e.contained = None  # release contained pins (cascade)
+
+    def gc_pass(self):
+        """Free entries whose local count has been zero (and borrow count
+        <= 0) for longer than the grace window."""
+        from ray_tpu.core.object_ref import local_ref_count
+
+        now = time.monotonic()
+        doomed = []
+        with self._lock:
+            for k, e in self._objects.items():
+                if (
+                    e.zero_since is not None
+                    and now - e.zero_since > self.grace_s
+                    and e.borrows <= 0
+                    and e.state != PENDING
+                ):
+                    doomed.append(k)
+        for k in doomed:
+            if local_ref_count(ObjectID(k)) == 0:
+                self._drop(k)
+
+    def shutdown(self):
+        with self._lock:
+            ks = list(self._objects)
+        for k in ks:
+            self._drop(k)
+
+
+# ---------------------------------------------------------------------------
+# remote-owner hints: obj_id bytes -> "host:port#node_hex" of the owner.
+# Module-level (not per-client): populated by ObjectRef materialization in
+# ANY process so borrowed refs always know their owner.
+# ---------------------------------------------------------------------------
+_hints: dict[bytes, str] = {}
+_hints_lock = threading.Lock()
+
+
+def note_hint(k: bytes, owner: str):
+    st = _state
+    if st is not None and st.owned.owns(k):
+        return  # we ARE the owner; no hint needed
+    with _hints_lock:
+        _hints[k] = owner
+
+
+def get_hint(k: bytes) -> str | None:
+    with _hints_lock:
+        return _hints.get(k)
+
+
+def drop_hint(k: bytes):
+    with _hints_lock:
+        _hints.pop(k, None)
+
+
+def hint_addr(owner: str) -> tuple[str, int]:
+    hp = owner.split("#", 1)[0]
+    host, port = hp.rsplit(":", 1)
+    return (host, int(port))
+
+
+def hint_node_hex(owner: str) -> str | None:
+    parts = owner.split("#", 1)
+    return parts[1] if len(parts) > 1 else None
+
+
+# ---------------------------------------------------------------------------
+# client side: one persistent connection to a peer
+# ---------------------------------------------------------------------------
+class _CallRec:
+    __slots__ = ("kind", "actor_hex", "task_id", "oids", "method", "func_id", "args", "kwargs", "num_returns", "retries_left", "trace", "done_counted", "pins", "raw", "cancelled")
+
+    def __init__(self, kind, actor_hex, task_id, oids, method, func_id, args, kwargs, num_returns, retries_left, trace, pins=None, raw=None):
+        self.done_counted = False
+        self.cancelled = False
+        # live ObjectRefs pinning this call's arguments until completion
+        # (the head pins spec args on its path; here the caller does)
+        self.pins = pins
+        # fast-path args: one pickle blob of (args, kwargs) riding the
+        # frame; None when ArgSpec encoding was used
+        self.raw = raw
+        self.kind = kind  # "actor" | "task"
+        self.actor_hex = actor_hex
+        self.task_id = task_id
+        self.oids = oids
+        self.method = method
+        self.func_id = func_id
+        self.args = args
+        self.kwargs = kwargs
+        self.num_returns = num_returns
+        self.retries_left = retries_left
+        self.trace = trace
+
+
+class PeerConn:
+    """Client half of one direct connection: pipelined requests, a reader
+    thread completing owned-store entries and blocking slots."""
+
+    def __init__(self, state: "DirectState", addr: tuple[str, int]):
+        self.state = state
+        self.addr = addr
+        self.sock = socket.create_connection(addr, timeout=10.0)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock.settimeout(None)
+        self._rf = _auth_client(self.sock, state.authkey)
+        self._wlock = threading.Lock()
+        self._lock = threading.Lock()
+        self._cid = 0
+        self._calls: dict[int, _CallRec] = {}  # in-flight direct calls
+        self._slots: dict[int, list] = {}  # cid -> [Event, ok, payload] blocking requests
+        self.dead = False
+        self.last_used = time.monotonic()
+        self._sent_funcs: set[str] = set()
+        self.inflight = 0
+        self._reader = threading.Thread(target=self._read_loop, daemon=True, name="rt-direct-peer")
+        self._reader.start()
+
+    def _next_cid(self) -> int:
+        with self._lock:
+            self._cid += 1
+            return self._cid
+
+    def send(self, msg: dict):
+        data = _dumps(msg)
+        try:
+            _send_frame(self.sock, data, self._wlock)
+        except (OSError, ValueError) as e:
+            self._on_death()
+            raise ConnectionError(f"direct peer send failed: {e}") from None
+
+    def send_call(self, rec: _CallRec, frame: dict):
+        cid = self._next_cid()
+        frame["cid"] = cid
+        with self._lock:
+            if self.dead:
+                raise ConnectionError("direct peer is down")
+            self._calls[cid] = rec
+            self.inflight += 1
+        self.last_used = time.monotonic()
+        try:
+            self.send(frame)
+        except ConnectionError:
+            # _on_death already failed this rec over; don't double-handle
+            raise
+
+    def ensure_func(self, func_id: str, blob):
+        if func_id in self._sent_funcs:
+            return
+        self.send({"op": "reg_func", "func_id": func_id, "blob": blob})
+        self._sent_funcs.add(func_id)
+
+    def request(self, op: str, timeout: float | None = None, **fields) -> dict:
+        """Blocking request/response (GET etc.). ``timeout`` bounds the
+        local wait; ``fields`` ride the frame (including any wire-side
+        timeout the server should honor)."""
+        cid = self._next_cid()
+        slot = [threading.Event(), None]
+        with self._lock:
+            if self.dead:
+                raise ConnectionError("direct peer is down")
+            self._slots[cid] = slot
+        self.last_used = time.monotonic()
+        self.send({"op": op, "cid": cid, **fields})
+        if not slot[0].wait(timeout=timeout):
+            with self._lock:
+                self._slots.pop(cid, None)
+            raise GetTimeoutError(f"direct {op} to {self.addr} timed out")
+        if isinstance(slot[1], ConnectionError):
+            raise slot[1]
+        return slot[1]
+
+    def request_get(self, k: bytes, timeout: float | None) -> dict:
+        """GET an owned object: the owner waits out PENDING entries with
+        OUR timeout (None = indefinitely, like a local get)."""
+        return self.request(
+            "get",
+            timeout=None if timeout is None else timeout + 5.0,
+            id=k,
+            **({} if timeout is None else {"timeout": timeout}),
+        )
+
+    def _read_loop(self):
+        try:
+            while True:
+                msg = _recv_frame(self._rf)
+                op = msg.get("op")
+                if op == "result":
+                    self._on_result(msg)
+                elif op == "value":
+                    with self._lock:
+                        slot = self._slots.pop(msg["cid"], None)
+                    if slot is not None:
+                        slot[1] = msg
+                        slot[0].set()
+                # unknown ops ignored (forward compat)
+        except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):
+            pass
+        finally:
+            self._on_death()
+
+    def _on_result(self, msg: dict):
+        from ray_tpu.core import rpc_chaos
+
+        if not rpc_chaos.apply("direct_result"):
+            # chaos: a lost reply is indistinguishable from a dead peer —
+            # fail the connection so in-flight calls take the failover path
+            self._on_death()
+            return
+        with self._lock:
+            rec = self._calls.pop(msg["cid"], None)
+            if rec is not None:
+                self.inflight -= 1
+        if rec is None:
+            return
+        self.last_used = time.monotonic()
+        owned = self.state.owned
+        err = msg.get("error")
+        if err is not None:
+            for oid in rec.oids:
+                owned.complete(oid.binary(), error=err)
+        elif "vals" in msg:
+            # raw fast path: results came as plain values in the frame
+            for oid, v in zip(rec.oids, msg["vals"]):
+                owned.complete(oid.binary(), value=v)
+        else:
+            for (kb, payload, head_owned) in msg["returns"]:
+                if head_owned:
+                    drop_hint(kb)
+                    owned.complete(kb, redirect=True)
+                    # the owner (this process) keeps the producing spec:
+                    # if the head store loses the bytes, we replay the
+                    # call (owner-based lineage; reference:
+                    # task_manager.cc lineage reconstruction lives with
+                    # the owner, not the GCS)
+                    self.state.remember_lineage(kb, rec)
+                else:
+                    owned.complete(kb, payload=payload)
+        self.state.on_call_done(rec)
+
+    def _on_death(self):
+        with self._lock:
+            if self.dead:
+                return
+            self.dead = True
+            calls, self._calls = self._calls, {}
+            slots, self._slots = self._slots, {}
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        for slot in slots.values():
+            slot[1] = ConnectionError("direct peer died")
+            slot[0].set()
+        self.state.on_conn_death(self, list(calls.values()))
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# server side
+# ---------------------------------------------------------------------------
+class DirectServer:
+    """Listener serving the direct protocol for this process: owned-object
+    GETs, borrow events, frees — and, when an exec handler is installed
+    (worker processes), direct CALL execution."""
+
+    def __init__(self, state: "DirectState", host: str = "0.0.0.0", advertise_host: str | None = None):
+        self.state = state
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(128)
+        adv = advertise_host or os.environ.get("RT_DIRECT_HOST") or "127.0.0.1"
+        self.address = (adv, self._sock.getsockname()[1])
+        self._stopped = threading.Event()
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(max_workers=16, thread_name_prefix="rt-direct-srv")
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True, name="rt-direct-listen")
+        self._thread.start()
+
+    def _accept_loop(self):
+        try:
+            self._sock.settimeout(0.5)
+        except OSError:
+            return
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,), daemon=True, name="rt-direct-conn").start()
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            conn.settimeout(30.0)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            rf = _auth_server(conn, self.state.authkey)
+            conn.settimeout(None)
+        except Exception:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        wlock = threading.Lock()
+
+        def reply(msg):
+            # dict or pre-pickled bytes (the worker's raw fast path)
+            try:
+                _send_frame(conn, msg if isinstance(msg, bytes) else _dumps(msg), wlock)
+            except (OSError, ValueError):
+                pass
+
+        funcs: dict[str, object] = {}
+        try:
+            while not self._stopped.is_set():
+                msg = _recv_frame(rf)
+                op = msg.get("op")
+                if op == "call":
+                    handler = self.state.exec_handler
+                    if handler is None:
+                        reply({"op": "result", "cid": msg["cid"], "returns": [], "error": TaskError(tb_str="this process does not execute direct calls", task_desc=msg.get("method", ""))})
+                    else:
+                        handler(msg, reply, funcs)
+                elif op == "get":
+                    self._pool.submit(self._serve_get, msg, reply)
+                elif op == "poll":
+                    e = self.state.owned.entry(msg["id"])
+                    ready = e is None or e.state != PENDING
+                    reply({"op": "value", "cid": msg["cid"], "payload": None, "ready": ready})
+                elif op == "ref":
+                    self._on_ref_events(msg["events"])
+                elif op == "free":
+                    for kb in msg["ids"]:
+                        self.state.owned.free(kb)
+                elif op == "reg_func":
+                    funcs[msg["func_id"]] = msg["blob"]
+                elif op == "cancel":
+                    cd = self.state.cancelled_direct
+                    if len(cd) > 1024:
+                        cd.clear()  # best-effort cooperative marks, bounded
+                    cd.add(msg["task"])
+                elif op == "ping":
+                    reply({"op": "value", "cid": msg["cid"], "payload": None})
+        except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_get(self, msg: dict, reply):
+        k = msg["id"]
+        e = self.state.owned.entry(k)
+        if e is not None and e.state == PENDING:
+            # long waits get their own thread so pending GETs can never
+            # starve the fixed server pool
+            threading.Thread(target=self._serve_get_blocking, args=(msg, reply), daemon=True, name="rt-direct-getwait").start()
+            return
+        self._serve_get_blocking(msg, reply)
+
+    def _serve_get_blocking(self, msg: dict, reply):
+        k = msg["id"]
+        timeout = msg.get("timeout")  # None = wait as long as the caller does
+        e = self.state.owned.wait_entry(k, timeout)
+        if e is None or e.state == REDIRECT:
+            reply({"op": "value", "cid": msg["cid"], "payload": None, "not_owned": True})
+            return
+        if e.state == PENDING:
+            reply({"op": "value", "cid": msg["cid"], "payload": None, "error": GetTimeoutError("owner-side wait timed out")})
+        elif e.state == ERROR:
+            reply({"op": "value", "cid": msg["cid"], "payload": None, "error": e.error})
+        elif e.state == VALUE:
+            from ray_tpu.core.payloads import encode_value
+
+            reply({"op": "value", "cid": msg["cid"], "payload": encode_value(e.value)})
+        else:
+            reply({"op": "value", "cid": msg["cid"], "payload": e.payload})
+
+    def _on_ref_events(self, events):
+        """Borrow register/release for objects we own; stale-hint events
+        (ids promoted to the head meanwhile) are forwarded into this
+        process's head-bound ref queue so the head's holder table stays
+        balanced (see module docstring for the bounded-leak caveat)."""
+        owned = self.state.owned
+        stale = []
+        for kb, reg in events:
+            if kb in owned:
+                owned.on_borrow(kb, reg)
+            else:
+                stale.append((kb, reg))
+        if stale:
+            from ray_tpu.core import object_ref as _oref
+
+            with _oref._rc_lock:
+                _oref._rc_events.extend(stale)
+
+    def shutdown(self):
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._pool.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# actor routes + leases
+# ---------------------------------------------------------------------------
+class ActorRoute:
+    __slots__ = ("addr", "epoch", "max_task_retries", "head_dirty", "inflight_recs", "lock", "drained")
+
+    def __init__(self):
+        self.addr = None
+        self.epoch = -1
+        self.max_task_retries = 0
+        self.head_dirty = False  # head-lane submissions since last fence
+        self.inflight_recs = 0
+        self.lock = threading.Lock()
+        self.drained = threading.Event()
+        self.drained.set()
+
+
+class Lease:
+    __slots__ = ("wid", "addr", "conn")
+
+    def __init__(self, wid: str, addr, conn: PeerConn):
+        self.wid = wid
+        self.addr = addr
+        self.conn = conn
+
+
+# ---------------------------------------------------------------------------
+# per-process direct state
+# ---------------------------------------------------------------------------
+class DirectState:
+    MAX_CONNS = 256
+
+    def __init__(self, client, authkey: bytes, node_hex: str = "", serve: bool = True, exec_handler=None):
+        from ray_tpu._config import get_config
+
+        self.client = client
+        self.authkey = authkey
+        self.node_hex = node_hex
+        self.owned = OwnedStore(grace_s=get_config().owned_object_grace_s)
+        self.exec_handler = exec_handler
+        self.cancelled_direct: set = set()
+        self.server = DirectServer(self) if serve else None
+        self.self_owner = (
+            f"{self.server.address[0]}:{self.server.address[1]}#{node_hex}" if self.server else None
+        )
+        self._conns: dict[tuple, PeerConn] = {}
+        self._conns_lock = threading.Lock()
+        self.routes: dict[str, ActorRoute] = {}
+        self._routes_lock = threading.Lock()
+        self.leases: list[Lease] = []
+        self._leases_lock = threading.Lock()
+        self._lease_last_used = 0.0
+        self._owner_ref_queues: dict[str, list] = {}  # owner -> pending ref events
+        self._orq_lock = threading.Lock()
+        # function blobs this client taught (or may teach) leased workers
+        self.func_blobs: dict[str, object] = {}
+        # owner-side lineage: return-oid -> producing _CallRec for
+        # head-sealed (large) direct results; bounded FIFO
+        self.lineage: dict[bytes, _CallRec] = {}
+        self._lineage_order: list = []
+        self._lineage_lock = threading.Lock()
+        self._reconstructing: set = set()
+        self._reconstruct_cv = threading.Condition(self._lineage_lock)
+        self._stopped = False
+        self._hk = threading.Thread(target=self._housekeeping, daemon=True, name="rt-direct-hk")
+        self._hk.start()
+
+    # -- connections --
+    def get_conn(self, addr: tuple[str, int]) -> PeerConn:
+        addr = tuple(addr)
+        with self._conns_lock:
+            c = self._conns.get(addr)
+            if c is not None and not c.dead:
+                return c
+        c = PeerConn(self, addr)
+        with self._conns_lock:
+            old = self._conns.get(addr)
+            if old is not None and not old.dead:
+                c.close()
+                return old
+            self._conns[addr] = c
+            if len(self._conns) > self.MAX_CONNS:
+                idle = sorted(
+                    (x for x in self._conns.values() if x.inflight == 0 and x is not c),
+                    key=lambda x: x.last_used,
+                )
+                for x in idle[: len(self._conns) - self.MAX_CONNS]:
+                    self._conns.pop(x.addr, None)
+                    x.close()
+        return c
+
+    def on_conn_death(self, conn: PeerConn, lost_calls: list[_CallRec]):
+        with self._conns_lock:
+            if self._conns.get(conn.addr) is conn:
+                self._conns.pop(conn.addr, None)
+        with self._leases_lock:
+            self.leases = [l for l in self.leases if l.conn is not conn]
+        for rec in lost_calls:
+            threading.Thread(target=self._failover, args=(rec,), daemon=True).start()
+
+    def on_call_done(self, rec: _CallRec):
+        if rec.kind == "actor" and not rec.done_counted:
+            rec.done_counted = True
+            route = self.route(rec.actor_hex)
+            with route.lock:
+                route.inflight_recs -= 1
+                if route.inflight_recs <= 0:
+                    route.drained.set()
+
+    # -- failover: direct call lost to a dead peer --
+    def _failover(self, rec: _CallRec):
+        try:
+            if rec.cancelled:
+                from ray_tpu.exceptions import RayTpuError
+
+                err = RayTpuError(f"task {rec.task_id.hex()[:8]} was cancelled")
+                for oid in rec.oids:
+                    self.owned.complete(oid.binary(), error=err)
+                return
+            if self._stopped:
+                err = WorkerCrashedError("runtime shut down with direct calls in flight")
+                for oid in rec.oids:
+                    self.owned.complete(oid.binary(), error=err)
+                return
+            client = self.client
+            if rec.kind == "actor":
+                self._failover_actor(client, rec)
+            else:
+                self._failover_task(client, rec)
+        except BaseException as e:  # noqa: BLE001
+            for oid in rec.oids:
+                self.owned.complete(oid.binary(), error=e if isinstance(e, Exception) else WorkerCrashedError(str(e)))
+        finally:
+            self.on_call_done(rec)
+
+    @staticmethod
+    def _rec_argspecs(rec: _CallRec):
+        """ArgSpecs for a head-path resubmit of this rec (raw fast-path
+        blobs re-encode through the normal arg machinery)."""
+        if rec.raw is None:
+            return rec.args, rec.kwargs
+        args, kwargs = pickle.loads(rec.raw)
+        from ray_tpu.api import _encode_args
+
+        specs, kw, _pins = _encode_args(args, kwargs or {})
+        return specs, kw
+
+    def _failover_actor(self, client, rec: _CallRec):
+        from ray_tpu.core.ids import ActorID
+
+        route = self.route(rec.actor_hex)
+        with route.lock:
+            route.addr = None  # force endpoint re-resolution
+            route.head_dirty = True
+        if rec.retries_left <= 0:
+            err = ActorDiedError(rec.actor_hex, "actor worker died during a direct call")
+            for oid in rec.oids:
+                self.owned.complete(oid.binary(), error=err)
+            return
+        # resubmit through the head (it owns the restart state machine);
+        # bridge the head-path results into our owned pending entries
+        args, kwargs = self._rec_argspecs(rec)
+        ids = client.submit_actor_task(
+            actor_id=ActorID.from_hex(rec.actor_hex),
+            method_name=rec.method,
+            args=args,
+            kwargs=kwargs,
+            num_returns=rec.num_returns,
+            streaming=False,
+            options={"_trace_ctx": rec.trace},
+        )
+        self._bridge(client, ids, rec.oids)
+
+    def _failover_task(self, client, rec: _CallRec):
+        if rec.retries_left <= 0:
+            err = WorkerCrashedError(f"leased worker died executing {rec.method}")
+            for oid in rec.oids:
+                self.owned.complete(oid.binary(), error=err)
+            return
+        args, kwargs = self._rec_argspecs(rec)
+        ids = client.submit_task(
+            name=rec.method,
+            func_id=rec.func_id,
+            args=args,
+            kwargs=kwargs,
+            num_returns=rec.num_returns,
+            streaming=False,
+            func_blob=self.func_blobs.get(rec.func_id),
+            options={"max_retries": rec.retries_left - 1},
+        )
+        self._bridge(client, ids, rec.oids)
+
+    def _bridge(self, client, head_ids, owned_oids):
+        def _pump():
+            for hid, oid in zip(head_ids, owned_oids):
+                try:
+                    v = client.get_object(hid)
+                    self.owned.complete(oid.binary(), value=v)
+                except BaseException as e:  # noqa: BLE001
+                    self.owned.complete(oid.binary(), error=e)
+
+        threading.Thread(target=_pump, daemon=True).start()
+
+    # -- owner-side lineage --
+    MAX_LINEAGE = 4096
+
+    def remember_lineage(self, k: bytes, rec: _CallRec):
+        with self._lineage_lock:
+            if k not in self.lineage:
+                self._lineage_order.append(k)
+            self.lineage[k] = rec
+            while len(self._lineage_order) > self.MAX_LINEAGE:
+                old = self._lineage_order.pop(0)
+                self.lineage.pop(old, None)
+
+    def forget_lineage(self, k: bytes):
+        with self._lineage_lock:
+            self.lineage.pop(k, None)
+
+    def reconstruct(self, client, obj_id: ObjectID) -> bool:
+        """Replay the direct call that produced a lost head-sealed result.
+        Blocks until the replay completes (entries leave PENDING). Returns
+        False when this process holds no lineage for the id."""
+        k = obj_id.binary()
+        with self._lineage_lock:
+            rec = self.lineage.get(k)
+            if rec is None:
+                return False
+            tid_b = rec.task_id.binary()
+            if tid_b in self._reconstructing:
+                # another getter is already replaying this task: wait it out
+                while tid_b in self._reconstructing:
+                    self._reconstruct_cv.wait(timeout=120.0)
+                return True
+            self._reconstructing.add(tid_b)
+            for oid in rec.oids:
+                self.owned.reset_pending(oid.binary())
+        try:
+            self._replay(client, rec)
+            for oid in rec.oids:
+                self.owned.wait_entry(oid.binary(), 120.0)
+        finally:
+            with self._lineage_lock:
+                self._reconstructing.discard(tid_b)
+                self._reconstruct_cv.notify_all()
+        return True
+
+    def _replay(self, client, rec: _CallRec):
+        """Resubmit a completed call (head path; bridged into the owned
+        entries). The head path re-pins args and re-seals large results."""
+        from ray_tpu.core.ids import ActorID
+
+        try:
+            args, kwargs = self._rec_argspecs(rec)
+            promote_argspecs(client, args, kwargs)
+            if rec.kind == "actor":
+                ids = client.submit_actor_task(
+                    actor_id=ActorID.from_hex(rec.actor_hex),
+                    method_name=rec.method,
+                    args=args,
+                    kwargs=kwargs,
+                    num_returns=rec.num_returns,
+                    streaming=False,
+                    options={},
+                )
+            else:
+                ids = client.submit_task(
+                    name=rec.method,
+                    func_id=rec.func_id,
+                    args=args,
+                    kwargs=kwargs,
+                    num_returns=rec.num_returns,
+                    streaming=False,
+                    func_blob=self.func_blobs.get(rec.func_id),
+                    options={},
+                )
+        except BaseException as e:  # noqa: BLE001
+            for oid in rec.oids:
+                self.owned.complete(oid.binary(), error=e if isinstance(e, Exception) else ObjectLostError(str(e)))
+            return
+        self._bridge(client, ids, rec.oids)
+
+    # -- actor routing --
+    def route(self, actor_hex: str) -> ActorRoute:
+        with self._routes_lock:
+            r = self.routes.get(actor_hex)
+            if r is None:
+                r = self.routes[actor_hex] = ActorRoute()
+            return r
+
+    # -- ref-event routing (the owner half of the borrow protocol) --
+    def route_ref_events(self, events: list[tuple[bytes, bool]]) -> list[tuple[bytes, bool]]:
+        """Split this process's local-count transitions: events for objects
+        WE own are applied locally; events for remote-owned objects queue
+        to their owner; the rest go to the head (returned)."""
+        head_events = []
+        to_owner: dict[str, list] = {}
+        for k, reg in events:
+            if self.owned.owns(k):
+                if reg:
+                    self.owned.on_local_reregister(k)
+                else:
+                    self.owned.on_local_zero(k)
+                continue
+            if k in self.owned:  # REDIRECT: head-owned now
+                if not reg:
+                    from ray_tpu.core.object_ref import local_ref_count
+
+                    if local_ref_count(ObjectID(k)) == 0:
+                        self.owned.drop_redirect(k)
+                        self.forget_lineage(k)
+                head_events.append((k, reg))
+                continue
+            owner = get_hint(k)
+            if owner is not None:
+                to_owner.setdefault(owner, []).append((k, reg))
+                if not reg:
+                    from ray_tpu.core.object_ref import local_ref_count
+
+                    if local_ref_count(ObjectID(k)) == 0:
+                        drop_hint(k)
+                continue
+            head_events.append((k, reg))
+        if to_owner:
+            with self._orq_lock:
+                for owner, evs in to_owner.items():
+                    self._owner_ref_queues.setdefault(owner, []).extend(evs)
+        return head_events
+
+    def _flush_owner_refs(self):
+        with self._orq_lock:
+            queues, self._owner_ref_queues = self._owner_ref_queues, {}
+        for owner, evs in queues.items():
+            try:
+                self.get_conn(hint_addr(owner)).send({"op": "ref", "events": evs})
+            except Exception:
+                pass  # owner gone: its objects died with it
+
+    # -- leases --
+    def acquire_lease(self) -> Lease | None:
+        client = self.client
+        try:
+            info = client.lease_worker()
+        except Exception:
+            return None
+        if not info:
+            return None
+        try:
+            conn = self.get_conn(tuple(info["addr"]))
+        except Exception:
+            try:
+                client.release_lease(info["wid"])
+            except Exception:
+                pass
+            return None
+        lease = Lease(info["wid"], tuple(info["addr"]), conn)
+        with self._leases_lock:
+            self.leases.append(lease)
+        return lease
+
+    def pick_lease(self) -> Lease | None:
+        self._lease_last_used = time.monotonic()
+        with self._leases_lock:
+            live = [l for l in self.leases if not l.conn.dead]
+            self.leases = live
+            if live:
+                best = min(live, key=lambda l: l.conn.inflight)
+                if best.conn.inflight < 64 or len(live) >= 8:
+                    return best
+        return self.acquire_lease() or (live[0] if live else None)
+
+    def _release_idle_leases(self):
+        now = time.monotonic()
+        if now - self._lease_last_used < 2.0:
+            return
+        with self._leases_lock:
+            leases, self.leases = self.leases, []
+        for l in leases:
+            if l.conn.inflight > 0:
+                with self._leases_lock:
+                    self.leases.append(l)
+                continue
+            try:
+                self.client.release_lease(l.wid)
+            except Exception:
+                pass
+
+    # -- housekeeping --
+    def _housekeeping(self):
+        while not self._stopped:
+            time.sleep(0.2)
+            try:
+                self._flush_owner_refs()
+                self.owned.gc_pass()
+                self._release_idle_leases()
+            except Exception:
+                pass
+
+    def shutdown(self):
+        self._stopped = True
+        with self._leases_lock:
+            leases, self.leases = self.leases, []
+        for l in leases:
+            try:
+                self.client.release_lease(l.wid)
+            except Exception:
+                pass
+        self._flush_owner_refs()
+        if self.server is not None:
+            self.server.shutdown()
+        with self._conns_lock:
+            conns, self._conns = list(self._conns.values()), {}
+        for c in conns:
+            c.close()
+        self.owned.shutdown()
+        with _hints_lock:
+            _hints.clear()
+
+
+# ---------------------------------------------------------------------------
+# module-level state management
+# ---------------------------------------------------------------------------
+_state: DirectState | None = None
+
+
+def state() -> DirectState | None:
+    return _state
+
+
+def attach(client, authkey: bytes | None, node_hex: str = "", serve: bool = True, exec_handler=None) -> DirectState | None:
+    """Install the process-wide direct state for this client. No authkey =
+    direct plane disabled (everything stays on the head path)."""
+    global _state
+    if _state is not None:
+        _state.shutdown()
+        _state = None
+    from ray_tpu._config import get_config
+
+    cfg = get_config()
+    # the ownership model rides the borrow protocol: without reference
+    # counting there is no owner-side GC, so fall back to the head path
+    if authkey is None or not cfg.direct_calls or not cfg.object_ref_counting:
+        return None
+    try:
+        _state = DirectState(client, authkey, node_hex=node_hex, serve=serve, exec_handler=exec_handler)
+    except Exception:
+        _state = None
+    return _state
+
+
+def detach(client):
+    global _state
+    if _state is not None and _state.client is client:
+        _state.shutdown()
+        _state = None
+
+
+# ---------------------------------------------------------------------------
+# submit paths (called from api.py; None return = use the head path)
+# ---------------------------------------------------------------------------
+def pack_raw(args, kwargs):
+    """Fast-path argument packing: one plain-pickle blob of (args, kwargs)
+    riding the call frame — no per-arg Serialized/ArgSpec machinery.
+    Returns (bytes, pins) or None when ineligible: top-level ObjectRefs
+    (those need resolve-before-call semantics), cloudpickle-only values,
+    or anything big enough to belong in shared memory. Nested ObjectRefs
+    are fine — __reduce__ reports them to the active sink for pinning and
+    carries their owner hints."""
+    for a in args:
+        if isinstance(a, _ObjRef):
+            return None
+    if kwargs:
+        for v in kwargs.values():
+            if isinstance(v, _ObjRef):
+                return None
+    from ray_tpu._config import get_config
+    from ray_tpu.core import object_ref as _oref
+
+    sink: list = []
+    token = _oref.push_ref_sink(sink)
+    try:
+        data = pickle.dumps((args, kwargs), protocol=5)
+    except Exception:
+        return None  # cloudpickle-only content: ArgSpec path handles it
+    finally:
+        _oref.pop_ref_sink(token)
+    if len(data) > get_config().max_direct_call_object_size:
+        return None
+    pins = [_ObjRef(i) for i in sink] if sink else None
+    return data, pins
+
+
+def _direct_ok(options: dict | None) -> bool:
+    o = options or {}
+    if o.get("num_returns") in ("streaming", "dynamic"):
+        return False
+    if o.get("num_cpus") not in (None, 1, 1.0):
+        return False  # a lease is exactly one CPU
+    for k in ("placement_group", "scheduling_strategy", "runtime_env", "label_selector", "_node_id", "resources", "num_tpus", "memory"):
+        if o.get(k):
+            return False
+    return True
+
+
+def try_actor_call(client, actor_id, method_name: str, arg_specs, kw_specs, options: dict | None, pins=None, raw=None):
+    """Direct actor call (pre-encoded ArgSpecs, or a raw pack_raw blob).
+    Returns list[ObjectRef] or None (= head path). The caller OWNS the
+    returns (inline results live in this process)."""
+    st = _state
+    if st is None or st.server is None or not _direct_ok(options):
+        return None
+    from ray_tpu.core import rpc_chaos
+
+    if not rpc_chaos.apply("direct_call"):
+        return None  # chaos: degrade to the head path
+    actor_hex = actor_id.hex()
+    route = st.route(actor_hex)
+    with route.lock:
+        addr = route.addr
+    if addr is None:
+        try:
+            ep = client.actor_endpoint(actor_hex)
+        except Exception:
+            return None
+        if not ep or not ep.get("addr"):
+            return None  # api fallback marks the route head-dirty
+        with route.lock:
+            route.addr = tuple(ep["addr"])
+            route.epoch = ep.get("epoch", 0)
+            route.max_task_retries = ep.get("max_task_retries", 0)
+            addr = route.addr
+    # lane fence: if we sent head-lane calls to this actor since the last
+    # direct call, wait for them to finish so per-caller ordering holds
+    if route.head_dirty:
+        try:
+            rids = client.submit_actor_task(actor_id=actor_id, method_name="__ray_ready__", args=[], kwargs={}, num_returns=1, streaming=False, options={})
+            client.get_object(rids[0], timeout=60.0)
+        except Exception:
+            pass  # actor death surfaces on the direct call below
+        route.head_dirty = False
+    try:
+        conn = st.get_conn(addr)
+    except Exception:
+        with route.lock:
+            route.addr = None
+        return None
+    nr = int((options or {}).get("num_returns", 1) or 1)
+    tid = TaskID.from_random()
+    oids = [ObjectID.for_task_return(tid, i) for i in range(nr)]
+    for oid in oids:
+        st.owned.create_pending(oid.binary())
+    rec = _CallRec(
+        "actor", actor_hex, tid, oids, method_name, None, arg_specs, kw_specs, nr,
+        route.max_task_retries, (options or {}).get("_trace_ctx"), pins=pins, raw=raw,
+    )
+    with route.lock:
+        route.inflight_recs += 1
+        route.drained.clear()
+    frame = {
+        "op": "call",
+        "actor": actor_id.binary(),
+        "method": method_name,
+        "task": tid.binary(),
+        "num_returns": nr,
+        "trace": (options or {}).get("_trace_ctx"),
+    }
+    if raw is not None:
+        frame["rawp"] = raw
+    else:
+        frame["args"] = arg_specs
+        frame["kwargs"] = kw_specs
+    try:
+        conn.send_call(rec, frame)
+    except ConnectionError:
+        pass  # failover path completes the pending entries
+    return _owned_refs(st, oids)
+
+
+def try_task_call(client, name: str, func_id: str, blob, arg_specs, kw_specs, options: dict | None, pins=None, raw=None):
+    """Direct stateless-task submission onto a leased worker (pre-encoded
+    ArgSpecs, or a raw pack_raw blob)."""
+    st = _state
+    if st is None or st.server is None or not _direct_ok(options):
+        return None
+    o = options or {}
+    if o.get("retry_exceptions"):
+        return None  # app-level retry policies stay on the head path
+    if o.get("max_retries") == 0:
+        # non-retriable tasks run head-supervised: the head pins them and
+        # the OOM killer's victim policy spares them (a leased worker is
+        # always a retriable victim)
+        return None
+    from ray_tpu.core import rpc_chaos
+
+    if not rpc_chaos.apply("direct_call"):
+        return None
+    if blob is not None:
+        st.func_blobs[func_id] = blob
+    elif func_id not in st.func_blobs:
+        return None  # no blob available to teach a leased worker
+    lease = st.pick_lease()
+    if lease is None:
+        return None
+    from ray_tpu._config import get_config
+
+    nr = int(o.get("num_returns", 1) or 1)
+    tid = TaskID.from_random()
+    oids = [ObjectID.for_task_return(tid, i) for i in range(nr)]
+    for oid in oids:
+        st.owned.create_pending(oid.binary())
+    retries = o.get("max_retries")
+    if retries is None:
+        retries = get_config().default_max_retries
+    rec = _CallRec("task", None, tid, oids, name, func_id, arg_specs, kw_specs, nr, retries, o.get("_trace_ctx"), pins=pins, raw=raw)
+    frame = {
+        "op": "call",
+        "actor": None,
+        "method": name,
+        "func_id": func_id,
+        "task": tid.binary(),
+        "num_returns": nr,
+        "trace": o.get("_trace_ctx"),
+    }
+    if raw is not None:
+        frame["rawp"] = raw
+    else:
+        frame["args"] = arg_specs
+        frame["kwargs"] = kw_specs
+    try:
+        lease.conn.ensure_func(func_id, st.func_blobs[func_id])
+        lease.conn.send_call(rec, frame)
+    except ConnectionError:
+        pass  # failover resubmits via the head
+    return _owned_refs(st, oids)
+
+
+def _owned_refs(st: DirectState, oids):
+    from ray_tpu.core.object_ref import ObjectRef
+
+    return [ObjectRef(oid, owner_hint=st.self_owner) for oid in oids]
+
+
+def head_lane_submit(actor_id):
+    """Mark an actor's route head-dirty (a head-path call was submitted);
+    drain in-flight direct calls first so ordering holds."""
+    st = _state
+    if st is None:
+        return
+    route = st.route(actor_id.hex())
+    route.head_dirty = True
+    if not route.drained.wait(timeout=60.0):
+        pass  # best effort: a stuck direct call will also stall the actor
+
+
+# ---------------------------------------------------------------------------
+# owned puts
+# ---------------------------------------------------------------------------
+def try_put(value):
+    """Owner-local put for small values. Returns (ObjectRef, None) or
+    (None, Serialized) — the Serialized is handed back so the head-path
+    fallback doesn't re-serialize (and its contained owned refs have been
+    promoted already)."""
+    st = _state
+    if st is None or st.server is None:
+        from ray_tpu.core.serialization import serialize
+
+        return None, serialize(value)
+    from ray_tpu._config import get_config
+    from ray_tpu.core.payloads import encode_serialized
+    from ray_tpu.core.serialization import serialize
+
+    s = serialize(value)
+    if s.total_size() > get_config().max_direct_call_object_size:
+        promote_contained(st.client, s)
+        return None, s
+    payload = encode_serialized(s)
+    if payload.shm is not None:
+        promote_contained(st.client, s)
+        return None, s
+    oid = ObjectID.from_put()
+    st.owned.put_ready(oid.binary(), payload, contained=list(s.contained_refs))
+    from ray_tpu.core.object_ref import ObjectRef
+
+    return ObjectRef(oid, owner_hint=st.self_owner), None
+
+
+# ---------------------------------------------------------------------------
+# get/wait/free interception
+# ---------------------------------------------------------------------------
+def maybe_get_owned(obj_id: ObjectID, timeout: float | None = None):
+    """(handled, value) for owned / remote-owned objects; handled=False
+    falls through to the caller's head path."""
+    st = _state
+    k = obj_id.binary()
+    if st is not None:
+        e = st.owned.entry(k)
+        if e is not None:
+            if e.state == PENDING:
+                e = st.owned.wait_entry(k, timeout)
+                if e is None:
+                    # freed concurrently (internal_free / shutdown)
+                    raise ObjectLostError(f"object {obj_id.hex()[:16]} was freed by its owner")
+                if e.state == PENDING:
+                    raise GetTimeoutError(f"get() timed out waiting for {obj_id.hex()[:16]}")
+            if e.state == ERROR:
+                raise e.error
+            if e.state == VALUE:
+                return True, e.value
+            if e.state == READY:
+                return True, _decode(e.payload)
+            return False, None  # REDIRECT: head owns it now
+    owner = get_hint(k)
+    if owner is not None and st is not None:
+        try:
+            conn = st.get_conn(hint_addr(owner))
+            # slot timeout slightly above the wire timeout so the owner's
+            # own timeout reply (not ours) names the failure
+            resp = conn.request_get(k, timeout)
+        except (ConnectionError, OSError):
+            drop_hint(k)
+            raise ObjectLostError(
+                f"object {obj_id.hex()[:16]}: owner process at {owner} is gone "
+                "(owned objects die with their owner)"
+            ) from None
+        if resp.get("not_owned"):
+            drop_hint(k)
+            return False, None  # promoted to head meanwhile
+        if resp.get("error") is not None:
+            raise resp["error"]
+        return True, _decode(resp["payload"])
+    return False, None
+
+
+def _decode(payload: Payload):
+    from ray_tpu.core.payloads import decode_payload
+
+    v, _seg = decode_payload(payload, zero_copy=False)
+    if isinstance(v, BaseException):
+        raise v
+    return v
+
+
+def is_owned_or_hinted(k: bytes) -> bool:
+    st = _state
+    if st is not None and st.owned.owns(k):
+        return True
+    return get_hint(k) is not None
+
+
+def owned_ready(k: bytes) -> bool | None:
+    """True/False readiness for an owned/hinted id; None = not ours.
+    Remote-owned ids poll the owner (a borrowed ref to an in-flight
+    direct result must not report ready early)."""
+    st = _state
+    if st is not None:
+        e = st.owned.entry(k)
+        if e is not None and e.state != REDIRECT:
+            return e.state != PENDING
+    owner = get_hint(k)
+    if owner is not None:
+        if st is None:
+            return True
+        try:
+            resp = st.get_conn(hint_addr(owner)).request("poll", timeout=10.0, id=k)
+            return bool(resp.get("ready", True))
+        except Exception:
+            return True  # owner gone: get() surfaces the real error
+    return None
+
+
+def wait_mixed(client, obj_ids, num_returns: int, timeout: float | None, fallback):
+    """ray.wait over a mix of owned and head-tracked ids. `fallback` is the
+    client's head-path wait_ready."""
+    ids = list(obj_ids)
+    split = [owned_ready(o.binary() if hasattr(o, "binary") else o) for o in ids]
+    if all(s is None for s in split):
+        return fallback(ids, num_returns, timeout)
+    head_ids = [o for o, s in zip(ids, split) if s is None]
+    deadline = None if timeout is None else time.monotonic() + timeout
+    known_ready: set = set()  # readiness is sticky: poll each id once
+    delay = 0.002
+    while True:
+        ready, not_ready = [], []
+        for o in ids:
+            if o in known_ready:
+                ready.append(o)
+                continue
+            s = owned_ready(o.binary() if hasattr(o, "binary") else o)
+            if s is True:
+                known_ready.add(o)
+                ready.append(o)
+            elif s is False:
+                not_ready.append(o)
+        head_ready = []
+        if head_ids:
+            t = 0.05 if deadline is None else max(0.0, min(0.05, deadline - time.monotonic()))
+            hr, _ = fallback(head_ids, len(head_ids), t)
+            head_ready = hr
+        ready.extend(head_ready)
+        # preserve input order; cap at num_returns (ray.wait semantics:
+        # extra ready refs stay in the not-ready list for the next call)
+        want = min(num_returns, len(ids))
+        ordered_ready = [o for o in ids if o in ready][:want]
+        ordered_not = [o for o in ids if o not in ordered_ready]
+        if len(ordered_ready) >= want:
+            return ordered_ready, ordered_not
+        if deadline is not None and time.monotonic() >= deadline:
+            return ordered_ready, ordered_not
+        time.sleep(delay)
+        delay = min(delay * 1.5, 0.05)  # back off: long waits stop spinning
+
+
+def free_owned(obj_ids) -> list:
+    """Free owned ids locally / at their owner; return the rest for the
+    head path."""
+    st = _state
+    rest = []
+    owner_frees: dict[str, list] = {}
+    for o in obj_ids:
+        k = o.binary() if hasattr(o, "binary") else o
+        if st is not None and st.owned.owns(k):
+            st.owned.free(k)
+            continue
+        owner = get_hint(k)
+        if owner is not None and st is not None:
+            owner_frees.setdefault(owner, []).append(k)
+            drop_hint(k)
+            continue
+        rest.append(o)
+    for owner, ks in owner_frees.items():
+        try:
+            st.get_conn(hint_addr(owner)).send({"op": "free", "ids": ks})
+        except Exception:
+            pass
+    return rest
+
+
+def add_done_callback_owned(obj_id: ObjectID, cb) -> bool:
+    """Wire a done callback for an owned id; returns False if not owned."""
+    st = _state
+    k = obj_id.binary()
+    if st is None:
+        return False
+    e = st.owned.entry(k)
+    if e is None or e.state == REDIRECT:
+        if get_hint(k) is not None:
+            def _fetch():
+                try:
+                    handled, v = maybe_get_owned(obj_id)
+                    cb(v, None) if handled else cb(None, ObjectLostError("owner lost"))
+                except BaseException as err:  # noqa: BLE001
+                    cb(None, err)
+
+            threading.Thread(target=_fetch, daemon=True).start()
+            return True
+        return False
+
+    def _deliver():
+        try:
+            handled, v = maybe_get_owned(obj_id)
+            if handled:
+                cb(v, None)
+            else:
+                try:
+                    cb(st.client.get_object(obj_id), None)
+                except BaseException as err:  # noqa: BLE001
+                    cb(None, err)
+        except BaseException as err:  # noqa: BLE001
+            cb(None, err)
+
+    if not st.owned.add_callback(k, lambda: threading.Thread(target=_deliver, daemon=True).start()):
+        return False
+    return True
+
+
+def owned_location(k: bytes) -> str | None:
+    """Node hex for owned/hinted ids (locations API)."""
+    st = _state
+    if st is not None and st.owned.owns(k):
+        return st.node_hex or None
+    owner = get_hint(k)
+    if owner is not None:
+        return hint_node_hex(owner)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# promotion: hand an owned object to the head before a head-path submit
+# ---------------------------------------------------------------------------
+def promote(client, k: bytes) -> bool:
+    """Move an owned object into the head store so head-side pinning,
+    lineage and locations all see it. Idempotent."""
+    st = _state
+    if st is None:
+        return False
+    oid = ObjectID(k)
+    if st is not None:
+        e = st.owned.entry(k)
+        if e is not None:
+            if e.state == REDIRECT:
+                return True
+            if e.state == PENDING:
+                e = st.owned.wait_entry(k, 120.0)
+                if e is None:
+                    raise ObjectLostError(f"object {oid.hex()[:16]} was freed by its owner")
+            if e.state == ERROR:
+                payload = _encode_err(e.error)
+            elif e.state == VALUE:
+                from ray_tpu.core.payloads import encode_value
+
+                payload = encode_value(e.value, obj_id=oid)
+            elif e.state == READY:
+                payload = e.payload
+            else:
+                return False
+            _put_payload(client, oid, payload)
+            st.owned.complete(k, redirect=True)
+            drop_hint(k)
+            return True
+    owner = get_hint(k)
+    if owner is None:
+        return False
+    try:
+        resp = st.get_conn(hint_addr(owner)).request("get", timeout=120.0, id=k)
+    except (ConnectionError, OSError):
+        drop_hint(k)
+        raise ObjectLostError(f"object {oid.hex()[:16]}: owner at {owner} is gone") from None
+    if resp.get("not_owned"):
+        drop_hint(k)
+        return True  # already at the head
+    if resp.get("error") is not None:
+        payload = _encode_err(resp["error"])
+    else:
+        payload = resp["payload"]
+    _put_payload(client, oid, payload)
+    drop_hint(k)
+    return True
+
+
+def _encode_err(err):
+    from ray_tpu.core.payloads import encode_value
+
+    return encode_value(err)
+
+
+def _put_payload(client, oid: ObjectID, payload: Payload):
+    if hasattr(client, "put_payload"):
+        client.put_payload(oid, payload)
+    else:
+        client.call("put_object", obj_id=oid, payload=payload)
+
+
+def promote_argspecs(client, arg_specs, kw_specs):
+    """Before a head-path submit: promote every owned ref appearing as a
+    top-level arg or contained inside an inline payload."""
+    st = _state
+    if st is None:
+        return
+    for a in list(arg_specs or []) + list((kw_specs or {}).values()):
+        if a.ref is not None and is_owned_or_hinted(a.ref.binary()):
+            promote(client, a.ref.binary())
+            a.owner = None  # now head-owned; resolve via the store
+        if a.payload is not None:
+            for c in a.payload.contained or []:
+                if is_owned_or_hinted(c.binary()):
+                    promote(client, c.binary())
+
+
+def promote_contained(client, serialized):
+    """Promote owned refs contained in a value headed for the head store."""
+    st = _state
+    if st is None:
+        return
+    for r in serialized.contained_refs:
+        if is_owned_or_hinted(r.id.binary()):
+            promote(client, r.id.binary())
+
+
+def try_reconstruct(client, obj_id: ObjectID) -> bool:
+    """Owner-side lineage replay hook for client get paths: called when
+    the head reports a head-sealed direct result lost."""
+    st = _state
+    if st is None:
+        return False
+    try:
+        return st.reconstruct(client, obj_id)
+    except Exception:
+        return False
+
+
+def cancel_owned(client, obj_id: ObjectID, force: bool = False) -> bool:
+    """Cancel an in-flight direct call producing obj_id. Cooperative: the
+    executing worker checks a cancelled set before starting. force=True
+    additionally asks the head to terminate a LEASED worker (the direct
+    analogue of cancel_task(force=True)); the conn death then fails the
+    call over, where the cancelled mark turns it into a cancel error
+    instead of a retry. Returns True when handled; False = not a live
+    direct call of ours (caller falls through to the head path)."""
+    st = _state
+    if st is None:
+        return False
+    k = obj_id.binary()
+    e = st.owned.entry(k)
+    if e is None or e.state != PENDING:
+        return False
+    tid = obj_id.task_id().binary()
+    with st._conns_lock:
+        conns = list(st._conns.values())
+    for c in conns:
+        with c._lock:
+            recs = list(c._calls.values())
+        for rec in recs:
+            if rec.task_id.binary() == tid:
+                rec.cancelled = True
+                try:
+                    c.send({"op": "cancel", "task": tid})
+                except Exception:
+                    pass
+                if force and rec.kind == "task":
+                    with st._leases_lock:
+                        wid = next((l.wid for l in st.leases if l.conn is c), None)
+                    if wid is not None:
+                        try:
+                            client.terminate_leased_worker(wid)
+                        except Exception:
+                            pass
+                return True
+    return False

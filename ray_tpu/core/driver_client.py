@@ -51,6 +51,17 @@ class DriverClient(WorkerClient):
             threading.Thread(target=self._ref_pump_loop, daemon=True, name="rt-ref-pump").start()
         else:
             set_ref_counting(False)
+        # direct call plane: an attached driver owns its small objects and
+        # calls actors/leased workers without the head in the loop
+        from ray_tpu.core import direct as _direct
+
+        dk = welcome.get("direct_authkey")
+        _direct.attach(
+            self,
+            bytes.fromhex(dk) if dk else None,
+            node_hex=welcome["node_id"],
+            serve=True,
+        )
 
     def _check_alive_locked(self):
         # Runs under the SAME lock the pump's fail-fast flush takes: a
@@ -94,6 +105,9 @@ class DriverClient(WorkerClient):
         if self._shutdown:
             return
         self._shutdown = True
+        from ray_tpu.core import direct as _direct
+
+        _direct.detach(self)
         try:
             self._send({"type": "driver_bye"})
         except Exception:

@@ -132,16 +132,21 @@ class MemoryMonitor:
             pass
 
     def _pick_victim(self):
-        """Largest-RSS busy worker whose running tasks are all retriable
-        (worker_killing_policy: prefer retriable, spare actors)."""
+        """Largest-RSS busy/leased worker whose running tasks are all
+        retriable (worker_killing_policy: prefer retriable, spare actors).
+        Leased workers (direct call plane) are always retriable victims:
+        non-retriable tasks never take the lease path (api.py routes
+        max_retries=0 through the head), and killing a leased worker makes
+        the callers' failover resubmit its in-flight calls."""
         best = None
         for node in self.rt.node_list():
             for w in list(node.workers.values()):
-                if w.state != "busy":
+                if w.state not in ("busy", "leased"):
                     continue
-                specs = [s for s, _ in w.running_tasks.values()]
-                if not specs or not all(s.max_retries > 0 for s in specs):
-                    continue
+                if w.state == "busy":
+                    specs = [s for s, _ in w.running_tasks.values()]
+                    if not specs or not all(s.max_retries > 0 for s in specs):
+                        continue
                 pid = getattr(w.proc, "pid", None)
                 if not pid:
                     continue

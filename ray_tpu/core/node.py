@@ -121,8 +121,11 @@ class WorkerHandle:
     proc: object
     conn: object  # driver-side end of the duplex pipe
     node_id: NodeID
-    state: str = "starting"  # starting | idle | busy | actor | retiring | dead
+    state: str = "starting"  # starting | idle | busy | actor | leased | retiring | dead
     actor_id: object = None
+    # direct call plane: this worker's own listener (host, port), reported
+    # in its ready message (core/direct.py)
+    direct_addr: object = None
     # fresh = has never executed user code; TPU tasks require a fresh worker
     # (chip-isolation env must precede any possible jax import)
     fresh: bool = True

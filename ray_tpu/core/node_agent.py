@@ -189,6 +189,10 @@ def agent_entry(
     except OSError:
         my_ip = "127.0.0.1"
     transfer_srv = transport.ObjectTransferServer(transfer_authkey, advertise_host=my_ip)
+    # workers' direct-call servers must advertise an address other hosts
+    # can dial (core/direct.py); same interface the agent reaches the
+    # head on
+    env.setdefault("RT_DIRECT_HOST", my_ip)
 
     def send_hello(c):
         c.send(

@@ -90,12 +90,26 @@ def local_ref_count(obj_id: ObjectID) -> int:
         return _rc_counts.get(obj_id.binary(), 0)
 
 
+_note_hint = None  # lazily bound direct.note_hint (avoids per-ref import)
+_get_hint = None  # lazily bound direct.get_hint
+
+
 class ObjectRef:
     __slots__ = ("id", "_owner_hint", "__weakref__")
 
     def __init__(self, obj_id: ObjectID, owner_hint: str | None = None):
         self.id = obj_id
         self._owner_hint = owner_hint
+        if owner_hint is not None:
+            # remember who owns this object so get/free/borrow events can
+            # go straight to the owner (core/direct.py ownership model)
+            global _note_hint, _get_hint
+            if _note_hint is None:
+                from ray_tpu.core.direct import get_hint as _gh
+                from ray_tpu.core.direct import note_hint as _nh
+
+                _note_hint, _get_hint = _nh, _gh
+            _note_hint(obj_id.binary(), owner_hint)
         _incref(obj_id)
 
     def __del__(self):
@@ -154,7 +168,13 @@ class ObjectRef:
         stack = getattr(_ref_sink, "stack", None)
         if stack:
             stack[-1].append(self.id)
-        return (ObjectRef, (self.id, self._owner_hint))
+        hint = self._owner_hint
+        if hint is None and _get_hint is not None:
+            # a ref rebuilt without its hint attribute (raw-id construction
+            # in library code) still travels with the owner it was learned
+            # to have in this process
+            hint = _get_hint(self.id.binary())
+        return (ObjectRef, (self.id, hint))
 
 
 class ObjectRefGenerator:

@@ -146,6 +146,11 @@ class Runtime:
         # persistent — reconnecting agents still hold the old keys.
         self._transfer_authkey = self._persistent_secret("transfer_authkey")
         self._listener_authkey = self._persistent_secret("listener_authkey")
+        self._direct_authkey = self._persistent_secret("direct_authkey")
+        # worker leases for the direct call plane (core/direct.py):
+        # wid -> (node, resources, owner_hex)
+        self._leases: dict = {}
+        self._leases_lock = threading.Lock()
         if not local_mode:
             adv = self.cfg.node_manager_host
             if adv in ("", "0.0.0.0"):
@@ -247,6 +252,16 @@ class Runtime:
             self.add_node(dict(base_res))
 
         self.store.listeners.append(self._on_sealed)
+        # direct call plane for the in-process driver: own a small-object
+        # store + serve it to workers (core/direct.py ownership model)
+        from ray_tpu.core import direct as _direct_mod
+
+        self._direct = _direct_mod.attach(
+            self,
+            self._direct_authkey if (self.cfg.direct_calls and not local_mode) else None,
+            node_hex=self.node_id.hex(),
+            serve=True,
+        )
         if not local_mode:
             self._io_thread = threading.Thread(target=self._io_loop, daemon=True, name="rt-io")
             self._io_thread.start()
@@ -348,6 +363,8 @@ class Runtime:
 
         if tracing.enabled():
             env["RT_TRACING"] = "1"
+        if self.cfg.direct_calls and not self.local_mode:
+            env["RT_DIRECT_AUTHKEY"] = self._direct_authkey.hex()
         return env
 
     def _register_node_transfer(self, node):
@@ -377,6 +394,7 @@ class Runtime:
                 "session_pid": os.getpid(),
                 "namespace": self.namespace,
                 "hostname": _socket.gethostname(),
+                "direct_authkey": self._direct_authkey.hex() if self.cfg.direct_calls else None,
             }
         )
         # register only after the welcome went through: a dialer that died
@@ -404,6 +422,7 @@ class Runtime:
             with self._drivers_lock:
                 self._drivers.pop(wid_hex, None)
             self._drop_holder(wid_hex)
+            self._release_leases_of_owner(wid_hex)
             try:
                 handle.conn.close()
             except Exception:
@@ -504,8 +523,13 @@ class Runtime:
     # object plane (CoreClient impl)
     # ------------------------------------------------------------------
     def put_object(self, value) -> ObjectRef:
+        from ray_tpu.core import direct as _direct
+
+        ref, s = _direct.try_put(value)
+        if ref is not None:
+            return ref
         obj_id = ObjectID.from_put()
-        self.store.put_serialized(obj_id, _to_serialized(value))
+        self.store.put_serialized(obj_id, s if s is not None else _to_serialized(value))
         return ObjectRef(obj_id)
 
     def put_payload(self, obj_id: ObjectID, payload: Payload):
@@ -518,6 +542,24 @@ class Runtime:
             self.store.seal(obj_id, StoredObject(value=payload.inline, contained_refs=contained))
 
     def get_object(self, obj_id: ObjectID, timeout: float | None = None, _depth: int = 0):
+        from ray_tpu.core import direct as _direct
+        from ray_tpu.exceptions import ObjectLostError
+
+        for _attempt in range(3):
+            handled, v = _direct.maybe_get_owned(obj_id, timeout)
+            if handled:
+                return v
+            try:
+                return self._get_object_store(obj_id, timeout)
+            except ObjectLostError:
+                # owner-side lineage: a head-sealed direct result can be
+                # replayed by its owner (this process) even though the
+                # head never saw the producing task
+                if not _direct.try_reconstruct(self, obj_id):
+                    raise
+        raise ObjectLostError(f"object {obj_id.hex()[:16]} lost repeatedly despite lineage replay")
+
+    def _get_object_store(self, obj_id: ObjectID, timeout: float | None = None):
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             entry = self._get_entry_reconstructing(obj_id, deadline)
@@ -568,9 +610,18 @@ class Runtime:
         return Payload(inline=Serialized(header=s.header, buffers=[bytes(b) for b in s.buffers]))
 
     def wait_ready(self, obj_ids, num_returns=1, timeout=None, fetch_local=True):
-        return self.store.wait_ready(obj_ids, num_returns, timeout)
+        from ray_tpu.core import direct as _direct
+
+        return _direct.wait_mixed(
+            self, list(obj_ids), num_returns, timeout,
+            lambda ids, nr, t: self.store.wait_ready(ids, nr, t),
+        )
 
     def add_done_callback(self, obj_id: ObjectID, cb):
+        from ray_tpu.core import direct as _direct
+
+        if _direct.add_done_callback_owned(obj_id, cb):
+            return
         with self._dc_lock:
             if not self.store.contains(obj_id):
                 self._done_callbacks.setdefault(obj_id, []).append(cb)
@@ -585,7 +636,9 @@ class Runtime:
             cb(None, e)
 
     def free_objects(self, obj_ids):
-        for oid in obj_ids:
+        from ray_tpu.core import direct as _direct
+
+        for oid in _direct.free_owned(list(obj_ids)):
             self.store.delete(oid)
 
     def dump_worker_stacks(self, worker_prefix: str = "", timeout: float = 10.0) -> dict:
@@ -639,12 +692,14 @@ class Runtime:
         get_object_locations). The shm namespace tag IS the location
         record: a descriptor's ns maps to the node holding the bytes;
         inline/spilled values live with the head. None = unknown/unsealed."""
+        from ray_tpu.core import direct as _direct
+
         out = {}
         head_hex = self.node_id.hex()
         for oid in obj_ids:
             entry = self.store.try_get_entry(oid)
             if entry is None:
-                out[oid.hex()] = None
+                out[oid.hex()] = _direct.owned_location(oid.binary())
             elif entry.shm is None or not entry.shm.ns or entry.shm.ns == self._head_ns:
                 out[oid.hex()] = head_hex
             else:
@@ -1598,12 +1653,20 @@ class Runtime:
         object whose last known holder vanished."""
         from ray_tpu.core.object_ref import drain_ref_events
 
+        from ray_tpu.core import direct as _direct
+
         while not self._stopped:
             time.sleep(self.cfg.ref_counting_interval_s)
             if self._stopped:
                 return
             try:
-                for k, registered in drain_ref_events():
+                events = drain_ref_events()
+                st = _direct.state()
+                if st is not None and st.client is self:
+                    # owned-object events apply owner-locally; remote-owned
+                    # events flow to their owners (core/direct.py)
+                    events = st.route_ref_events(events)
+                for k, registered in events:
                     if not registered:
                         self._maybe_free_object(k)
             except Exception:
@@ -1771,12 +1834,22 @@ class Runtime:
         if not rpc_chaos.apply(t):
             return  # chaos: per-message-type fault injection (done, stream_item, ...)
         if t == "ready":
+            if msg.get("direct_addr"):
+                w.direct_addr = tuple(msg["direct_addr"])
             if w.state == "starting":
                 w.state = "idle"
                 w.last_idle = time.monotonic()
             self.scheduler.wake()
         elif t == "done":
             self._on_task_done(node, w, msg)
+        elif t == "seal":
+            # a worker completed a direct call with large results: they
+            # live in shm under head ownership (core/direct.py)
+            for oid, payload in msg["items"]:
+                self.put_payload(oid, payload)
+        elif t == "task_events":
+            # batched spans of direct-plane executions (observability)
+            self.task_manager.record_external(msg["events"], node_id=node.node_id, worker_id=w.worker_id)
         elif t == "stream_item":
             self._on_stream_item(msg)
         elif self._dispatch_client_msg(w, msg):
@@ -1969,6 +2042,14 @@ class Runtime:
         if w.state == "dead" or self._stopped:
             return
         self._drop_holder(w.worker_id.hex())
+        # direct plane: reclaim the lease ON this worker and any leases it
+        # held as a client
+        with self._leases_lock:
+            lease = self._leases.pop(w.worker_id, None)
+        if lease is not None:
+            lnode, res, _owner = lease
+            lnode.release(res)
+        self._release_leases_of_owner(w.worker_id.hex())
         if w.state == "retiring":
             self._finish_retirement(node, w)
             return
@@ -2053,6 +2134,10 @@ class Runtime:
     def _handle_client_req(self, w: WorkerHandle, msg: dict):
         method = msg["method"]
         params = msg["params"]
+        if method == "lease_worker":
+            # lease ownership rides the requesting channel's identity so a
+            # dead client's leases can be reclaimed
+            params = {**params, "_owner": w.worker_id.hex()}
         try:
             handler = getattr(self, f"_rpc_{method}", None)
             if handler is None:
@@ -2117,6 +2202,15 @@ class Runtime:
     def _rpc_get_function(self, func_id):
         return self.get_function_blob(func_id)
 
+    def _rpc_actor_endpoint(self, actor_id):
+        return self.actor_endpoint(actor_id)
+
+    def _rpc_lease_worker(self, _owner=""):
+        return self.lease_worker(owner=_owner)
+
+    def _rpc_release_lease(self, wid):
+        return self.release_lease(wid)
+
     def _rpc_cluster_info(self, kind):
         return self.cluster_info(kind)
 
@@ -2177,6 +2271,113 @@ class Runtime:
                             pass
                         return True
         return False
+
+    # ------------------------------------------------------------------
+    # direct call plane: endpoints + worker leases (core/direct.py;
+    # reference: cluster_lease_manager.h lease-based scheduling)
+    # ------------------------------------------------------------------
+    def actor_endpoint(self, actor_id) -> dict | None:
+        """Direct address of an ALIVE actor's worker, or None (caller then
+        stays on the head path, which owns PENDING/RESTARTING queueing)."""
+        if isinstance(actor_id, str):
+            actor_id = ActorID.from_hex(actor_id)
+        astate = self.actors.get(actor_id)
+        if astate is None:
+            return None
+        info = astate.info
+        if info.state != "ALIVE":
+            return None
+        node = self.nodes.get(info.node_id)
+        w = node.workers.get(info.worker_id) if node else None
+        if w is None or not w.alive() or w.direct_addr is None:
+            return None
+        return {
+            "addr": w.direct_addr,
+            "epoch": info.num_restarts,
+            "max_task_retries": info.max_task_retries,
+        }
+
+    def lease_worker(self, owner: str = "") -> dict | None:
+        """Reserve one CPU and a worker for direct task submission. The
+        worker leaves the dispatch pool until the lease is released."""
+        res = {"CPU": 1.0}
+        for node in self.node_list():
+            if getattr(node, "remote", False) and not node.workers:
+                continue
+            if not node.allocate(res):
+                continue
+            w = self._claim_lease_worker(node)
+            if w is None:
+                node.release(res)
+                continue
+            with self._leases_lock:
+                self._leases[w.worker_id] = (node, res, owner)
+            return {"wid": w.worker_id.hex(), "addr": w.direct_addr}
+        return None
+
+    def _claim_lease_worker(self, node: Node, timeout: float = 15.0):
+        """An idle unbound worker with a direct address; spawns one if the
+        pool is empty (bounded wait for its ready handshake)."""
+        deadline = time.monotonic() + timeout
+        spawned = False
+        while time.monotonic() < deadline and not self._stopped:
+            with node._lock:
+                for w in node.workers.values():
+                    if w.state == "idle" and not w.env_binding and w.direct_addr is not None:
+                        w.state = "leased"
+                        return w
+                starting = any(w.state == "starting" for w in node.workers.values())
+            if not starting and not spawned:
+                try:
+                    node.start_worker()
+                    spawned = True
+                except RuntimeError:
+                    return None
+            time.sleep(0.005)
+        return None
+
+    def release_lease(self, wid_hex: str) -> bool:
+        from ray_tpu.core.ids import WorkerID
+
+        wid = WorkerID.from_hex(wid_hex) if isinstance(wid_hex, str) else wid_hex
+        with self._leases_lock:
+            lease = self._leases.pop(wid, None)
+        if lease is None:
+            return False
+        node, res, _owner = lease
+        node.release(res)
+        w = node.workers.get(wid)
+        if w is not None and w.state == "leased":
+            w.state = "idle"
+            w.last_idle = time.monotonic()
+            self.scheduler.wake()
+        return True
+
+    def terminate_leased_worker(self, wid_hex: str) -> bool:
+        """force-cancel support for direct-plane tasks: kill a LEASED
+        worker (only — never an actor/busy worker) so the caller's conn
+        death completes the cancelled call."""
+        from ray_tpu.core.ids import WorkerID
+
+        wid = WorkerID.from_hex(wid_hex) if isinstance(wid_hex, str) else wid_hex
+        for node in self.node_list():
+            w = node.workers.get(wid)
+            if w is not None and w.state == "leased":
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
+                return True
+        return False
+
+    def _rpc_terminate_leased_worker(self, wid):
+        return self.terminate_leased_worker(wid)
+
+    def _release_leases_of_owner(self, owner_hex: str):
+        with self._leases_lock:
+            doomed = [wid for wid, (_, _, o) in self._leases.items() if o == owner_hex]
+        for wid in doomed:
+            self.release_lease(wid)
 
     def cluster_info(self, kind: str):
         if kind == "nodes":
@@ -2321,6 +2522,9 @@ class Runtime:
         if self._stopped:
             return
         self._stopped = True
+        from ray_tpu.core import direct as _direct_mod
+
+        _direct_mod.detach(self)
         if getattr(self, "_log_monitor", None) is not None:
             self._log_monitor.stop()  # joins the poll thread
             self._log_monitor.poll_once()  # final race-free flush

@@ -107,6 +107,41 @@ class TaskManager:
                 if a.payload is not None and a.payload.shm is not None:
                     unlink_shm(a.payload.shm.shm_name)
 
+    def record_external(self, records: list[dict], node_id=None, worker_id=None):
+        """Batched task events from direct-plane executions: the worker
+        executed calls the head never dispatched (core/direct.py) and
+        flushes their spans here so the timeline / state API / lifetime
+        counters stay complete (reference: core_worker
+        task_event_buffer.h flushing task events to the GCS)."""
+        from ray_tpu.core.ids import ActorID
+
+        with self._lock:
+            for r in records:
+                tid = TaskID(r["task"])
+                if tid in self._tasks:
+                    continue
+                spec = TaskSpec(
+                    task_id=tid,
+                    name=r.get("name", "direct"),
+                    func_id="",
+                    args=[],
+                    actor_id=ActorID.from_hex(r["actor"]) if r.get("actor") else None,
+                )
+                st = TaskState(spec)
+                start, end = r.get("start", time.time()), r.get("end", time.time())
+                st.submitted_at = start
+                st.status = "FINISHED" if r.get("ok", True) else "FAILED"
+                st.events = [("PENDING", start), ("RUNNING", start), (st.status, end)]
+                st.attempts_done = 1
+                st.node_id = node_id
+                st.worker_id = worker_id
+                self._tasks[tid] = st
+                self._order.append(tid)
+                self.lifetime_submitted += 1
+                if st.status == "FINISHED":
+                    self.lifetime_finished += 1
+            self._prune_locked()
+
     def get(self, task_id: TaskID) -> TaskState | None:
         with self._lock:
             return self._tasks.get(task_id)

@@ -30,10 +30,13 @@ class Payload:
 class ArgSpec:
     """One task argument: a payload (by value) or an object ref (by
     reference, resolved by the scheduler before dispatch — or fetched by the
-    executing worker if nested)."""
+    executing worker if nested). ``owner`` carries the owner address of a
+    direct-plane owned object (core/direct.py): the executing worker pulls
+    the value straight from the owner instead of asking the head."""
 
     payload: Payload | None = None
     ref: ObjectID | None = None
+    owner: str | None = None
 
 
 @dataclass

@@ -24,7 +24,8 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 
 from ray_tpu.core import context
-from ray_tpu.core.ids import ObjectID
+from ray_tpu.core import direct as _direct
+from ray_tpu.core.ids import ObjectID, TaskID
 from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.core.payloads import decode_payload, encode_value
 from ray_tpu.core.serialization import deserialize_s
@@ -74,6 +75,9 @@ class WorkerClient:
 
         try:
             events = drain_ref_events()
+            st = _direct.state()
+            if st is not None:
+                events = st.route_ref_events(events)  # owned events go to owners
             if events:
                 msg["ref_events"] = [(k.hex(), reg) for k, reg in events]
         except Exception:
@@ -131,8 +135,22 @@ class WorkerClient:
 
     # ---------------- CoreClient API ----------------
     def get_object(self, obj_id: ObjectID, timeout: float | None = None):
+        from ray_tpu.exceptions import ObjectLostError
+
+        handled, v = _direct.maybe_get_owned(obj_id, timeout)
+        if handled:
+            return v
         for attempt in range(3):
-            payload = self.call("get_object", obj_id=obj_id, timeout_s=timeout, timeout=None)
+            try:
+                payload = self.call("get_object", obj_id=obj_id, timeout_s=timeout, timeout=None)
+            except ObjectLostError:
+                # owner-side lineage replay for head-sealed direct results
+                if _direct.try_reconstruct(self, obj_id):
+                    handled, v = _direct.maybe_get_owned(obj_id, timeout)
+                    if handled:
+                        return v
+                    continue
+                raise
             try:
                 value, seg = decode_payload(payload, zero_copy=True)
             except FileNotFoundError:
@@ -146,15 +164,29 @@ class WorkerClient:
         raise FileNotFoundError(f"object {obj_id.hex()[:16]} backing store repeatedly lost")
 
     def put_object(self, value) -> ObjectRef:
+        ref, s = _direct.try_put(value)
+        if ref is not None:
+            return ref
+        from ray_tpu.core.payloads import encode_serialized
+
         obj_id = ObjectID.from_put()
-        payload = encode_value(value, obj_id=obj_id)
+        payload = encode_serialized(s, obj_id=obj_id)
         self.call("put_object", obj_id=obj_id, payload=payload)
         return ObjectRef(obj_id)
 
+    def put_payload(self, obj_id: ObjectID, payload):
+        self.call("put_object", obj_id=obj_id, payload=payload)
+
     def wait_ready(self, obj_ids, num_returns=1, timeout=None, fetch_local=True):
-        return self.call("wait_ready", obj_ids=list(obj_ids), num_returns=num_returns, timeout_s=timeout, timeout=None)
+        return _direct.wait_mixed(
+            self, list(obj_ids), num_returns, timeout,
+            lambda ids, nr, t: self.call("wait_ready", obj_ids=list(ids), num_returns=nr, timeout_s=t, timeout=None),
+        )
 
     def add_done_callback(self, obj_id, cb):
+        if _direct.add_done_callback_owned(obj_id, cb):
+            return
+
         # Poll-free callback support for workers: run a waiter thread.
         def _wait():
             try:
@@ -188,13 +220,40 @@ class WorkerClient:
         return ObjectRef(oid) if oid is not None else None
 
     def free_objects(self, obj_ids):
+        rest = _direct.free_owned(list(obj_ids))
+        if not rest:
+            return
         try:
-            self.call("free_objects", obj_ids=list(obj_ids))
+            self.call("free_objects", obj_ids=rest)
         except Exception:
             pass
 
+    # ---------------- direct-plane head RPCs ----------------
+    def actor_endpoint(self, actor_hex: str):
+        return self.call("actor_endpoint", actor_id=actor_hex)
+
+    def lease_worker(self):
+        return self.call("lease_worker")
+
+    def release_lease(self, wid: str):
+        return self.call("release_lease", wid=wid)
+
+    def terminate_leased_worker(self, wid: str):
+        return self.call("terminate_leased_worker", wid=wid)
+
     def object_locations(self, obj_ids) -> dict:
-        return self.call("object_locations", obj_ids=list(obj_ids))
+        ids = list(obj_ids)
+        out = {}
+        rest = []
+        for o in ids:
+            loc = _direct.owned_location(o.binary())
+            if loc is not None or _direct.is_owned_or_hinted(o.binary()):
+                out[o.hex()] = loc
+            else:
+                rest.append(o)
+        if rest:
+            out.update(self.call("object_locations", obj_ids=rest))
+        return out
 
     def cluster_info(self, kind: str):
         return self.call("cluster_info", kind=kind)
@@ -227,6 +286,9 @@ class WorkerClient:
 
         def one(a):
             if a.ref is not None:
+                if getattr(a, "owner", None):
+                    # direct-plane owned argument: fetch from its owner
+                    _direct.note_hint(a.ref.binary(), a.owner)
                 return self.get_object(a.ref)
             try:
                 v, seg = decode_payload(a.payload, zero_copy=True)
@@ -418,6 +480,207 @@ class WorkerClient:
         finally:
             self._cancelled_streams.discard(spec.task_id)
 
+    # ---------------- direct-plane execution ----------------
+    def _direct_exec_handler(self, msg, reply, conn_funcs):
+        """Server hook (core/direct.py): a peer submitted a call straight
+        to this worker. Runs on the same exec lane as head-dispatched work
+        so per-actor ordering and max_concurrency hold."""
+        self._exec_pool.submit(self._execute_direct, msg, reply, conn_funcs)
+
+    def _reply_direct_raw(self, msg, values, reply):
+        """Fast-path reply: plain values ride the result frame as one
+        pickle. Falls back (False) for cloudpickle-only or store-sized
+        results."""
+        import pickle as _pickle
+
+        from ray_tpu._config import get_config
+        from ray_tpu.core import object_ref as _oref
+
+        sink: list = []
+        token = _oref.push_ref_sink(sink)
+        try:
+            data = _pickle.dumps(
+                {"op": "result", "cid": msg["cid"], "vals": values, "error": None},
+                protocol=5,
+            )
+        except Exception:
+            return False
+        finally:
+            _oref.pop_ref_sink(token)
+        if len(data) > get_config().max_direct_call_object_size:
+            return False
+        if sink:
+            self._keepalive_refs(sink)
+        reply(data)
+        return True
+
+    def _buffer_task_event(self, msg, started: float, ok: bool):
+        """Buffer one direct-execution span; the ref pump flushes batches
+        to the head (observability parity: task_event_buffer.h)."""
+        buf = getattr(self, "_task_event_buf", None)
+        if buf is None:
+            buf = self._task_event_buf = []
+        actor = msg.get("actor")
+        buf.append({
+            "task": msg["task"],
+            "name": msg["method"],
+            "actor": actor.hex() if actor else None,
+            "start": started,
+            "end": time.time(),
+            "ok": ok,
+        })
+
+    def _flush_task_events(self):
+        buf = getattr(self, "_task_event_buf", None)
+        if buf:
+            events, self._task_event_buf = buf, []
+            try:
+                self._send({"type": "task_events", "events": events})
+            except Exception:
+                pass
+
+    def _keepalive_refs(self, contained_ids, hold_s: float = 3.0):
+        import collections
+
+        ka = getattr(self, "_direct_keepalive", None)
+        if ka is None:
+            ka = self._direct_keepalive = collections.deque()
+        now = time.monotonic()
+        ka.append((now + hold_s, [ObjectRef(c) for c in contained_ids]))
+        while ka and ka[0][0] < now:
+            ka.popleft()
+
+    def _prune_keepalive(self):
+        """Timer-driven keepalive expiry (the append-time prune alone
+        would hold the LAST call's pins for the worker's lifetime)."""
+        ka = getattr(self, "_direct_keepalive", None)
+        if ka:
+            now = time.monotonic()
+            while ka and ka[0][0] < now:
+                ka.popleft()
+
+    def _direct_fn(self, func_id: str, conn_funcs: dict):
+        fn = self._func_cache.get(func_id)
+        if fn is None:
+            blob = conn_funcs.get(func_id)
+            if blob is None:
+                raise RuntimeError(f"direct call for unregistered function {func_id[:12]}")
+            fn = deserialize_s(blob)
+            self._func_cache[func_id] = fn
+        return fn
+
+    def _execute_direct(self, msg, reply, conn_funcs):
+        trace = msg.get("trace")
+        if trace is not None:
+            from ray_tpu.util import tracing
+
+            with tracing.span(
+                f"task::{msg['method']}", kind="server", parent_ctx=tuple(trace),
+                task_id=msg["task"].hex(),
+            ):
+                return self._execute_direct_inner(msg, reply, conn_funcs)
+        return self._execute_direct_inner(msg, reply, conn_funcs)
+
+    def _execute_direct_inner(self, msg, reply, conn_funcs):
+        tid = TaskID(msg["task"])
+        st = _direct.state()
+        if st is not None and msg["task"] in st.cancelled_direct:
+            st.cancelled_direct.discard(msg["task"])
+            from ray_tpu.exceptions import RayTpuError
+
+            reply({"op": "result", "cid": msg["cid"], "returns": [],
+                   "error": RayTpuError(f"task {tid.hex()[:8]} was cancelled")})
+            return
+        self.current_task_id = tid
+        started = time.time()
+        ok = True
+        segs = []
+        try:
+            if msg.get("actor") is not None:
+                fn = self._actor_method(msg["method"])
+            else:
+                fn = self._direct_fn(msg["func_id"], conn_funcs)
+            rawp = msg.get("rawp")
+            if rawp is not None:
+                # fast path: (args, kwargs) ride the frame as one blob
+                import pickle as _pickle
+
+                args, kwargs = _pickle.loads(rawp)
+                kwargs = kwargs or {}
+            else:
+                args, kwargs, segs = self._decode_args(msg["args"], msg.get("kwargs"))
+            try:
+                result = fn(*args, **kwargs)
+            finally:
+                del args, kwargs
+            if inspect.iscoroutine(result):
+                self._complete_async_direct(msg, result, reply)
+                return  # the loop callback buffers the span
+            if inspect.isgenerator(result):
+                result = list(result)
+            self._reply_direct(msg, result, reply)
+            self._buffer_task_event(msg, started, True)
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, TaskError) else TaskError.from_exception(
+                e, task_desc=f"{msg['method']}[{tid.hex()[:8]}]"
+            )
+            try:
+                reply({"op": "result", "cid": msg["cid"], "returns": [], "error": err})
+            except Exception:
+                pass
+            self._buffer_task_event(msg, started, False)
+        finally:
+            self._release_segments(segs)
+            self.current_task_id = None
+
+    def _reply_direct(self, msg, result, reply):
+        tid = TaskID(msg["task"])
+        nr = msg.get("num_returns", 1)
+        values = [result] if nr == 1 else list(result)
+        if len(values) != nr:
+            raise ValueError(f"direct call {msg['method']} returned {len(values)} values, expected {nr}")
+        if self._reply_direct_raw(msg, values, reply):
+            return
+        returns, seals = [], []
+        for i, v in enumerate(values):
+            oid = ObjectID.for_task_return(tid, i)
+            payload = encode_value(v, obj_id=oid)
+            head_owned = payload.shm is not None
+            if head_owned:
+                seals.append((oid, payload))
+            if payload.contained:
+                # refs pickled inside the result: hold them past the reply
+                # so the caller's borrow registration beats our release
+                # (the direct-plane analogue of the done-piggyback ordering)
+                self._keepalive_refs(payload.contained)
+            returns.append((oid.binary(), payload, head_owned))
+        if seals:
+            # large results go to the shared store under head ownership;
+            # the seal must reach the head BEFORE the caller can act on
+            # the reply (pipe FIFO gives that ordering on this side; the
+            # head blocks unknown-id gets until the seal arrives)
+            self._send_done({"type": "seal", "items": seals})
+        reply({"op": "result", "cid": msg["cid"], "returns": returns, "error": None})
+
+    def _complete_async_direct(self, msg, coro, reply):
+        started = time.time()
+        fut = asyncio.run_coroutine_threadsafe(coro, self._get_actor_loop())
+
+        def _cb(f):
+            ok = True
+            try:
+                self._reply_direct(msg, f.result(), reply)
+            except BaseException as e:  # noqa: BLE001
+                ok = False
+                err = e if isinstance(e, TaskError) else TaskError.from_exception(e, task_desc=msg["method"])
+                try:
+                    reply({"op": "result", "cid": msg["cid"], "returns": [], "error": err})
+                except Exception:
+                    pass
+            self._buffer_task_event(msg, started, ok)
+
+        fut.add_done_callback(_cb)
+
     # -- actors --
     def _create_actor_instance(self, spec, msg):
         cls = self.get_function(spec.func_id)
@@ -491,15 +754,21 @@ class WorkerClient:
     # ---------------- main loop ----------------
     def _ref_pump_loop(self):
         """Flush this process's ref-count transitions to the head (the
-        borrow protocol's worker half; reference_counter.h)."""
+        borrow protocol's worker half; reference_counter.h). Events for
+        direct-plane owned objects are routed to their owners instead."""
         from ray_tpu._config import get_config
         from ray_tpu.core.object_ref import drain_ref_events
 
         interval = max(0.05, get_config().ref_counting_interval_s)
         while not self._shutdown:
             time.sleep(interval)
+            self._flush_task_events()
+            self._prune_keepalive()
             try:
                 events = drain_ref_events()
+                st = _direct.state()
+                if st is not None:
+                    events = st.route_ref_events(events)
                 if events:
                     # one-way message on the worker pipe: FIFO with done
                     # messages, so batches can never be applied out of
@@ -518,7 +787,11 @@ class WorkerClient:
             threading.Thread(target=self._ref_pump_loop, daemon=True, name="rt-ref-pump").start()
         else:
             set_ref_counting(False)
-        self._send({"type": "ready", "worker_id": self.worker_id, "pid": os.getpid()})
+        ready = {"type": "ready", "worker_id": self.worker_id, "pid": os.getpid()}
+        st = _direct.state()
+        if st is not None and st.server is not None:
+            ready["direct_addr"] = st.server.address
+        self._send(ready)
         while not self._shutdown:
             try:
                 msg = self.conn.recv()
@@ -635,4 +908,15 @@ def worker_entry(conn, worker_id: str, node_id: str, env: dict | None = None):
 
     set_fetch_hook(client._fetch_remote_segment)
     context.set_client(client)
+    # direct call plane: serve owned objects + direct executions on this
+    # worker's own socket (core/direct.py); disabled when the head did not
+    # hand out a direct authkey (RT_DIRECT_CALLS=0)
+    dk = os.environ.get("RT_DIRECT_AUTHKEY")
+    _direct.attach(
+        client,
+        bytes.fromhex(dk) if dk else None,
+        node_hex=node_id,
+        serve=True,
+        exec_handler=client._direct_exec_handler,
+    )
     client.run()

@@ -216,8 +216,10 @@ def test_serve_routing_under_inbound_delay(rt):
 
     h = serve.run(Doubler.bind(), name="chaos_app")
     assert h.remote(1).result(timeout_s=60) == 2  # replicas warm
-    # head-node replicas deliver results as 'done' worker messages
+    # results arrive as head-path 'done' messages or direct-plane result
+    # frames (core/direct.py) — delay both inbound paths
     rpc_chaos.inject("done", delay_s=0.03)
+    rpc_chaos.inject("direct_result", delay_s=0.03)
     try:
         lat0 = time.perf_counter()
         results = [h.remote(i).result(timeout_s=120) for i in range(10)]

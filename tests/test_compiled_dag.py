@@ -195,8 +195,10 @@ def test_plain_function_rejected(rt):
 
 
 def test_hop_latency_beats_task_roundtrip(rt):
-    """The compiled steady-state hop must be ~10x under the task round
-    trip (VERDICT round-3 item 2 acceptance bar)."""
+    """The compiled steady-state hop must be well under the task round
+    trip (VERDICT round-3 item 2 acceptance bar was 10x vs the head-path
+    RPC; the round-5 direct call plane cut the plain roundtrip itself
+    ~3x, so the bar here is 4x vs the DIRECT roundtrip)."""
 
     @ray_tpu.remote
     def nop():
@@ -220,6 +222,6 @@ def test_hop_latency_beats_task_roundtrip(rt):
             comp.execute(i).get(timeout=30)
         per_exec = (time.perf_counter() - t0) / N
         per_hop = per_exec / 4  # driver->a->b->c->driver
-        assert per_hop < task_rt / 10, f"hop {per_hop*1e6:.0f}us vs task rt {task_rt*1e6:.0f}us"
+        assert per_hop < task_rt / 4, f"hop {per_hop*1e6:.0f}us vs task rt {task_rt*1e6:.0f}us"
     finally:
         comp.teardown(kill_actors=True)

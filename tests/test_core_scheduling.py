@@ -118,13 +118,15 @@ def test_node_death_task_retry(rt_start):
 
 def test_object_eviction_reconstruction(rt_start):
     """Evicted task outputs are rebuilt via lineage (reference:
-    object_recovery_manager.h:41)."""
+    object_recovery_manager.h:41). Uses a store-sized output: small
+    results live in the OWNER's memory (core/direct.py) and are never
+    evicted — only shm-store objects participate in eviction."""
     import numpy as np
 
     @ray_tpu.remote
     def produce(seed):
         rng = np.random.default_rng(seed)
-        return rng.integers(0, 100, size=(1000,))
+        return rng.integers(0, 100, size=(50_000,))
 
     ref = produce.remote(42)
     first = ray_tpu.get(ref).copy()

@@ -1,0 +1,300 @@
+"""Direct call plane tests (core/direct.py): ownership-based metadata,
+caller->worker actor calls, worker leases, owner-side lineage, failover.
+
+Reference semantics being mirrored: per-owner refcounts + in-owner small
+objects (reference_counter.h), direct actor submission, lease-based task
+scheduling (cluster_lease_manager.h), owner-based lineage replay.
+"""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import context, direct
+
+
+def _state():
+    st = direct.state()
+    assert st is not None, "direct plane should be on by default"
+    return st
+
+
+# ------------------------------------------------------------- owned objects
+def test_small_put_is_owner_local(rt_start):
+    client = context.get_client()
+    ref = ray_tpu.put({"k": 1})
+    # owner-local: never lands in the head store
+    assert not client.store.contains(ref.id)
+    assert _state().owned.owns(ref.id.binary())
+    assert ray_tpu.get(ref) == {"k": 1}
+    # free on last release (grace window)
+    k = ref.id.binary()
+    del ref
+    gc.collect()
+    deadline = time.time() + 10
+    while time.time() < deadline and _state().owned.entry(k) is not None:
+        time.sleep(0.2)
+    assert _state().owned.entry(k) is None, "owned object never freed"
+
+
+def test_large_put_stays_head_owned(rt_start):
+    client = context.get_client()
+    ref = ray_tpu.put(np.zeros(200_000))
+    assert client.store.contains(ref.id)
+    assert not _state().owned.owns(ref.id.binary())
+
+
+def test_worker_fetches_owned_arg_from_owner(rt_start):
+    """A by-ref owned argument travels owner->worker without the head."""
+    ref = ray_tpu.put(list(range(50)))
+
+    @ray_tpu.remote
+    def total(xs):
+        return sum(xs)
+
+    assert ray_tpu.get(total.remote(ref)) == sum(range(50))
+
+
+def test_owned_ref_promoted_for_constrained_task(rt_start):
+    """A constrained (head-path) task promotes owned args to the head."""
+    client = context.get_client()
+    ref = ray_tpu.put(41)
+
+    @ray_tpu.remote(resources={"spice": 1}, num_cpus=0)
+    def inc(x):
+        return x + 1
+
+    node = client.add_node({"CPU": 1, "spice": 1})
+    try:
+        assert ray_tpu.get(inc.remote(ref), timeout=60) == 42
+        # promotion moved it into the head store
+        assert client.store.contains(ref.id)
+    finally:
+        client.remove_node(node.node_id)
+
+
+def test_borrowed_owned_ref_across_workers(rt_start):
+    """Worker A's owned result consumed by worker B via the owner."""
+
+    @ray_tpu.remote
+    def produce():
+        return {"v": 7}
+
+    @ray_tpu.remote
+    def consume(wrapped):
+        import ray_tpu as rt
+
+        return rt.get(wrapped[0])["v"]
+
+    r = produce.remote()
+    # nested (not top-level) so the ref itself travels, exercising the
+    # borrow path from a third process
+    assert ray_tpu.get(consume.remote([r])) == 7
+
+
+# ------------------------------------------------------------- actor calls
+def test_actor_calls_are_direct_and_ordered(rt_start):
+    @ray_tpu.remote
+    class Seq:
+        def __init__(self):
+            self.log = []
+
+        def add(self, i):
+            self.log.append(i)
+            return len(self.log)
+
+        def get_log(self):
+            return list(self.log)
+
+    s = Seq.remote()
+    refs = [s.add.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs[-1]) == 50
+    assert ray_tpu.get(s.get_log.remote()) == list(range(50))
+    # the route went direct (an endpoint was resolved)
+    assert any(r.addr is not None for r in _state().routes.values())
+
+
+def test_lane_switch_preserves_order(rt_start):
+    """Mixing direct calls and head-lane (streaming) calls on one actor
+    keeps per-caller order via the drain fence."""
+
+    @ray_tpu.remote
+    class Rec:
+        def __init__(self):
+            self.log = []
+
+        def mark(self, x):
+            self.log.append(x)
+            return x
+
+        def stream(self, n):
+            for i in range(n):
+                self.log.append(f"s{i}")
+                yield i
+
+        def get_log(self):
+            return list(self.log)
+
+    r = Rec.remote()
+    r.mark.remote("a")
+    gen = r.stream.options(num_returns="streaming").remote(2)  # head lane
+    items = [ray_tpu.get(x) for x in gen]
+    assert items == [0, 1]
+    r.mark.remote("b")  # direct again (fence drains the head lane)
+    log = ray_tpu.get(r.get_log.remote())
+    assert log == ["a", "s0", "s1", "b"], log
+
+
+def test_actor_death_fails_inflight_direct_calls(rt_start):
+    @ray_tpu.remote
+    class Sleeper:
+        def nap(self, s):
+            import time as _t
+
+            _t.sleep(s)
+            return "ok"
+
+    a = Sleeper.remote()
+    assert ray_tpu.get(a.nap.remote(0.01)) == "ok"  # direct route warm
+    slow = a.nap.remote(30)
+    time.sleep(0.3)
+    ray_tpu.kill(a)
+    with pytest.raises(Exception):
+        ray_tpu.get(slow, timeout=30)
+
+
+def test_actor_restart_failover_reruns_direct_call(rt_start):
+    @ray_tpu.remote(max_restarts=2)
+    class Worker:
+        def __init__(self):
+            self.calls = 0
+
+        def work(self, die=False):
+            self.calls += 1
+            if die:
+                import os as _os
+
+                _os._exit(1)
+            return self.calls
+
+    w = Worker.remote()
+    assert ray_tpu.get(w.work.remote()) == 1  # direct route warm
+    dead = w.work.remote(die=True)  # kills the worker mid-direct-call
+    # max_task_retries=0 -> at-most-once: the in-flight call errors...
+    with pytest.raises(Exception):
+        ray_tpu.get(dead, timeout=60)
+    # ...but the actor restarts and the route re-resolves (fresh state)
+    assert ray_tpu.get(w.work.remote(), timeout=60) == 1
+
+
+# ------------------------------------------------------------- task leases
+def test_leased_worker_death_fails_over(rt_start):
+    @ray_tpu.remote(max_retries=3)
+    def flaky(path):
+        import os as _os
+
+        if not _os.path.exists(path):
+            open(path, "w").close()
+            _os._exit(1)  # kill the leased worker mid-call
+        return "second"
+
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".marker") as f:
+        marker = f.name
+    assert ray_tpu.get(flaky.remote(marker), timeout=120) == "second"
+
+
+def test_lease_released_when_idle(rt_start):
+    client = context.get_client()
+
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    assert ray_tpu.get(one.remote()) == 1
+    with client._leases_lock:
+        assert len(client._leases) >= 1  # a lease is live right after use
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        with client._leases_lock:
+            if not client._leases:
+                break
+        time.sleep(0.3)
+    with client._leases_lock:
+        assert not client._leases, "idle leases never returned to the pool"
+
+
+# ------------------------------------------------------------- lineage
+def test_owner_lineage_replays_lost_large_result(rt_start):
+    """A head-sealed direct result evicted from the store is replayed
+    from the OWNER's lineage (the head never saw the producing task)."""
+    client = context.get_client()
+
+    @ray_tpu.remote
+    def big(seed):
+        rng = np.random.default_rng(seed)
+        return rng.integers(0, 100, size=(60_000,))
+
+    ref = big.remote(3)
+    first = ray_tpu.get(ref).copy()
+    assert client.store.contains(ref.id), "large result should be head-sealed"
+    assert client.store.evict(ref.id)
+    second = ray_tpu.get(ref, timeout=60)
+    assert (first == second).all()
+
+
+# ------------------------------------------------------------- chaos
+def test_direct_call_drop_degrades_to_head_path(rt_start):
+    from ray_tpu.core import rpc_chaos
+
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    assert ray_tpu.get(sq.remote(2)) == 4
+    rpc_chaos.inject("direct_call", drop_prob=1.0)
+    try:
+        # every submit degrades to the head path; answers stay right
+        assert ray_tpu.get([sq.remote(i) for i in range(8)], timeout=60) == [i * i for i in range(8)]
+    finally:
+        rpc_chaos.clear()
+
+
+def test_direct_result_drop_triggers_failover(rt_start):
+    from ray_tpu.core import rpc_chaos
+
+    @ray_tpu.remote(max_task_retries=2)
+    class Echo:
+        def hi(self, x):
+            return x
+
+    e = Echo.remote()
+    assert ray_tpu.get(e.hi.remote(1)) == 1  # direct route warm
+    rpc_chaos.inject("direct_result", drop_prob=1.0, max_hits=1)
+    try:
+        # the dropped reply fails the conn; retriable calls fail over to
+        # the head path (at-most-once actors would error instead)
+        assert ray_tpu.get(e.hi.remote(2), timeout=60) == 2
+    finally:
+        rpc_chaos.clear()
+
+
+def test_direct_disabled_flag_round3_mode():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, _system_config={"direct_calls": False})
+    try:
+        assert direct.state() is None
+
+        @ray_tpu.remote
+        def sq(x):
+            return x * x
+
+        assert ray_tpu.get(sq.remote(5)) == 25
+        ref = ray_tpu.put(1)
+        assert context.get_client().store.contains(ref.id)
+    finally:
+        ray_tpu.shutdown()

@@ -75,12 +75,19 @@ def test_timeline_export(rt_start, tmp_path):
 
     ray_tpu.get([step.remote(i) for i in range(4)], timeout=60)
     path = str(tmp_path / "trace.json")
-    events = ray_tpu.timeline(path)
     import json
 
+    # direct-plane executions flush their spans in 0.2s batches (worker
+    # task-event buffer, like the reference's task_event_buffer.h) — poll
+    deadline = time.time() + 10.0
+    while True:
+        events = ray_tpu.timeline(path)
+        mine = [e for e in events if e["name"].startswith("step")]
+        if len(mine) >= 4 or time.time() > deadline:
+            break
+        time.sleep(0.2)
     on_disk = json.load(open(path))
     assert len(on_disk) == len(events)
-    mine = [e for e in events if e["name"].startswith("step")]
     assert len(mine) >= 4
     for e in mine:
         assert e["ph"] == "X" and e["dur"] >= 0.05 * 1e6 * 0.5
@@ -153,7 +160,9 @@ def test_memory_monitor_kills_largest_retriable_worker(rt_start):
     r2 = hold_retriable.remote()
     deadline = time.time() + 30
     while time.time() < deadline:
-        busy = sum(1 for n in client.node_list() for w in n.workers.values() if w.state == "busy")
+        # retriable tasks ride the direct lease path ("leased"), the
+        # non-retriable one is head-dispatched ("busy")
+        busy = sum(1 for n in client.node_list() for w in n.workers.values() if w.state in ("busy", "leased"))
         if busy >= 2:
             break
         time.sleep(0.1)
@@ -396,7 +405,14 @@ def test_core_metrics_back_grafana_panels(rt_start):
         return 1
 
     ray_tpu.get([nop.remote() for _ in range(3)], timeout=60)
-    text = metrics.export_prometheus(context.get_client())
+    # direct-plane spans flush to the head in 0.2s batches — poll
+    deadline = time.time() + 10.0
+    while True:
+        text = metrics.export_prometheus(context.get_client())
+        lines = [ln for ln in text.splitlines() if ln.startswith("rt_tasks_finished_total")]
+        if (lines and float(lines[-1].split()[-1]) >= 3) or time.time() > deadline:
+            break
+        time.sleep(0.2)
     for series in (
         "rt_tasks_finished_total",
         "rt_tasks_submitted_total",
