@@ -41,6 +41,10 @@ class RequestState:
     out_queue: "queue.SimpleQueue | None" = None
     # KV computed by a remote prefill engine (disaggregation)
     prefilled: dict | None = None
+    # paged layout: admission order (preemption picks the youngest) and
+    # preemption count (observability)
+    admit_seq: int = -1
+    preemptions: int = 0
 
 
 @dataclass
@@ -193,12 +197,21 @@ class LLMEngine:
         enable_prefix_caching: bool = True,
         prefix_cache_bytes: int = 256 << 20,
         prefix_block: int = 64,
+        kv_layout: str = "slots",
+        num_pages: int | None = None,
+        page_size: int = 64,
     ):
+        """kv_layout: "slots" (static per-sequence rows; llm/kv_cache.py)
+        or "paged" (block-table page pool; llm/paged_kv.py — concurrency
+        bounded by total pages, vLLM-class memory management). For paged,
+        ``num_pages`` sizes the pool (default: the slot-equivalent HBM,
+        max_num_seqs * max_seq_len / page_size) and ``page_size`` must
+        divide every prefill bucket and the prefix block."""
         import jax
         import jax.numpy as jnp
 
         from ray_tpu.llm import kv_cache as kvc
-        from ray_tpu.llm.model_runner import make_runner_fns
+        from ray_tpu.llm.model_runner import make_paged_runner_fns, make_runner_fns
         from ray_tpu.llm.sampling import sample
         from ray_tpu.models.llama import init_params
 
@@ -206,6 +219,9 @@ class LLMEngine:
         self.mesh = mesh
         self.max_num_seqs = int(max_num_seqs)
         self.max_seq_len = int(max_seq_len or config.max_seq_len)
+        if kv_layout not in ("slots", "paged"):
+            raise ValueError(f"kv_layout must be 'slots' or 'paged', got {kv_layout!r}")
+        self.kv_layout = kv_layout
         if prefill_buckets is None:
             b, buckets = 64, []
             while b < self.max_seq_len:
@@ -214,20 +230,59 @@ class LLMEngine:
             buckets.append(self.max_seq_len)
             prefill_buckets = tuple(buckets)
         self.prefill_buckets = tuple(sorted(prefill_buckets))
-        self._prefill, self._insert, self._decode, self._extend = make_runner_fns(config)
         self._sample = jax.jit(sample)
 
-        cache_cfg = kvc.CacheConfig(
-            num_layers=config.num_layers,
-            num_slots=self.max_num_seqs,
-            max_seq_len=self.max_seq_len,
-            num_kv_heads=config.num_kv_heads,
-            head_dim=config.hd,
-            dtype=cache_dtype or config.dtype,
+        if kv_layout == "paged":
+            from ray_tpu.llm import paged_kv as pkv
+
+            if any(b % page_size for b in self.prefill_buckets):
+                raise ValueError(f"page_size {page_size} must divide every prefill bucket {self.prefill_buckets}")
+            if prefix_block % page_size:
+                raise ValueError(f"page_size {page_size} must divide prefix_block {prefix_block}")
+            max_pg = -(-self.max_seq_len // page_size)
+            if num_pages is None:
+                # slot-equivalent HBM: same bytes, but shared across
+                # sequences instead of stranded per slot (+1 for trash)
+                num_pages = self.max_num_seqs * max_pg + 1
+            self._pcfg = pkv.PagedCacheConfig(
+                num_layers=config.num_layers,
+                num_pages=int(num_pages),
+                page_size=int(page_size),
+                max_pages_per_seq=max_pg,
+                num_slots=self.max_num_seqs,
+                num_kv_heads=config.num_kv_heads,
+                head_dim=config.hd,
+                dtype=cache_dtype or config.dtype,
+            )
+            self._prefill, self._insert, self._decode, self._extend = make_paged_runner_fns(config)
+            self._page_alloc = pkv.PageAllocator(self._pcfg.num_pages)
+            self._tables = np.zeros((self.max_num_seqs, max_pg), np.int32)
+            self._lengths = np.zeros((self.max_num_seqs,), np.int32)
+            self._slot_pages: list[list[int]] = [[] for _ in range(self.max_num_seqs)]
+            self._admit_counter = 0
+        else:
+            self._prefill, self._insert, self._decode, self._extend = make_runner_fns(config)
+
+        cache_cfg = (
+            None
+            if kv_layout == "paged"
+            else kvc.CacheConfig(
+                num_layers=config.num_layers,
+                num_slots=self.max_num_seqs,
+                max_seq_len=self.max_seq_len,
+                num_kv_heads=config.num_kv_heads,
+                head_dim=config.hd,
+                dtype=cache_dtype or config.dtype,
+            )
         )
         if mesh is None:
             self.params = params if params is not None else init_params(config, jax.random.PRNGKey(seed))
-            self.cache = kvc.alloc(cache_cfg)
+            if kv_layout == "paged":
+                from ray_tpu.llm import paged_kv as pkv
+
+                self.pool = pkv.alloc(self._pcfg)
+            else:
+                self.cache = kvc.alloc(cache_cfg)
         else:
             param_sh, cache_sh = self._mesh_shardings(mesh)
             if params is not None:
@@ -239,7 +294,12 @@ class LLMEngine:
                 self.params = jax.jit(lambda k: init_params(config, k), out_shardings=param_sh)(
                     jax.random.PRNGKey(seed)
                 )
-            self.cache = jax.jit(lambda: kvc.alloc(cache_cfg), out_shardings=cache_sh)()
+            if kv_layout == "paged":
+                from ray_tpu.llm import paged_kv as pkv
+
+                self.pool = jax.jit(lambda: pkv.alloc(self._pcfg), out_shardings=cache_sh)()
+            else:
+                self.cache = jax.jit(lambda: kvc.alloc(cache_cfg), out_shardings=cache_sh)()
         B = self.max_num_seqs
         # per-slot device-side sampling state
         self._temps = np.zeros((B,), np.float32)
@@ -285,8 +345,13 @@ class LLMEngine:
             param_logical_axes(self.config),
             is_leaf=lambda x: isinstance(x, tuple),
         )
+        # both layouts put kv_heads at axis 3: slot rows [L,B,S,kv,hd],
+        # paged pool [L,P,page,kv,hd]
         kv_s = NamedSharding(mesh, P(None, None, None, tp, None))
-        cache_sh = {"k": kv_s, "v": kv_s, "length": NamedSharding(mesh, P())}
+        if getattr(self, "kv_layout", "slots") == "paged":
+            cache_sh = {"k": kv_s, "v": kv_s}
+        else:
+            cache_sh = {"k": kv_s, "v": kv_s, "length": NamedSharding(mesh, P())}
         return param_sh, cache_sh
 
     # ------------------------------------------------------------- admission
@@ -313,6 +378,13 @@ class LLMEngine:
                     f"prompt ({len(prompt_token_ids)}) + max_tokens ({params.max_tokens}) "
                     f"exceeds max_seq_len ({self.max_seq_len})"
                 )
+            if self.kv_layout == "paged":
+                T = _bucket(len(prompt_token_ids), self.prefill_buckets)
+                if T // self._pcfg.page_size + 1 > self._pcfg.num_pages - 1:
+                    raise ValueError(
+                        f"prompt needs {T // self._pcfg.page_size + 1} pages but the pool has "
+                        f"{self._pcfg.num_pages - 1}; raise num_pages"
+                    )
             st = RequestState(request_id, list(prompt_token_ids), params)
             if stream or out_queue is not None:
                 st.out_queue = out_queue if out_queue is not None else queue.SimpleQueue()
@@ -401,10 +473,182 @@ class LLMEngine:
         st.finished = True
         st.finish_reason = reason
         if st.slot >= 0:
+            if self.kv_layout == "paged":
+                self._release_slot_pages(st.slot)
             self._slots[st.slot] = None
             st.slot = -1
         if st.out_queue is not None:
             st.out_queue.put(None)  # sentinel
+
+    # ------------------------------------------------------ paged plumbing
+    def _release_slot_pages(self, slot: int):
+        self._page_alloc.free(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self._tables[slot, :] = 0
+        self._lengths[slot] = 0
+
+    def _preempt_for(self, need: int, exclude: RequestState | None = None) -> bool:
+        """Recompute-preemption (vLLM's default policy): the YOUNGEST
+        running sequence frees its pages and re-queues with its generated
+        tokens folded into the prompt. Returns True once >= need pages
+        are free."""
+        while self._page_alloc.free_pages < need:
+            victims = [s for s in self._slots if s is not None and s is not exclude]
+            if not victims:
+                return False
+            victim = max(victims, key=lambda s: s.admit_seq)
+            victim.preemptions += 1
+            slot = victim.slot
+            self._release_slot_pages(slot)
+            self._slots[slot] = None
+            victim.slot = -1
+            self._waiting.appendleft(victim)
+        return True
+
+    def _paged_grow(self):
+        """Before a decode step: any sequence whose next append crosses
+        into an unallocated page gets one (preempting the youngest OTHER
+        sequence when the pool is dry; a sequence that cannot grow at all
+        preempts itself back to waiting)."""
+        page = self._pcfg.page_size
+        for st in [s for s in self._slots if s is not None]:
+            if st.slot < 0 or self._slots[st.slot] is not st:
+                continue  # preempted by an earlier iteration's _preempt_for
+            slot = st.slot
+            pg_ix = int(self._lengths[slot]) // page
+            if pg_ix < len(self._slot_pages[slot]):
+                continue
+            if pg_ix >= self._pcfg.max_pages_per_seq:
+                self._finish(st, "length")  # cache row exhausted
+                continue
+            got = self._page_alloc.alloc(1)
+            if got is None and self._preempt_for(1, exclude=st):
+                got = self._page_alloc.alloc(1)
+            if got is None:
+                # nothing left to preempt: this sequence itself re-queues
+                st.preemptions += 1
+                self._release_slot_pages(slot)
+                self._slots[slot] = None
+                st.slot = -1
+                self._waiting.appendleft(st)
+                continue
+            self._slot_pages[slot].extend(got)
+            self._tables[slot, pg_ix] = got[0]
+
+    def _paged_admit(self, st: RequestState) -> bool:
+        """Admission on the page pool; False = not enough pages even after
+        preemption (request stays waiting)."""
+        import jax.numpy as jnp
+
+        page = self._pcfg.page_size
+        slot = self._slots.index(None)
+        # preempted sequences resume with generated tokens as prompt tail
+        prompt = st.prompt_token_ids + st.token_ids
+        n = len(prompt)
+        pref = None
+        if st.prefilled is None and self._prefix_cache is not None and not st.token_ids:
+            pref = self._prefix_cache.lookup(prompt)
+            if pref is not None:
+                n_p = pref[2]
+                Tm = _bucket(n - n_p, self.prefill_buckets)
+                if n_p + Tm > self.max_seq_len:
+                    pref = None
+        if st.prefilled is not None:
+            kv = st.prefilled
+            # the transferred KV is bucket-padded; pages cover the padding
+            # too (garbage tail is masked by length, overwritten by appends)
+            T_pad = -(-int(kv["k"].shape[1]) // page) * page
+            need = T_pad // page + 1
+        elif pref is not None:
+            n_p = pref[2]
+            Tm = _bucket(n - n_p, self.prefill_buckets)
+            need = (n_p + Tm) // page + 1
+        else:
+            T = _bucket(n, self.prefill_buckets)
+            need = T // page + 1
+        # the +1 decode-headroom page must not overflow the table row
+        # (a prompt bucket that already fills it grows via _paged_grow,
+        # which finishes the sequence at the row edge)
+        need = min(need, self._pcfg.max_pages_per_seq)
+        if need > self._pcfg.num_pages - 1:
+            # can never fit (e.g. a preempted sequence re-admitting with
+            # prompt+generated beyond the pool): error out instead of
+            # spinning in the admission loop forever
+            self._finish(st, f"error: needs {need} pages, pool holds {self._pcfg.num_pages - 1}")
+            return True
+        if self._page_alloc.free_pages < need and not self._preempt_for(need):
+            return False
+        pages = self._page_alloc.alloc(need)
+        if pages is None:
+            return False
+        self._slot_pages[slot] = pages
+        self._tables[slot, :] = 0
+        self._tables[slot, : len(pages)] = pages
+        table_row = jnp.asarray(self._tables[slot])
+
+        if st.prefilled is not None:
+            kv = st.prefilled
+            st.prefilled = None
+            kn, vn, n_real = kv["k"], kv["v"], int(kv["n"])
+            T_pad = -(-int(kn.shape[1]) // page) * page
+            k_pad = np.zeros((kn.shape[0], T_pad) + tuple(kn.shape[2:]), kn.dtype)
+            v_pad = np.zeros_like(k_pad)
+            k_pad[:, : kn.shape[1]] = kn
+            v_pad[:, : vn.shape[1]] = vn
+            self.pool = self._insert(self.pool, table_row[: T_pad // page], jnp.asarray(k_pad), jnp.asarray(v_pad))
+            logits = jnp.asarray(kv["logits"])[None]
+            self._lengths[slot] = n_real
+        elif pref is not None:
+            k_p, v_p, n_p = pref
+            m = n - n_p
+            Tm = _bucket(m, self.prefill_buckets)
+            # the cache stores K/V at the ORIGINAL prompt's bucket width;
+            # the hit may be any block-aligned prefix of it — slice to the
+            # matched length (page-aligned: page_size divides prefix_block)
+            self.pool = self._insert(
+                self.pool, table_row[: n_p // page], jnp.asarray(k_p)[:, :n_p], jnp.asarray(v_p)[:, :n_p]
+            )
+            toks = np.zeros((Tm,), np.int32)
+            toks[:m] = prompt[n_p:]
+            logits, self.pool = self._extend(
+                self.params, self.pool, table_row, jnp.asarray(n_p, np.int32), jnp.asarray(toks), jnp.asarray(m, np.int32)
+            )
+            logits = logits[None]
+            self._lengths[slot] = n
+        else:
+            T = _bucket(n, self.prefill_buckets)
+            toks = np.zeros((1, T), np.int32)
+            toks[0, :n] = prompt
+            logits, ks, vs = self._prefill(self.params, jnp.asarray(toks), jnp.asarray([n], np.int32))
+            if self._prefix_cache is not None and not st.token_ids:
+                self._prefix_cache.store(prompt, ks[:, 0], vs[:, 0], self.prefill_buckets)
+            self.pool = self._insert(self.pool, table_row[: T // page], ks[:, 0], vs[:, 0])
+            self._lengths[slot] = n
+        self._bind_slot(st, slot, logits)
+        return True
+
+    def _bind_slot(self, st: RequestState, slot: int, logits):
+        import jax
+        import jax.numpy as jnp
+
+        st.slot = slot
+        st.admit_seq = self._admit_counter = getattr(self, "_admit_counter", 0) + 1
+        self._slots[slot] = st
+        p = st.params
+        self._temps[slot] = p.temperature
+        self._top_k[slot] = p.top_k
+        self._top_p[slot] = p.top_p
+        if p.seed is not None:
+            self._keys[slot] = np.asarray(jax.random.key_data(jax.random.PRNGKey(p.seed)))
+        tok, logp, key = self._sample(
+            logits,
+            jnp.asarray(self._keys[slot : slot + 1]),
+            jnp.asarray(self._temps[slot : slot + 1]),
+            jnp.asarray(self._top_k[slot : slot + 1]),
+            jnp.asarray(self._top_p[slot : slot + 1]),
+        )
+        self._keys[slot] = np.asarray(key[0])
+        self._emit(st, int(tok[0]), float(logp[0]))
 
     def _admit_one(self, st: RequestState):
         import jax.numpy as jnp
@@ -448,26 +692,8 @@ class LLMEngine:
                 if self._prefix_cache is not None:
                     self._prefix_cache.store(st.prompt_token_ids, ks[:, 0], vs[:, 0], self.prefill_buckets)
                 self.cache = self._insert(self.cache, slot, ks[:, 0], vs[:, 0], n)
-        st.slot = slot
-        self._slots[slot] = st
-        p = st.params
-        self._temps[slot] = p.temperature
-        self._top_k[slot] = p.top_k
-        self._top_p[slot] = p.top_p
-        if p.seed is not None:
-            import jax
-
-            self._keys[slot] = np.asarray(jax.random.key_data(jax.random.PRNGKey(p.seed)))
         # sample the first generated token from the prefill logits
-        tok, logp, key = self._sample(
-            logits,
-            jnp.asarray(self._keys[slot : slot + 1]),
-            jnp.asarray(self._temps[slot : slot + 1]),
-            jnp.asarray(self._top_k[slot : slot + 1]),
-            jnp.asarray(self._top_p[slot : slot + 1]),
-        )
-        self._keys[slot] = np.asarray(key[0])
-        self._emit(st, int(tok[0]), float(logp[0]))
+        self._bind_slot(st, slot, logits)
 
     def _emit(self, st: RequestState, token: int, logp: float):
         st.token_ids.append(token)
@@ -489,12 +715,30 @@ class LLMEngine:
                 st = self._waiting.popleft()
                 if st.finished:  # aborted while waiting
                     continue
-                self._admit_one(st)
+                if self.kv_layout == "paged":
+                    if not self._paged_admit(st):
+                        self._waiting.appendleft(st)  # pool full: wait
+                        break
+                else:
+                    self._admit_one(st)
 
+            if self.kv_layout == "paged":
+                self._paged_grow()
             active = [s for s in self._slots if s is not None]
             outputs: list[RequestOutput] = []
             if active:
-                logits, self.cache = self._decode(self.params, self.cache, jnp.asarray(self._next_tokens))
+                if self.kv_layout == "paged":
+                    logits, self.pool, _ = self._decode(
+                        self.params,
+                        self.pool,
+                        jnp.asarray(self._tables),
+                        jnp.asarray(self._lengths),
+                        jnp.asarray(self._next_tokens),
+                    )
+                    for st in active:
+                        self._lengths[st.slot] += 1
+                else:
+                    logits, self.cache = self._decode(self.params, self.cache, jnp.asarray(self._next_tokens))
                 toks, logps, keys = self._sample(
                     logits,
                     jnp.asarray(self._keys),

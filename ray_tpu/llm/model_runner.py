@@ -188,6 +188,139 @@ def extend(params, cache, slot, tokens, length, cfg: LlamaConfig):
     return logits, {"k": k, "v": v, "length": lens}
 
 
+def decode_attn_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig):
+    """READ-ONLY half of the paged decode step: attention over the cached
+    pages plus the current token's K/V in registers. Returns
+    (logits [slots, vocab] f32, k_new [L, slots, kv, hd], v_new same) —
+    the scatter into the pool is a SEPARATE program (append_paged).
+
+    The split is deliberate: a single program that both gathers from and
+    scatters into the pool buffer was observed to corrupt reads
+    nondeterministically on the XLA CPU runtime (in-place scatter racing
+    page gathers). Keeping each program one-directional removes the
+    aliasing hazard on every backend and costs one extra dispatch.
+    """
+    B = tokens.shape[0]
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    rep = nh // nkv
+    cos, sin = rotary_embedding(lengths[:, None], cfg.hd, cfg.rope_theta)
+    x = jnp.take(params["embed"], tokens[:, None], axis=0)  # [B, 1, H]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+
+    from ray_tpu.llm.paged_kv import _paged_attn_batch
+
+    def layer_fn(x, xs):
+        layer, k_pool_l, v_pool_l = xs  # [P, page, kv, hd]
+        xn = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q, k_t, v_t = _qkv(xn, layer, cfg)  # [B, 1, nh/nkv, hd]
+        qh = apply_rope(q.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)
+        kh = apply_rope(k_t.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)
+        qg = qh[:, 0].reshape(B, nkv, rep, hd)
+        o = _paged_attn_batch(qg, k_pool_l, v_pool_l, tables, lengths, scale, k_self=kh[:, 0], v_self=v_t[:, 0])
+        o = o.reshape(B, 1, nh * hd).astype(x.dtype)
+        x = x + jnp.dot(o, layer["wo"])
+        x = _mlp(x, layer, cfg)
+        return x, (kh[:, 0], v_t[:, 0])
+
+    x, (k_new, v_new) = jax.lax.scan(layer_fn, x, (params["layers"], pool["k"], pool["v"]))
+    x = rms_norm(x[:, 0], params["final_norm"], cfg.rms_eps)
+    unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
+    logits = jnp.dot(x, unembed, preferred_element_type=jnp.float32)
+    return logits, k_new, v_new
+
+
+def append_paged(pool, write_page, write_off, k_new, v_new):
+    """Scatter-only half of the paged decode step: write each slot's new
+    token K/V at (write_page[b], write_off[b]) for every layer."""
+    return {
+        "k": pool["k"].at[:, write_page, write_off].set(k_new.astype(pool["k"].dtype)),
+        "v": pool["v"].at[:, write_page, write_off].set(v_new.astype(pool["v"].dtype)),
+    }
+
+
+def decode_write_targets(tables, lengths, page: int):
+    """(write_page [B], write_off [B]) for each slot's next token (trash
+    page for rows past the table edge)."""
+    B = lengths.shape[0]
+    page_ix = jnp.minimum(lengths // page, tables.shape[1] - 1)
+    write_page = tables[jnp.arange(B, dtype=jnp.int32), page_ix]
+    return write_page, lengths % page
+
+
+def extend_write_targets(table_row, start, T: int, page: int):
+    """(write_page [T], write_off [T]) for a suffix chunk at absolute
+    positions start..start+T-1."""
+    positions = jnp.asarray(start, jnp.int32) + jnp.arange(T, dtype=jnp.int32)
+    page_ix = jnp.minimum(positions // page, table_row.shape[0] - 1)
+    return table_row[page_ix], positions % page
+
+
+def decode_step_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig):
+    """Convenience wrapper: attention program + append program (two
+    dispatches; see decode_attn_paged for why they must stay separate).
+    Returns (logits, new pool, lengths+1)."""
+    write_page, write_off = decode_write_targets(tables, lengths, pool["k"].shape[2])
+    logits, k_new, v_new = decode_attn_paged(params, pool, tables, lengths, tokens, cfg)
+    pool = append_paged(pool, write_page, write_off, k_new, v_new)
+    return logits, pool, lengths + 1
+
+
+def extend_attn_paged(params, pool, table_row, start, tokens, length, cfg: LlamaConfig):
+    """READ-ONLY half of paged chunked-prefill: the suffix attends to the
+    cached prefix pages plus itself causally (in registers). Returns
+    (logits [vocab] f32 at the last real token, k_chunk [L, T, kv, hd],
+    v_chunk same); the pool scatter is a separate program."""
+    T = tokens.shape[0]
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    rep = nh // nkv
+    start = jnp.asarray(start, jnp.int32)
+    positions = start + jnp.arange(T, dtype=jnp.int32)
+    cos, sin = rotary_embedding(positions, cfg.hd, cfg.rope_theta)
+    x = jnp.take(params["embed"], tokens[None, :], axis=0)  # [1, T, H]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+
+    from ray_tpu.llm.paged_kv import _paged_attn_seq
+
+    def layer_fn(x, xs):
+        layer, k_pool_l, v_pool_l = xs
+        xn = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q, k_t, v_t = _qkv(xn, layer, cfg)  # [1, T, nh/nkv, hd]
+        qh = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)  # [1, nh, T, hd]
+        kh = apply_rope(k_t.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)  # [1, T, nkv, hd]
+        qg = qh[0].reshape(nkv, rep, T, hd)
+        o = _paged_attn_seq(qg, k_pool_l, v_pool_l, table_row, start, kh[0], v_t[0], scale)
+        o = o.transpose(2, 0, 1, 3).reshape(1, T, nh * hd).astype(x.dtype)
+        x = x + jnp.dot(o, layer["wo"])
+        x = _mlp(x, layer, cfg)
+        return x, (kh[0], v_t[0])
+
+    x, (k_chunk, v_chunk) = jax.lax.scan(layer_fn, x, (params["layers"], pool["k"], pool["v"]))
+    x = rms_norm(x[0], params["final_norm"], cfg.rms_eps)  # [T, H]
+    x_last = x[jnp.maximum(length - 1, 0)]
+    unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
+    logits = jnp.dot(x_last, unembed, preferred_element_type=jnp.float32)
+    return logits, k_chunk, v_chunk
+
+
+def append_chunk_paged(pool, write_page, write_off, k_chunk, v_chunk):
+    """Scatter-only half of paged chunked-prefill: write the suffix K/V
+    rows (write_page/write_off: [T]) for every layer."""
+    return {
+        "k": pool["k"].at[:, write_page, write_off].set(k_chunk.astype(pool["k"].dtype)),
+        "v": pool["v"].at[:, write_page, write_off].set(v_chunk.astype(pool["v"].dtype)),
+    }
+
+
+def extend_paged(params, pool, table_row, start, tokens, length, cfg: LlamaConfig):
+    """Convenience wrapper: attention program + chunk append program (two
+    dispatches; see decode_attn_paged for the split rationale). Returns
+    (logits [vocab] f32 at the last real token, new pool)."""
+    write_page, write_off = extend_write_targets(table_row, start, tokens.shape[0], pool["k"].shape[2])
+    logits, k_chunk, v_chunk = extend_attn_paged(params, pool, table_row, start, tokens, length, cfg)
+    pool = append_chunk_paged(pool, write_page, write_off, k_chunk, v_chunk)
+    return logits, pool
+
+
 def make_runner_fns(cfg: LlamaConfig):
     """Jitted (prefill, insert, decode, extend) closures for an engine."""
     from ray_tpu.llm import kv_cache as kvc
@@ -196,4 +329,35 @@ def make_runner_fns(cfg: LlamaConfig):
     insert_fn = jax.jit(kvc.insert_sequence, donate_argnums=(0,))
     decode_fn = jax.jit(partial(decode_step, cfg=cfg), donate_argnums=(1,))
     extend_fn = jax.jit(partial(extend, cfg=cfg), donate_argnums=(1,))
+    return prefill_fn, insert_fn, decode_fn, extend_fn
+
+
+def make_paged_runner_fns(cfg: LlamaConfig):
+    """Jitted (prefill, insert_pages, decode, extend) for a paged engine.
+
+    Decode/extend each compile as TWO programs — read-only attention and
+    scatter-only append — never fused (jitting the combined wrapper would
+    reintroduce the same-program gather+scatter aliasing hazard; see
+    decode_attn_paged)."""
+    from ray_tpu.llm import paged_kv as pkv
+
+    prefill_fn = jax.jit(partial(prefill, cfg=cfg))
+    insert_fn = jax.jit(pkv.insert_pages, donate_argnums=(0,))
+    attn_fn = jax.jit(partial(decode_attn_paged, cfg=cfg))
+    append_fn = jax.jit(append_paged, donate_argnums=(0,))
+    ext_attn_fn = jax.jit(partial(extend_attn_paged, cfg=cfg))
+    ext_append_fn = jax.jit(append_chunk_paged, donate_argnums=(0,))
+
+    def decode_fn(params, pool, tables, lengths, tokens):
+        write_page, write_off = decode_write_targets(tables, lengths, pool["k"].shape[2])
+        logits, k_new, v_new = attn_fn(params, pool, tables, lengths, tokens)
+        pool = append_fn(pool, write_page, write_off, k_new, v_new)
+        return logits, pool, lengths + 1
+
+    def extend_fn(params, pool, table_row, start, tokens, length):
+        write_page, write_off = extend_write_targets(table_row, start, tokens.shape[0], pool["k"].shape[2])
+        logits, k_chunk, v_chunk = ext_attn_fn(params, pool, table_row, start, tokens, length)
+        pool = ext_append_fn(pool, write_page, write_off, k_chunk, v_chunk)
+        return logits, pool
+
     return prefill_fn, insert_fn, decode_fn, extend_fn

@@ -1,0 +1,209 @@
+"""Block-table paged KV cache: concurrency bounded by HBM pages, not slots.
+
+The slot cache (llm/kv_cache.py) reserves ``max_seq_len`` tokens of HBM per
+concurrent sequence; short sequences strand most of it. This module is the
+vLLM-class answer the reference gets from its engine (reference capability:
+python/ray/llm/_internal/serve/engines/vllm/vllm_models.py:215-228 —
+block_size / gpu_memory_utilization paging), re-designed for XLA:
+
+- One page POOL per layer stack: ``k,v: [L, num_pages, page, kv, hd]``.
+  Page 0 is reserved as the trash page: block-table padding points at it,
+  so scatters for inactive lanes land somewhere harmless and gathers from
+  it are masked by length.
+- A host-side ``PageAllocator`` free list; the block table
+  ``[slots, max_pages_per_seq] int32`` is host state shipped to the device
+  each step (tiny) — allocation decisions stay in Python, the compiled
+  program never sees a dynamic shape.
+- Attention runs as a ``lax.scan`` over the page axis with an online
+  softmax (flash-style m/l/acc carry): each step gathers ONE page per
+  sequence, so nothing ever materializes a [slots, max_seq] view. Static
+  trip count = max_pages_per_seq -> one compiled program for every
+  occupancy mix.
+
+Preemption (pool exhausted) is recompute-style like vLLM's default: the
+youngest sequence frees its pages and re-queues with prompt+generated as
+its new prompt.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30  # -inf surrogate: keeps exp() NaN-free for fully-masked pages
+
+
+@dataclass(frozen=True)
+class PagedCacheConfig:
+    num_layers: int
+    num_pages: int  # total pool pages (page 0 reserved as trash)
+    page_size: int
+    max_pages_per_seq: int
+    num_slots: int
+    num_kv_heads: int
+    head_dim: int
+    dtype: str = "bfloat16"
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+
+def alloc(cfg: PagedCacheConfig) -> dict:
+    shape = (cfg.num_layers, cfg.num_pages, cfg.page_size, cfg.num_kv_heads, cfg.head_dim)
+    dt = jnp.dtype(cfg.dtype)
+    return {"k": jnp.zeros(shape, dtype=dt), "v": jnp.zeros(shape, dtype=dt)}
+
+
+class PageAllocator:
+    """Host-side free list over pages 1..num_pages-1 (0 = trash)."""
+
+    def __init__(self, num_pages: int):
+        self._free = list(range(num_pages - 1, 0, -1))
+        self.num_pages = num_pages
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_for(self, n_tokens: int, page_size: int) -> int:
+        return max(1, -(-n_tokens // page_size))
+
+    def alloc(self, n: int) -> list[int] | None:
+        if n > len(self._free):
+            return None
+        out = self._free[-n:]
+        del self._free[-n:]
+        return out
+
+    def free(self, pages) -> None:
+        for p in pages:
+            if p:  # never recycle the trash page
+                self._free.append(int(p))
+
+
+# ---------------------------------------------------------------------------
+# jitted pool ops
+# ---------------------------------------------------------------------------
+def insert_pages(pool: dict, page_ids, k_new, v_new) -> dict:
+    """Write a prefilled sequence's K/V into its pages.
+
+    k_new/v_new: [L, T_pad, kv, hd] with T_pad == len(page_ids)*page_size
+    (host pads); page_ids: [n_pg] int32 (padding entries = 0 -> trash).
+    """
+    L, T, kvh, hd = k_new.shape
+    npg = page_ids.shape[0]
+    page = pool["k"].shape[2]
+    kr = k_new.reshape(L, npg, page, kvh, hd).astype(pool["k"].dtype)
+    vr = v_new.reshape(L, npg, page, kvh, hd).astype(pool["v"].dtype)
+    return {
+        "k": pool["k"].at[:, page_ids].set(kr),
+        "v": pool["v"].at[:, page_ids].set(vr),
+    }
+
+
+def _combine(m1, l1, a1, m2, l2, a2):
+    """Merge two online-softmax partials (flash-attention combine)."""
+    m = jnp.maximum(m1, m2)
+    x1 = jnp.exp(m1 - m)
+    x2 = jnp.exp(m2 - m)
+    return m, l1 * x1 + l2 * x2, a1 * x1[..., None] + a2 * x2[..., None]
+
+
+def _paged_attn_batch(qg, pool_k_l, pool_v_l, table, lengths, scale, k_self=None, v_self=None):
+    """Online-softmax attention of one query token per slot over paged KV.
+
+    qg: [B, nkv, rep, hd]; pool_*_l: [P, page, kv, hd] (one layer);
+    table: [B, max_pg] int32; lengths: [B] int32 — attend to CACHED
+    positions 0..lengths[b]-1 (strictly pre-existing data) plus the
+    current token's own K/V passed in REGISTERS as k_self/v_self
+    [B, kv, hd]. The current position is never read back from the pool:
+    a same-program scatter->gather on one buffer is exactly the in-place
+    aliasing pattern XLA's CPU thunk executor was observed to mis-order
+    (nondeterministic stale reads), and keeping the self term out of
+    memory sidesteps it while also saving the round trip.
+    Returns [B, nkv, rep, hd] float32.
+    """
+    B, nkv, rep, hd = qg.shape
+    page = pool_k_l.shape[1]
+    max_pg = table.shape[1]
+    qf = qg.astype(jnp.float32) * scale
+
+    def body(carry, p):
+        m, l, acc = carry
+        pids = table[:, p]  # [B]
+        kp = pool_k_l[pids].astype(jnp.float32)  # [B, page, kv, hd]
+        vp = pool_v_l[pids].astype(jnp.float32)
+        s = jnp.einsum("bgrh,bpgh->bgrp", qf, kp)  # [B, nkv, rep, page]
+        pos = p * page + jnp.arange(page, dtype=jnp.int32)  # [page]
+        ok = pos[None, :] < lengths[:, None]  # [B, page] cached only
+        s = jnp.where(ok[:, None, None, :], s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        pexp = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + pexp.sum(axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum("bgrp,bpgh->bgrh", pexp, vp)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, nkv, rep), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, nkv, rep), jnp.float32)
+    a0 = jnp.zeros((B, nkv, rep, hd), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, a0), jnp.arange(max_pg, dtype=jnp.int32))
+    if k_self is not None:
+        # fold the current token as a one-element softmax partial:
+        # m2 = s_self, l2 = exp(s_self - m2) = 1, acc2 = 1 * v_self
+        s_self = jnp.einsum("bgrh,bgh->bgr", qf, k_self.astype(jnp.float32))  # [B, nkv, rep]
+        vs = jnp.broadcast_to(v_self.astype(jnp.float32)[:, :, None, :], acc.shape)
+        m, l, acc = _combine(m, l, acc, s_self, jnp.ones_like(s_self), vs)
+    return acc / jnp.maximum(l, 1e-20)[..., None]
+
+
+def _paged_attn_seq(qg, pool_k_l, pool_v_l, table_row, start, k_chunk, v_chunk, scale):
+    """Online-softmax attention of T query tokens of ONE sequence: a
+    cached PREFIX (positions 0..start-1, read from pages) plus the chunk's
+    own K/V attended causally IN REGISTERS (the chunk was produced this
+    call and is never read back from the pool — see _paged_attn_batch for
+    the aliasing rationale).
+
+    qg: [nkv, rep, T, hd]; table_row: [max_pg] int32; start: [] int32;
+    k_chunk/v_chunk: [T, kv, hd]. Query t (absolute position start+t)
+    attends prefix fully and chunk positions 0..t. Returns
+    [nkv, rep, T, hd] float32.
+    """
+    nkv, rep, T, hd = qg.shape
+    page = pool_k_l.shape[1]
+    max_pg = table_row.shape[0]
+    qf = qg.astype(jnp.float32) * scale
+
+    def body(carry, p):
+        m, l, acc = carry  # [nkv, rep, T], ..., [nkv, rep, T, hd]
+        pid = table_row[p]
+        kp = pool_k_l[pid].astype(jnp.float32)  # [page, kv, hd]
+        vp = pool_v_l[pid].astype(jnp.float32)
+        s = jnp.einsum("grth,pgh->grtp", qf, kp)  # [nkv, rep, T, page]
+        pos = p * page + jnp.arange(page, dtype=jnp.int32)
+        ok = pos < start  # [page] prefix only, same bound for every query
+        s = jnp.where(ok[None, None, None], s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        pexp = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + pexp.sum(axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum("grtp,pgh->grth", pexp, vp)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((nkv, rep, T), _NEG, jnp.float32)
+    l0 = jnp.zeros((nkv, rep, T), jnp.float32)
+    a0 = jnp.zeros((nkv, rep, T, hd), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, a0), jnp.arange(max_pg, dtype=jnp.int32))
+    # causal in-chunk part from registers
+    s_c = jnp.einsum("grth,ugh->grtu", qf, k_chunk.astype(jnp.float32))  # [nkv, rep, T, T]
+    causal = jnp.arange(T, dtype=jnp.int32)[None, :] <= jnp.arange(T, dtype=jnp.int32)[:, None]  # [T(q), T(k)]
+    s_c = jnp.where(causal[None, None], s_c, _NEG)
+    m2 = s_c.max(axis=-1)
+    pe2 = jnp.exp(s_c - m2[..., None])
+    l2 = pe2.sum(axis=-1)
+    a2 = jnp.einsum("grtu,ugh->grth", pe2, v_chunk.astype(jnp.float32))
+    m, l, acc = _combine(m, l, acc, m2, l2, a2)
+    return acc / jnp.maximum(l, 1e-20)[..., None]
