@@ -1,0 +1,189 @@
+"""Serving benchmark on the real TPU chip (VERDICT r4 #3a).
+
+Two layers, committed as BENCH_serve.json:
+
+1. ENGINE: prefill tokens/s and steady-state decode tokens/s of the
+   continuous-batching engine on the same ~1B-param llama bench.py
+   trains, for both KV layouts (slots / paged).
+2. FULL STACK: serve.run -> proxy/router -> LLMServer replica -> engine,
+   N concurrent client streams, end-to-end tokens/s + request p50/p99.
+
+Reference numbers being mirrored: the Serve-LLM benchmark page the
+reference publishes (/root/reference/doc/source/serve/llm/benchmarks.md).
+
+Run ON THE CHIP (no JAX_PLATFORMS override): python bench_serve.py
+Quick CPU sanity: JAX_PLATFORMS=cpu python bench_serve.py --tiny
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+
+def _model(tiny: bool):
+    from ray_tpu.models.llama import LlamaConfig
+
+    if tiny:
+        return LlamaConfig.tiny(dtype="float32", remat=False, max_seq_len=512), 64, 32
+    # the bench.py flagship: ~1B params, bf16
+    cfg = LlamaConfig(
+        vocab_size=32000,
+        hidden_size=2048,
+        intermediate_size=5632,
+        num_layers=18,
+        num_heads=16,
+        num_kv_heads=16,
+        max_seq_len=2048,
+        remat=False,
+    )
+    return cfg, 512, 128
+
+
+def bench_engine(cfg, prompt_len: int, gen_len: int, kv_layout: str, max_num_seqs: int = 8) -> dict:
+    import numpy as np
+
+    from ray_tpu.llm.engine import LLMEngine
+    from ray_tpu.llm.sampling import SamplingParams
+
+    kw = {"kv_layout": kv_layout, "page_size": 64} if kv_layout == "paged" else {}
+    eng = LLMEngine(cfg, max_num_seqs=max_num_seqs, max_seq_len=cfg.max_seq_len, enable_prefix_caching=False, **kw)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, cfg.vocab_size - 1, size=prompt_len)) for _ in range(max_num_seqs)]
+    sp = SamplingParams(temperature=0.7, max_tokens=gen_len)
+
+    # warm/compile
+    eng.generate([prompts[0][:prompt_len]], SamplingParams(temperature=0.7, max_tokens=4))
+
+    # prefill throughput: admit a full batch, time until all prefills done
+    t0 = time.perf_counter()
+    ids = [eng.add_request(p, sp) for p in prompts]
+    while eng.num_waiting:
+        eng.step()
+    prefill_s = time.perf_counter() - t0
+    prefill_tok_s = max_num_seqs * prompt_len / prefill_s
+
+    # steady-state decode: step until done, count generated tokens
+    t0 = time.perf_counter()
+    steps = 0
+    while eng.has_unfinished():
+        eng.step()
+        steps += 1
+    decode_s = time.perf_counter() - t0
+    gen_tokens = max_num_seqs * gen_len
+    return {
+        "metric": f"engine_{kv_layout}",
+        "prefill_tokens_per_s": round(prefill_tok_s, 1),
+        "decode_tokens_per_s": round(gen_tokens / decode_s, 1),
+        "decode_step_ms": round(decode_s / max(steps, 1) * 1e3, 2),
+        "batch": max_num_seqs,
+        "prompt_len": prompt_len,
+        "gen_len": gen_len,
+    }
+
+
+def bench_full_stack(cfg, prompt_len: int, gen_len: int, concurrency: int, tiny: bool) -> dict:
+    """proxy -> router -> replica -> engine with N concurrent callers."""
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import LLMConfig, build_llm_deployment
+
+    rt.init(num_cpus=4)
+    try:
+        app = build_llm_deployment(
+            LLMConfig(
+                model_config=cfg,
+                engine_kwargs={"max_num_seqs": max(8, concurrency), "enable_prefix_caching": False},
+                num_tpus_per_replica=0 if tiny else -1,
+                max_ongoing_requests=concurrency * 2,
+            )
+        )
+        h = serve.run(app, name="bench_llm")
+        rng = np.random.default_rng(1)
+        prompt = list(int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=prompt_len))
+        # warm (compile happens in the replica)
+        h.generate.remote(prompt, {"max_tokens": 4}).result(timeout_s=1200)
+
+        lat: list[float] = []
+        lock = threading.Lock()
+        errors: list[str] = []
+
+        def client(n_requests: int):
+            for _ in range(n_requests):
+                t0 = time.perf_counter()
+                try:
+                    out = h.generate.remote(prompt, {"max_tokens": gen_len, "temperature": 0.7}).result(timeout_s=1200)
+                    assert len(out["token_ids"]) == gen_len
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(str(e)[:200])
+                    return
+                with lock:
+                    lat.append(time.perf_counter() - t0)
+
+        per_client = 4 if tiny else 3
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(per_client,)) for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        lat.sort()
+        n = len(lat)
+        return {
+            "metric": "serve_full_stack",
+            "concurrency": concurrency,
+            "requests": n,
+            "errors": len(errors),
+            "tokens_per_s": round(n * gen_len / wall, 1),
+            "requests_per_s": round(n / wall, 2),
+            "p50_s": round(lat[n // 2], 3) if n else None,
+            "p99_s": round(lat[min(n - 1, int(n * 0.99))], 3) if n else None,
+            "prompt_len": prompt_len,
+            "gen_len": gen_len,
+        }
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        rt.shutdown()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true", help="CPU sanity mode")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--out", default="BENCH_serve.json")
+    ap.add_argument("--only", default="")
+    args = ap.parse_args(argv)
+
+    cfg, prompt_len, gen_len = _model(args.tiny)
+    results = []
+    for name, fn in (
+        ("engine_slots", lambda: bench_engine(cfg, prompt_len, gen_len, "slots")),
+        ("engine_paged", lambda: bench_engine(cfg, prompt_len, gen_len, "paged")),
+        ("full_stack", lambda: bench_full_stack(cfg, prompt_len, gen_len, args.concurrency, args.tiny)),
+    ):
+        if args.only and args.only not in name:
+            continue
+        print(f"=== {name} ===", flush=True)
+        try:
+            rec = fn()
+        except BaseException as e:  # noqa: BLE001
+            rec = {"metric": name, "error": f"{type(e).__name__}: {e}"}
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+    if not args.only and not args.tiny:
+        with open(args.out, "w") as f:
+            json.dump({"benchmarks": results, "ts": time.time()}, f, indent=1)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -295,15 +295,12 @@ class RemoteFunction:
         name = getattr(self._fn, "__name__", "task")
         num_returns, streaming = _num_returns(self._options)
         opts = _with_trace(self._options, name)
-        if not streaming and _direct.state() is not None:
-            # direct plane fast path: plain args ride the frame as one
-            # pickle — no per-arg encoding at all (core/direct.py)
-            packed = _direct.pack_raw(args, kwargs)
-            if packed is not None:
-                raw, rpins = packed
-                refs = _direct.try_task_call(client, name, self._func_id, self._blob, None, None, opts, pins=rpins, raw=raw)
-                if refs is not None:
-                    return refs[0] if num_returns == 1 else refs
+        if not streaming and _direct.state() is not None and _direct.raw_eligible(args, kwargs):
+            # direct plane fast path: args ride the call frame as plain
+            # values — ONE pickle for the whole submission (core/direct.py)
+            refs = _direct.try_task_call(client, name, self._func_id, self._blob, None, None, opts, raw=(args, kwargs))
+            if refs is not None:
+                return refs[0] if num_returns == 1 else refs
         arg_specs, kw_specs, pins = _encode_args(args, kwargs)
         if not streaming:
             # direct plane: stream the task onto a leased worker, head out
@@ -356,14 +353,11 @@ class ActorMethod:
         client = _auto_init()
         num_returns, streaming = _num_returns(self._options)
         opts = _with_trace(self._options, self._name)
-        if not streaming and _direct.state() is not None:
-            # direct plane fast path: plain args ride the frame directly
-            packed = _direct.pack_raw(args, kwargs)
-            if packed is not None:
-                raw, rpins = packed
-                refs = _direct.try_actor_call(client, self._handle._actor_id, self._name, None, None, opts, pins=rpins, raw=raw)
-                if refs is not None:
-                    return refs[0] if num_returns == 1 else refs
+        if not streaming and _direct.state() is not None and _direct.raw_eligible(args, kwargs):
+            # direct plane fast path: args ride the call frame directly
+            refs = _direct.try_actor_call(client, self._handle._actor_id, self._name, None, None, opts, raw=(args, kwargs))
+            if refs is not None:
+                return refs[0] if num_returns == 1 else refs
         arg_specs, kw_specs, pins = _encode_args(args, kwargs)
         if not streaming:
             # direct plane: straight to the actor's worker (core/direct.py)

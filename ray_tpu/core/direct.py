@@ -342,8 +342,14 @@ _hints_lock = threading.Lock()
 
 def note_hint(k: bytes, owner: str):
     st = _state
-    if st is not None and st.owned.owns(k):
-        return  # we ARE the owner; no hint needed
+    if st is not None:
+        if owner is st.self_owner:
+            # our own fresh ref: getters consult the owned store first and
+            # __reduce__ carries the instance hint — skip both lock takes
+            # (this is every direct-call return ref, the hot path)
+            return
+        if st.owned.owns(k):
+            return  # we ARE the owner; no hint needed
     with _hints_lock:
         _hints[k] = owner
 
@@ -433,9 +439,13 @@ class PeerConn:
             self._on_death()
             raise ConnectionError(f"direct peer send failed: {e}") from None
 
-    def send_call(self, rec: _CallRec, frame: dict):
-        cid = self._next_cid()
-        frame["cid"] = cid
+    def send_call(self, rec: _CallRec, frame: dict, data: bytes | None = None):
+        """Register the in-flight call and send its frame. ``data`` is the
+        pre-pickled frame (raw fast path; the cid placeholder inside was
+        already filled by the caller via reserve_cid)."""
+        cid = frame["cid"] if data is not None else self._next_cid()
+        if data is None:
+            frame["cid"] = cid
         with self._lock:
             if self.dead:
                 raise ConnectionError("direct peer is down")
@@ -443,10 +453,16 @@ class PeerConn:
             self.inflight += 1
         self.last_used = time.monotonic()
         try:
-            self.send(frame)
-        except ConnectionError:
-            # _on_death already failed this rec over; don't double-handle
-            raise
+            if data is not None:
+                _send_frame(self.sock, data, self._wlock)
+            else:
+                self.send(frame)
+        except (OSError, ValueError) as e:
+            self._on_death()
+            raise ConnectionError(f"direct peer send failed: {e}") from None
+
+    def reserve_cid(self) -> int:
+        return self._next_cid()
 
     def ensure_func(self, func_id: str, blob):
         if func_id in self._sent_funcs:
@@ -781,6 +797,9 @@ class DirectState:
         self._reconstructing: set = set()
         self._reconstruct_cv = threading.Condition(self._lineage_lock)
         self._stopped = False
+        # hot-path cached config values (get_config() per call adds up)
+        self.default_max_retries = get_config().default_max_retries
+        self.inline_threshold = get_config().max_direct_call_object_size
         self._hk = threading.Thread(target=self._housekeeping, daemon=True, name="rt-direct-hk")
         self._hk.start()
 
@@ -854,14 +873,17 @@ class DirectState:
 
     @staticmethod
     def _rec_argspecs(rec: _CallRec):
-        """ArgSpecs for a head-path resubmit of this rec (raw fast-path
-        blobs re-encode through the normal arg machinery)."""
+        """ArgSpecs for a head-path resubmit of this rec. Raw fast-path
+        recs keep the SUBMISSION-TIME frame pickle; unpickling it
+        reproduces the argument snapshot (a caller mutating its objects
+        after .remote() must not change what a retry executes)."""
         if rec.raw is None:
             return rec.args, rec.kwargs
-        args, kwargs = pickle.loads(rec.raw)
+        frame = pickle.loads(rec.raw)
+        args, kwargs = frame["argv"], frame.get("kwargv") or {}
         from ray_tpu.api import _encode_args
 
-        specs, kw, _pins = _encode_args(args, kwargs or {})
+        specs, kw, _pins = _encode_args(args, kwargs)
         return specs, kw
 
     def _failover_actor(self, client, rec: _CallRec):
@@ -1177,33 +1199,39 @@ def detach(client):
 # ---------------------------------------------------------------------------
 # submit paths (called from api.py; None return = use the head path)
 # ---------------------------------------------------------------------------
-def pack_raw(args, kwargs):
-    """Fast-path argument packing: one plain-pickle blob of (args, kwargs)
-    riding the call frame — no per-arg Serialized/ArgSpec machinery.
-    Returns (bytes, pins) or None when ineligible: top-level ObjectRefs
-    (those need resolve-before-call semantics), cloudpickle-only values,
-    or anything big enough to belong in shared memory. Nested ObjectRefs
-    are fine — __reduce__ reports them to the active sink for pinning and
-    carries their owner hints."""
+def raw_eligible(args, kwargs) -> bool:
+    """Fast-path eligibility: args ride the call frame as plain values (a
+    single pickle for the whole frame — no per-arg Serialized/ArgSpec
+    machinery, no separate blob). Top-level ObjectRefs are excluded (they
+    need resolve-before-call semantics); nested ObjectRefs are fine —
+    __reduce__ reports them to the active sink for pinning and carries
+    their owner hints. Cloudpickle-only/oversized values are caught at
+    frame-serialize time (the submit falls back to the ArgSpec path)."""
     for a in args:
         if isinstance(a, _ObjRef):
-            return None
+            return False
     if kwargs:
         for v in kwargs.values():
             if isinstance(v, _ObjRef):
-                return None
-    from ray_tpu._config import get_config
+                return False
+    return True
+
+
+def _dump_raw_frame(st, frame) -> tuple[bytes, list | None] | None:
+    """Serialize a raw-args call frame in ONE pickle pass, collecting
+    nested-ref pins via the serialization sink. None = ineligible
+    (unpicklable content or too large for inline transport)."""
     from ray_tpu.core import object_ref as _oref
 
     sink: list = []
     token = _oref.push_ref_sink(sink)
     try:
-        data = pickle.dumps((args, kwargs), protocol=5)
+        data = pickle.dumps(frame, protocol=5)
     except Exception:
         return None  # cloudpickle-only content: ArgSpec path handles it
     finally:
         _oref.pop_ref_sink(token)
-    if len(data) > get_config().max_direct_call_object_size:
+    if len(data) > st.inline_threshold + 4096:
         return None
     pins = [_ObjRef(i) for i in sink] if sink else None
     return data, pins
@@ -1266,6 +1294,26 @@ def try_actor_call(client, actor_id, method_name: str, arg_specs, kw_specs, opti
     nr = int((options or {}).get("num_returns", 1) or 1)
     tid = TaskID.from_random()
     oids = [ObjectID.for_task_return(tid, i) for i in range(nr)]
+    frame = {
+        "op": "call",
+        "actor": actor_id.binary(),
+        "method": method_name,
+        "task": tid.binary(),
+        "num_returns": nr,
+        "trace": (options or {}).get("_trace_ctx"),
+    }
+    data = None
+    if raw is not None:
+        frame["cid"] = conn.reserve_cid()
+        frame["argv"], frame["kwargv"] = raw
+        packed = _dump_raw_frame(st, frame)
+        if packed is None:
+            return None  # unpicklable/oversized: ArgSpec path next
+        data, pins = packed
+        raw = data  # failover resubmits from this snapshot
+    else:
+        frame["args"] = arg_specs
+        frame["kwargs"] = kw_specs
     for oid in oids:
         st.owned.create_pending(oid.binary())
     rec = _CallRec(
@@ -1275,21 +1323,8 @@ def try_actor_call(client, actor_id, method_name: str, arg_specs, kw_specs, opti
     with route.lock:
         route.inflight_recs += 1
         route.drained.clear()
-    frame = {
-        "op": "call",
-        "actor": actor_id.binary(),
-        "method": method_name,
-        "task": tid.binary(),
-        "num_returns": nr,
-        "trace": (options or {}).get("_trace_ctx"),
-    }
-    if raw is not None:
-        frame["rawp"] = raw
-    else:
-        frame["args"] = arg_specs
-        frame["kwargs"] = kw_specs
     try:
-        conn.send_call(rec, frame)
+        conn.send_call(rec, frame, data)
     except ConnectionError:
         pass  # failover path completes the pending entries
     return _owned_refs(st, oids)
@@ -1320,17 +1355,9 @@ def try_task_call(client, name: str, func_id: str, blob, arg_specs, kw_specs, op
     lease = st.pick_lease()
     if lease is None:
         return None
-    from ray_tpu._config import get_config
-
     nr = int(o.get("num_returns", 1) or 1)
     tid = TaskID.from_random()
     oids = [ObjectID.for_task_return(tid, i) for i in range(nr)]
-    for oid in oids:
-        st.owned.create_pending(oid.binary())
-    retries = o.get("max_retries")
-    if retries is None:
-        retries = get_config().default_max_retries
-    rec = _CallRec("task", None, tid, oids, name, func_id, arg_specs, kw_specs, nr, retries, o.get("_trace_ctx"), pins=pins, raw=raw)
     frame = {
         "op": "call",
         "actor": None,
@@ -1340,14 +1367,27 @@ def try_task_call(client, name: str, func_id: str, blob, arg_specs, kw_specs, op
         "num_returns": nr,
         "trace": o.get("_trace_ctx"),
     }
+    data = None
     if raw is not None:
-        frame["rawp"] = raw
+        frame["cid"] = lease.conn.reserve_cid()
+        frame["argv"], frame["kwargv"] = raw
+        packed = _dump_raw_frame(st, frame)
+        if packed is None:
+            return None  # unpicklable/oversized: ArgSpec path next
+        data, pins = packed
+        raw = data  # failover resubmits from this snapshot
     else:
         frame["args"] = arg_specs
         frame["kwargs"] = kw_specs
+    for oid in oids:
+        st.owned.create_pending(oid.binary())
+    retries = o.get("max_retries")
+    if retries is None:
+        retries = st.default_max_retries
+    rec = _CallRec("task", None, tid, oids, name, func_id, arg_specs, kw_specs, nr, retries, o.get("_trace_ctx"), pins=pins, raw=raw)
     try:
         lease.conn.ensure_func(func_id, st.func_blobs[func_id])
-        lease.conn.send_call(rec, frame)
+        lease.conn.send_call(rec, frame, data)
     except ConnectionError:
         pass  # failover resubmits via the head
     return _owned_refs(st, oids)

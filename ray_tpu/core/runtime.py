@@ -344,7 +344,7 @@ class Runtime:
             self.nodes[node.node_id] = node
         self.gcs.events.record("node_added", node_id=node.node_id.hex(), resources=resources)
         self.gcs.pubsub.publish("node", {"event": "added", "node_id": node.node_id.hex()})
-        self.scheduler.wake()
+        self.scheduler.bump_capacity()
         return node
 
     def _persistent_secret(self, name: str) -> bytes:
@@ -513,7 +513,9 @@ class Runtime:
             self._ns_nodes.pop(ns, None)
         self.gcs.events.record("node_removed", node_id=node_id.hex())
         self.gcs.pubsub.publish("node", {"event": "removed", "node_id": node_id.hex()})
-        self.scheduler.wake()
+        # membership changed: parked shapes re-evaluate against the
+        # post-removal cluster view
+        self.scheduler.bump_capacity()
 
     def node_list(self) -> list[Node]:
         with self._nodes_lock:
@@ -1135,6 +1137,7 @@ class Runtime:
 
         self.store.put_serialized(_pg_ready_oid(pgs.pg_id), _to_serialized(True))
         self.gcs.events.record("pg_created", pg_id=pgs.pg_id.hex(), strategy=pgs.strategy)
+        self.scheduler.bump_capacity()
         return True
 
     def pending_pg_demand(self) -> list[dict]:
@@ -1205,6 +1208,7 @@ class Runtime:
                 for idx in list(node.pg_bundles.get(pg_id, {})):
                     node.return_bundle(pg_id, idx)
         self.gcs.events.record("pg_removed", pg_id=pg_id.hex())
+        self.scheduler.bump_capacity()
         # freed capacity may satisfy queued gang reservations (reference:
         # pending PG queue re-scheduled on resource release)
         for other_id in list(self._pending_pgs):
@@ -1509,6 +1513,8 @@ class Runtime:
             node.release_to_bundle(pg_id, idx, res)
         else:
             node.release(res)
+        # parked (infeasible/busy) shapes become placeable again
+        self.scheduler.bump_capacity()
 
     # ------------------------------------------------------------------
     # worker IO loop
@@ -2350,7 +2356,7 @@ class Runtime:
         if w is not None and w.state == "leased":
             w.state = "idle"
             w.last_idle = time.monotonic()
-            self.scheduler.wake()
+        self.scheduler.bump_capacity()
         return True
 
     def terminate_leased_worker(self, wid_hex: str) -> bool:

@@ -117,6 +117,15 @@ class Scheduler:
         self._waiting: dict = {}  # task_id -> (spec, set(pending obj ids))
         self._dep_index: dict = {}  # obj_id -> set(task_id)
         self._ready: deque[TaskSpec] = deque()
+        # shapes that failed placement PARK here until cluster capacity
+        # changes (reference: the lease manager's separate infeasible
+        # queue re-evaluated on node updates — without it, a deep
+        # all-infeasible backlog makes every pass O(backlog), turning
+        # submission into O(n^2); measured: 100k queued tasks throttled
+        # submits to ~100/s before this)
+        self._parked: dict = {}  # shape -> [epoch, deque[TaskSpec]]
+        self._capacity_epoch = 1
+        self._last_unpark_all = 0.0
         self._infeasible_warned: set = set()
         self._wake = threading.Event()
         self._stopped = False
@@ -178,6 +187,13 @@ class Scheduler:
                 if s.task_id == task_id:
                     del self._ready[i]
                     return True
+            for shape, (ep, dq) in self._parked.items():
+                for i, s in enumerate(dq):
+                    if s.task_id == task_id:
+                        del dq[i]
+                        if not dq:
+                            del self._parked[shape]
+                        return True
         return False
 
     # ---- scheduling loop (runs on the runtime's scheduler thread) ----
@@ -194,6 +210,12 @@ class Scheduler:
                 logger.exception("scheduler loop error")
 
     def wake(self):
+        self._wake.set()
+
+    def bump_capacity(self):
+        """Cluster capacity changed (resource release, node add/remove,
+        PG commit): parked shapes become placeable again."""
+        self._capacity_epoch += 1
         self._wake.set()
 
     @staticmethod
@@ -216,40 +238,60 @@ class Scheduler:
         )
 
     def _schedule_once(self):
+        import time as _time
+
+        cur = self._capacity_epoch
         with self._lock:
             ready, self._ready = self._ready, deque()
-        requeue = []
+            # unpark shapes whose park predates the current capacity
+            # epoch (plus a periodic full unpark as belt-and-braces for
+            # any release path missing a bump_capacity call)
+            if self._parked:
+                force = _time.monotonic() - self._last_unpark_all > 2.0
+                if force:
+                    self._last_unpark_all = _time.monotonic()
+                for shape in list(self._parked):
+                    ep, dq = self._parked[shape]
+                    if force or ep < cur:
+                        ready.extend(dq)
+                        del self._parked[shape]
+        park: dict = {}
         blocked: set = set()
         nodes = self.rt.node_list()
         for spec in ready:
             shape = self._shape_key(spec)
             if shape in blocked:
-                requeue.append(spec)
+                park[shape].append(spec)
                 continue
             node = self.policy.pick(spec, nodes)
             if node is None:
-                if spec.task_id not in self._infeasible_warned:
+                if shape not in self._infeasible_warned:
                     if len(self._infeasible_warned) > 10_000:
                         self._infeasible_warned.clear()
-                    self._infeasible_warned.add(spec.task_id)
+                    self._infeasible_warned.add(shape)
                     logger.warning(
                         "task %s is infeasible on the current cluster (resources=%s); queued",
                         spec.desc(),
                         spec.scheduling.resources,
                     )
-                requeue.append(spec)
                 blocked.add(shape)
+                park[shape] = deque([spec])
                 continue
             if node == "retry":
-                requeue.append(spec)
                 blocked.add(shape)
+                park[shape] = deque([spec])
                 continue
             if not self.rt.reserve_and_queue(node, spec):
-                requeue.append(spec)
                 blocked.add(shape)
-        if requeue:
-            with self._lock:
-                self._ready.extend(requeue)
+                park[shape] = deque([spec])
+        with self._lock:
+            for shape, dq in park.items():
+                entry = self._parked.get(shape)
+                if entry is not None:
+                    entry[1].extend(dq)
+                    entry[0] = cur  # re-confirmed unplaceable at this epoch
+                else:
+                    self._parked[shape] = [cur, dq]
 
     def take_ready_for(self, node, reserve, limit: int = 8) -> bool:
         """Completion fast path: the worker-IO thread that just freed
@@ -296,10 +338,17 @@ class Scheduler:
 
     def has_pending(self) -> bool:
         with self._lock:
-            return bool(self._ready or self._waiting)
+            return bool(self._ready or self._waiting or self._parked)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._ready) + len(self._waiting) + sum(len(dq) for _, dq in self._parked.values())
 
     def pending_demand(self) -> list[dict]:
         """Resource requests of queued-but-unplaced tasks (autoscaler
         input; reference: autoscaler/v2 cluster resource demand)."""
         with self._lock:
-            return [dict(s.scheduling.resources) for s in self._ready]
+            out = [dict(s.scheduling.resources) for s in self._ready]
+            for _, dq in self._parked.values():
+                out.extend(dict(s.scheduling.resources) for s in dq)
+            return out

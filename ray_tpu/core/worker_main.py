@@ -600,13 +600,10 @@ class WorkerClient:
                 fn = self._actor_method(msg["method"])
             else:
                 fn = self._direct_fn(msg["func_id"], conn_funcs)
-            rawp = msg.get("rawp")
-            if rawp is not None:
-                # fast path: (args, kwargs) ride the frame as one blob
-                import pickle as _pickle
-
-                args, kwargs = _pickle.loads(rawp)
-                kwargs = kwargs or {}
+            if "argv" in msg:
+                # fast path: args arrived as plain values with the frame
+                args = msg["argv"]
+                kwargs = msg.get("kwargv") or {}
             else:
                 args, kwargs, segs = self._decode_args(msg["args"], msg.get("kwargs"))
             try:

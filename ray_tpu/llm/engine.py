@@ -380,9 +380,10 @@ class LLMEngine:
                 )
             if self.kv_layout == "paged":
                 T = _bucket(len(prompt_token_ids), self.prefill_buckets)
-                if T // self._pcfg.page_size + 1 > self._pcfg.num_pages - 1:
+                need = min(T // self._pcfg.page_size + 1, self._pcfg.max_pages_per_seq)
+                if need > self._pcfg.num_pages - 1:
                     raise ValueError(
-                        f"prompt needs {T // self._pcfg.page_size + 1} pages but the pool has "
+                        f"prompt needs {need} pages but the pool has "
                         f"{self._pcfg.num_pages - 1}; raise num_pages"
                     )
             st = RequestState(request_id, list(prompt_token_ids), params)
@@ -576,7 +577,12 @@ class LLMEngine:
             # spinning in the admission loop forever
             self._finish(st, f"error: needs {need} pages, pool holds {self._pcfg.num_pages - 1}")
             return True
-        if self._page_alloc.free_pages < need and not self._preempt_for(need):
+        # ADMISSION never preempts running sequences: two contenders would
+        # otherwise evict each other inside one admission loop, generating
+        # their whole outputs one-recompute-prefill-per-token while decode
+        # stalls (vLLM semantics: waiting requests wait for free blocks;
+        # only DECODE growth may preempt — _paged_grow)
+        if self._page_alloc.free_pages < need:
             return False
         pages = self._page_alloc.alloc(need)
         if pages is None:
