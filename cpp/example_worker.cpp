@@ -1,0 +1,49 @@
+// Example C++ worker: defines a task function and an actor class served
+// to the cluster (tests/test_xlang_cpp.py compiles and drives this).
+//
+//   ./example_worker <head_host> <xlang_port> <authkey_hex> <worker_name>
+//
+// Reference parity target: /root/reference/cpp/example (counter app) —
+// tasks and a stateful Counter actor defined in C++.
+
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+#include "ray_tpu_worker.hpp"
+
+namespace {
+
+// stateful actor: the cluster-visible Counter
+struct Counter : ray_tpu::Actor {
+  long value = 0;
+  std::string Call(const std::string& method, const std::string& payload) override {
+    if (method == "add") {
+      value += std::stol(payload.empty() ? "1" : payload);
+      return std::to_string(value);
+    }
+    if (method == "get") return std::to_string(value);
+    throw std::runtime_error("unknown method " + method);
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 5) {
+    std::fprintf(stderr, "usage: %s <head_host> <xlang_port> <authkey_hex> <name>\n", argv[0]);
+    return 2;
+  }
+  ray_tpu::Worker w(argv[3]);
+  w.RegisterFunction("scale", [](const std::string& p) {
+    return std::to_string(std::stol(p) * 3);
+  });
+  w.RegisterActorClass("Counter", [](const std::string&) {
+    return std::unique_ptr<ray_tpu::Actor>(new Counter);
+  });
+  w.Announce(argv[1], std::atoi(argv[2]), argv[4]);
+  std::printf("worker %s serving on port %d\n", argv[4], w.port());
+  std::fflush(stdout);
+  w.Serve();
+  return 0;
+}

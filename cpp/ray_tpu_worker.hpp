@@ -1,0 +1,287 @@
+// ray_tpu C++ WORKER API: define tasks and actors in C++ and serve their
+// executions to the cluster.
+//
+// Reference parity: /root/reference/cpp/include/ray/api.h — the reference
+// lets C++ code register task functions and actor classes and executes
+// them inside C++ worker processes (cpp/src/ray/runtime/). TPU-native
+// redesign: instead of binding the core worker into C++, a C++ worker is
+// a tiny server speaking the language-neutral xlang frame protocol
+// (ray_tpu/core/xlang.py): it listens on its own socket, ANNOUNCES itself
+// to the head's xlang endpoint (REG_WORKER), and serves
+// function/actor-method executions pushed to it by python-side proxies.
+// Results travel back through the normal object plane (the proxy's
+// returns are ordinary cluster objects with ownership/refcounting).
+//
+//   ray_tpu::Worker w(authkey_hex);
+//   w.RegisterFunction("scale", [](const std::string& p) { ... });
+//   w.RegisterActorClass("Counter",
+//       [] { return std::unique_ptr<ray_tpu::Actor>(new Counter); });
+//   w.Announce("127.0.0.1", xlang_port, "cppw");  // head-side registry
+//   w.Serve();                                    // blocking
+//
+// Zero dependencies beyond POSIX sockets (+ the inline SHA-256 from
+// ray_tpu_client.hpp).
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <thread>
+
+#include "ray_tpu_client.hpp"  // detail::hmac_sha256 / unhex
+
+namespace ray_tpu {
+
+// user-visible actor interface: one dynamic dispatch per method call
+// (the reference's C++ API generates per-method stubs at compile time;
+// a string-keyed dispatch keeps this header dependency-free)
+struct Actor {
+  virtual ~Actor() = default;
+  virtual std::string Call(const std::string& method, const std::string& payload) = 0;
+};
+
+class Worker {
+ public:
+  using Fn = std::function<std::string(const std::string&)>;
+  using ActorFactory = std::function<std::unique_ptr<Actor>(const std::string& ctor_payload)>;
+
+  // ops served by this worker (mirrors ray_tpu/core/xlang.py)
+  static constexpr uint8_t kExecFn = 0x10;
+  static constexpr uint8_t kNewActor = 0x11;
+  static constexpr uint8_t kCallMethod = 0x12;
+  static constexpr uint8_t kDelActor = 0x13;
+  static constexpr uint8_t kRegWorker = 0x04;  // sent TO the head
+
+  explicit Worker(const std::string& authkey_hex)
+      : key_(detail::unhex(authkey_hex)) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) throw std::runtime_error("socket() failed");
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = 0;
+    if (::bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0)
+      throw std::runtime_error("bind failed");
+    if (::listen(listen_fd_, 16) != 0) throw std::runtime_error("listen failed");
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, (sockaddr*)&addr, &len);
+    port_ = ntohs(addr.sin_port);
+  }
+
+  ~Worker() {
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+  }
+
+  int port() const { return port_; }
+
+  void RegisterFunction(const std::string& name, Fn fn) { fns_[name] = std::move(fn); }
+  void RegisterActorClass(const std::string& name, ActorFactory f) { classes_[name] = std::move(f); }
+
+  // Tell the head's xlang endpoint where this worker listens and what it
+  // provides; python-side proxies resolve it by name (xlang.cpp_worker).
+  void Announce(const std::string& head_host, int head_port, const std::string& worker_name) {
+    int fd = dial(head_host, head_port);
+    auth_client(fd);
+    std::string body;
+    body.push_back(char(kRegWorker));
+    uint16_t p = uint16_t(port_);
+    body.append((char*)&p, 2);
+    uint16_t n = uint16_t(worker_name.size());
+    body.append((char*)&n, 2);
+    body += worker_name;
+    send_frame(fd, body);
+    std::string resp = recv_frame(fd);
+    ::close(fd);
+    if (resp.empty() || resp[0] != 0)
+      throw std::runtime_error("worker registration rejected: " + resp.substr(1));
+  }
+
+  // Blocking accept loop; one thread per connection (python proxy actors
+  // hold one persistent connection each, so per-actor ordering is the
+  // connection's FIFO order).
+  void Serve() {
+    while (!stopped_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (stopped_.load()) return;
+        continue;
+      }
+      std::thread(&Worker::ServeConn, this, fd).detach();
+    }
+  }
+
+  void Stop() {
+    stopped_.store(true);
+    ::shutdown(listen_fd_, SHUT_RDWR);
+  }
+
+ private:
+  void ServeConn(int fd) {
+    try {
+      auth_server(fd);
+      while (true) {
+        std::string req = recv_frame(fd);
+        if (req.empty()) break;
+        std::string resp;
+        try {
+          resp = Dispatch(req);
+        } catch (const std::exception& e) {
+          resp.push_back(char(1));
+          resp += e.what();
+        }
+        send_frame(fd, resp);
+      }
+    } catch (...) {
+    }
+    ::close(fd);
+  }
+
+  std::string Dispatch(const std::string& req) {
+    uint8_t op = uint8_t(req[0]);
+    std::string out;
+    if (op == kExecFn) {
+      uint16_t n;
+      std::memcpy(&n, req.data() + 1, 2);
+      std::string name = req.substr(3, n), payload = req.substr(3 + n);
+      auto it = fns_.find(name);
+      if (it == fns_.end()) throw std::runtime_error("no function " + name);
+      out.push_back(char(0));
+      out += it->second(payload);
+    } else if (op == kNewActor) {
+      uint16_t n;
+      std::memcpy(&n, req.data() + 1, 2);
+      std::string cls = req.substr(3, n), payload = req.substr(3 + n);
+      auto it = classes_.find(cls);
+      if (it == classes_.end()) throw std::runtime_error("no actor class " + cls);
+      uint64_t iid;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        iid = next_iid_++;
+        actors_[iid] = it->second(payload);
+      }
+      out.push_back(char(0));
+      out.append((char*)&iid, 8);
+    } else if (op == kCallMethod) {
+      uint64_t iid;
+      std::memcpy(&iid, req.data() + 1, 8);
+      uint16_t n;
+      std::memcpy(&n, req.data() + 9, 2);
+      std::string method = req.substr(11, n), payload = req.substr(11 + n);
+      Actor* a;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        auto it = actors_.find(iid);
+        if (it == actors_.end()) throw std::runtime_error("no actor instance");
+        a = it->second.get();
+      }
+      out.push_back(char(0));
+      out += a->Call(method, payload);
+    } else if (op == kDelActor) {
+      uint64_t iid;
+      std::memcpy(&iid, req.data() + 1, 8);
+      std::lock_guard<std::mutex> g(mu_);
+      actors_.erase(iid);
+      out.push_back(char(0));
+    } else {
+      throw std::runtime_error("unknown op");
+    }
+    return out;
+  }
+
+  // ---- framing + auth (same wire format as transport.py) ----
+  static int dial(const std::string& host, int port) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) throw std::runtime_error("socket() failed");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(uint16_t(port));
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      throw std::runtime_error("bad host");
+    if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0)
+      throw std::runtime_error("connect failed");
+    return fd;
+  }
+
+  static void send_all(int fd, const char* p, size_t n) {
+    while (n) {
+      ssize_t w = ::send(fd, p, n, 0);
+      if (w <= 0) throw std::runtime_error("send failed");
+      p += w;
+      n -= size_t(w);
+    }
+  }
+
+  static void recv_all(int fd, char* p, size_t n) {
+    while (n) {
+      ssize_t r = ::recv(fd, p, n, 0);
+      if (r <= 0) throw std::runtime_error("closed");
+      p += r;
+      n -= size_t(r);
+    }
+  }
+
+  static void send_frame(int fd, const std::string& data) {
+    uint32_t len = uint32_t(data.size());
+    char lb[4];
+    std::memcpy(lb, &len, 4);
+    send_all(fd, lb, 4);
+    send_all(fd, data.data(), data.size());
+  }
+
+  static std::string recv_frame(int fd) {
+    char lb[4];
+    recv_all(fd, lb, 4);
+    uint32_t len;
+    std::memcpy(&len, lb, 4);
+    if (len > (1u << 30)) throw std::runtime_error("oversized frame");
+    std::string out(len, '\0');
+    recv_all(fd, out.data(), len);
+    return out;
+  }
+
+  void auth_client(int fd) {
+    std::string challenge = recv_frame(fd);
+    uint8_t mac[32];
+    detail::hmac_sha256(key_, challenge, mac);
+    send_frame(fd, std::string((char*)mac, 32));
+    if (recv_frame(fd) != "OK") throw std::runtime_error("auth rejected");
+  }
+
+  void auth_server(int fd) {
+    std::string challenge(20, '\0');
+    for (auto& c : challenge) c = char(rand());
+    send_frame(fd, challenge);
+    std::string resp = recv_frame(fd);
+    uint8_t mac[32];
+    detail::hmac_sha256(key_, challenge, mac);
+    if (resp.size() != 32 || std::memcmp(resp.data(), mac, 32) != 0)
+      throw std::runtime_error("client auth failed");
+    send_frame(fd, "OK");
+  }
+
+  std::string key_;
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> stopped_{false};
+  std::map<std::string, Fn> fns_;
+  std::map<std::string, ActorFactory> classes_;
+  std::map<uint64_t, std::unique_ptr<Actor>> actors_;
+  std::mutex mu_;
+  uint64_t next_iid_ = 1;
+};
+
+}  // namespace ray_tpu

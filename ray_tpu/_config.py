@@ -119,6 +119,12 @@ class Config:
     # Format: "method1=N,method2=M" — fail the first N calls of method1.
     testing_rpc_failure: str = ""
 
+    # --- data ---
+    # Blocks observed above this size are split into ~this-sized chunks
+    # between pipeline stages (reference: DataContext.target_max_block_size
+    # + _internal/execution dynamic block splitting). 0 disables.
+    target_max_block_size: int = 128 * 1024 * 1024
+
     # --- direct call plane (ownership model; core/direct.py) ---
     # Caller->worker direct actor calls, worker leases for stateless tasks
     # and owner-local small objects (reference: reference_counter.h

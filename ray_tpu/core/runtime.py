@@ -449,7 +449,7 @@ class Runtime:
         self.gcs.events.record("node_added", node_id=node.node_id.hex(), resources=node.total_resources, joined=True)
         self.gcs.pubsub.publish("node", {"event": "added", "node_id": node.node_id.hex()})
         logger.info("node %s joined via agent listener (ns=%s)", node.node_id.hex()[:8], node.shm_ns)
-        self.scheduler.wake()
+        self.scheduler.bump_capacity()  # parked infeasible shapes re-evaluate
 
     # ---- cross-node segment fetch/free (head side) ----
     def _fetch_foreign_segment(self, desc) -> str:

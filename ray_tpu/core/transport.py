@@ -161,29 +161,36 @@ class ObjectTransferServer:
                 except ConnectionError:
                     return  # peer closed / retired the pooled socket
                 conn.settimeout(30.0)
+                head_want = None
                 if req.startswith(b"PULLR"):
                     off, length = struct.unpack("<QQ", req[5:21])
                     name = req[21:].decode()
-                    stat_only = False
+                elif req.startswith(b"PULLH"):
+                    # head pull: announce the TOTAL size, then stream at
+                    # most `want` bytes — small segments finish in ONE
+                    # round trip, large ones learn the size for ranged
+                    # sibling pulls without a wasted full-stream push
+                    (head_want,) = struct.unpack("<Q", req[5:13])
+                    name = req[13:].decode()
+                    off, length = 0, None
                 elif req.startswith(b"PULL"):
                     off, length = 0, None
                     name = req[4:].decode()
-                    stat_only = False
                 elif req.startswith(b"STAT"):
                     name = req[4:].decode()
-                    off, length, stat_only = 0, 0, True
+                    if "/" in name or not name.startswith(self.allowed_prefixes):
+                        raise ConnectionError("illegal segment name")
+                    try:
+                        conn.sendall(struct.pack("<Q", os.path.getsize("/dev/shm/" + name)))
+                    except OSError:
+                        conn.sendall(struct.pack("<Q", _ERR))
+                        _send_frame(conn, b"not found")
+                    continue
                 else:
                     raise ConnectionError(f"bad transfer op {req[:8]!r}")
                 if "/" in name or not name.startswith(self.allowed_prefixes):
                     raise ConnectionError("illegal segment name")
                 path = "/dev/shm/" + name
-                if stat_only:
-                    try:
-                        conn.sendall(struct.pack("<Q", os.path.getsize(path)))
-                    except OSError:
-                        conn.sendall(struct.pack("<Q", _ERR))
-                        _send_frame(conn, b"not found")
-                    continue
                 try:
                     f = open(path, "rb")
                 except OSError:
@@ -194,12 +201,16 @@ class ObjectTransferServer:
                     from ray_tpu.core import rpc_chaos
 
                     size = os.fstat(f.fileno()).st_size
-                    if length is None:
+                    if head_want is not None:
+                        send_size = min(head_want, size)
+                        conn.sendall(struct.pack("<QQ", size, send_size))
+                    elif length is None:
                         send_size = max(0, size - off)
+                        conn.sendall(struct.pack("<Q", send_size))
                     else:
                         send_size = max(0, min(length, size - off))
+                        conn.sendall(struct.pack("<Q", send_size))
                     f.seek(off)
-                    conn.sendall(struct.pack("<Q", send_size))
                     sent = 0
                     use_sendfile = True
                     while sent < send_size:
@@ -207,10 +218,13 @@ class ObjectTransferServer:
                             raise ConnectionError("chaos: transfer aborted mid-stream")
                         want = min(self.chunk_bytes, send_size - sent)
                         if use_sendfile:
-                            # kernel path: page cache -> socket, no python
-                            # loop, GIL released for the whole window
+                            # kernel path: page cache -> socket with the
+                            # GIL released. socket.sendfile (not raw
+                            # os.sendfile) handles the timeout socket's
+                            # EAGAIN internally by waiting for
+                            # writability instead of failing the window.
                             try:
-                                m = os.sendfile(conn.fileno(), f.fileno(), off + sent, want)
+                                m = conn.sendfile(f, offset=off + sent, count=want)
                                 if m == 0:
                                     break
                                 sent += m
@@ -341,6 +355,7 @@ def _recv_to_file(sock: socket.socket, fd: int, file_off: int, length: int) -> i
     got = 0
     if hasattr(os, "splice"):
         pr = pw = -1
+        consumed_any = False  # bytes left the SOCKET (possibly into the pipe)
         try:
             pr, pw = os.pipe()
             try:
@@ -350,22 +365,27 @@ def _recv_to_file(sock: socket.socket, fd: int, file_off: int, length: int) -> i
             except OSError:
                 pass
             while got < length:
-                n = os.splice(sock.fileno(), pw, min(1 << 20, length - got))
+                try:
+                    n = os.splice(sock.fileno(), pw, min(1 << 20, length - got))
+                except OSError:
+                    if consumed_any:
+                        raise ConnectionError("splice transfer failed mid-stream") from None
+                    break  # first socket splice unsupported: clean fallback
                 if n == 0:
                     raise ConnectionError("transfer truncated")
+                consumed_any = True
                 moved = 0
                 while moved < n:
-                    moved += os.splice(pr, fd, n - moved, offset_dst=file_off + got + moved)
+                    # any failure past this point strands bytes in the
+                    # pipe — the stream offset is unknowable, so the pull
+                    # (and its pooled socket) must fail, never fall back
+                    try:
+                        moved += os.splice(pr, fd, n - moved, offset_dst=file_off + got + moved)
+                    except OSError:
+                        raise ConnectionError("splice pipe drain failed mid-stream") from None
                 got += n
-            return got
-        except OSError:
-            if got:
-                # partial progress: bytes may be stranded in the pipe, so
-                # the stream offset is unknown — the segment AND the
-                # connection are both unusable (retry dials fresh)
-                raise ConnectionError("splice transfer failed mid-stream") from None
-            # clean first-call failure (splice unsupported on this fd
-            # combo): nothing consumed, the recv fallback can take over
+            else:
+                return got
         finally:
             for p in (pr, pw):
                 if p >= 0:
@@ -402,37 +422,30 @@ def _pull_once(addr, authkey: bytes, src_name: str, dst_name: str, timeout: floa
     pooled = False
     try:
         sock.settimeout(timeout)
-        # cheap STAT round trip first: large segments go straight to
-        # parallel range pulls without a wasted full-stream server push
-        _send_frame(sock, b"STAT" + src_name.encode())
-        (size,) = struct.unpack("<Q", _recv_exact(sock, 8))
-        if size == _ERR:
+        # ONE round trip: PULLH streams up to the parallel threshold and
+        # announces the total, so small segments finish immediately and
+        # large ones learn the size for ranged sibling pulls with no
+        # wasted full-stream push
+        _send_frame(sock, b"PULLH" + struct.pack("<Q", _PARALLEL_THRESHOLD) + src_name.encode())
+        (total,) = struct.unpack("<Q", _recv_exact(sock, 8))
+        if total == _ERR:
             err = _recv_frame(sock)
             _bump("pull_errors")
             _pool_put(addr, sock)
             pooled = True
             raise FileNotFoundError(f"remote segment {src_name}: {err.decode()}")
-        if size >= _PARALLEL_THRESHOLD:
-            _pool_put(addr, sock)
-            pooled = True
-            got = _pull_parallel(addr, authkey, src_name, tmp, size, timeout)
-        else:
-            _send_frame(sock, b"PULL" + src_name.encode())
-            (size2,) = struct.unpack("<Q", _recv_exact(sock, 8))
-            if size2 == _ERR:
-                err = _recv_frame(sock)
-                _bump("pull_errors")
-                _pool_put(addr, sock)
-                pooled = True
-                raise FileNotFoundError(f"remote segment {src_name}: {err.decode()}")
-            with _admission:
-                fd = os.open(tmp, os.O_WRONLY | os.O_CREAT, 0o600)
-                try:
-                    got = _recv_to_file(sock, fd, 0, size2)
-                finally:
-                    os.close(fd)
-            _pool_put(addr, sock)
-            pooled = True
+        (sending,) = struct.unpack("<Q", _recv_exact(sock, 8))
+        with _admission:
+            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT, 0o600)
+            try:
+                os.ftruncate(fd, total)
+                got = _recv_to_file(sock, fd, 0, sending)
+            finally:
+                os.close(fd)
+        _pool_put(addr, sock)
+        pooled = True
+        if total > sending:
+            got += _pull_parallel(addr, authkey, src_name, tmp, sending, total, timeout)
         os.rename(tmp, "/dev/shm/" + dst_name)
         _bump("pulls")
         _bump("pull_bytes", got)
@@ -449,14 +462,16 @@ def _pull_once(addr, authkey: bytes, src_name: str, dst_name: str, timeout: floa
                 pass
 
 
-def _pull_parallel(addr, authkey: bytes, src_name: str, tmp: str, size: int, timeout: float) -> int:
-    """Split a large segment into ranges pulled over parallel pooled
-    connections (admission-controlled; reference pull_manager windowing)."""
+def _pull_parallel(addr, authkey: bytes, src_name: str, tmp: str, start: int, size: int, timeout: float) -> int:
+    """Pull [start, size) of a segment as parallel ranged streams over
+    pooled connections (admission-controlled; reference pull_manager
+    windowing). The file already holds [0, start)."""
     nstreams = _PARALLEL_STREAMS
-    part = (size + nstreams - 1) // nstreams
-    ranges = [(i * part, min(part, size - i * part)) for i in range(nstreams) if i * part < size]
-    with open(tmp, "wb") as f:
-        f.truncate(size)
+    todo = size - start
+    part = (todo + nstreams - 1) // nstreams
+    ranges = [
+        (start + i * part, min(part, todo - i * part)) for i in range(nstreams) if i * part < todo
+    ]
     fd = os.open(tmp, os.O_WRONLY)
     errors: list = []
     try:

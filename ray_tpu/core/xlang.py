@@ -39,6 +39,13 @@ from ray_tpu.core.transport import _auth_server, _recv_exact, _send_frame
 OP_PUT = 0x01
 OP_GET = 0x02
 OP_CALL = 0x03
+OP_REG_WORKER = 0x04  # a non-Python WORKER announces its own listener
+
+# ops served BY a registered xlang worker (cpp/ray_tpu_worker.hpp)
+OP_EXEC_FN = 0x10
+OP_NEW_ACTOR = 0x11
+OP_CALL_METHOD = 0x12
+OP_DEL_ACTOR = 0x13
 
 
 def _recv_frame(sock: socket.socket) -> bytes:
@@ -67,6 +74,8 @@ class XLangServer:
         self.rt = runtime
         self.authkey = authkey or secrets.token_bytes(16)
         self._fns: dict[str, object] = {}  # name -> RemoteFunction
+        # registered non-Python workers: name -> (host, port)
+        self.workers: dict[str, tuple] = {}
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -113,6 +122,16 @@ class XLangServer:
                         (timeout,) = struct.unpack("<d", body[20:28])
                         value = self.rt.get_object(oid, timeout=timeout if timeout > 0 else None)
                         resp = bytes([0]) + _to_wire_bytes(value)
+                    elif op == OP_REG_WORKER:
+                        # a C++ (or other-language) worker announces the
+                        # listener it serves task/actor executions on;
+                        # python proxies resolve it by name
+                        (wport,) = struct.unpack("<H", body[:2])
+                        (name_len,) = struct.unpack("<H", body[2:4])
+                        wname = body[4 : 4 + name_len].decode()
+                        peer_host = conn.getpeername()[0]
+                        self.workers[wname] = (peer_host, wport)
+                        resp = bytes([0])
                     elif op == OP_CALL:
                         (name_len,) = struct.unpack("<H", body[:2])
                         name = body[2 : 2 + name_len].decode()
@@ -182,3 +201,114 @@ def shutdown():
     if _server is not None:
         _server.shutdown()
         _server = None
+
+
+# ---------------------------------------------------------------------------
+# worker-side C++ API: python proxies for functions/actors DEFINED in a
+# registered xlang worker (reference: /root/reference/cpp/include/ray/api.h —
+# tasks and actors authored in C++, callable from the cluster)
+# ---------------------------------------------------------------------------
+def _worker_endpoint(worker_name: str, timeout: float = 30.0) -> tuple:
+    import time as _time
+
+    if _server is None:
+        raise RuntimeError("call xlang.serve() first")
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        ep = _server.workers.get(worker_name)
+        if ep is not None:
+            return ep
+        _time.sleep(0.05)
+    raise KeyError(f"no xlang worker named {worker_name!r} registered")
+
+
+def _dial_worker(host: str, port: int, authkey_hex: str) -> socket.socket:
+    from ray_tpu.core.transport import _auth_client
+
+    sock = socket.create_connection((host, port), timeout=30.0)
+    sock.settimeout(120.0)
+    _auth_client(sock, bytes.fromhex(authkey_hex))
+    return sock
+
+
+def _worker_roundtrip(sock: socket.socket, req: bytes) -> bytes:
+    _send_frame(sock, req)
+    resp = _recv_frame(sock)
+    if not resp or resp[0] != 0:
+        raise RuntimeError(f"xlang worker error: {resp[1:].decode(errors='replace')}")
+    return resp[1:]
+
+
+def cpp_function(worker_name: str, fn_name: str):
+    """A .remote()-able proxy for a function DEFINED in a registered C++
+    worker. Execution happens in the C++ process; the call itself runs as
+    a normal cluster task (a python worker dials the C++ listener), so
+    the result is an ordinary owned object."""
+    host, port = _worker_endpoint(worker_name)
+    key = _server.authkey.hex()
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def _cpp_call(h, p, k, fn, payload):
+        import struct as _struct
+
+        from ray_tpu.core import xlang as _x
+
+        sock = _x._dial_worker(h, p, k)
+        try:
+            req = bytes([_x.OP_EXEC_FN]) + _struct.pack("<H", len(fn)) + fn.encode() + bytes(payload)
+            return _x._worker_roundtrip(sock, req)
+        finally:
+            sock.close()
+
+    class _Proxy:
+        def remote(self, payload: bytes = b""):
+            return _cpp_call.remote(host, port, key, fn_name, payload)
+
+    return _Proxy()
+
+
+def cpp_actor(worker_name: str, class_name: str, ctor_payload: bytes = b""):
+    """Instantiate an actor CLASS defined in a registered C++ worker and
+    return a handle. A python proxy actor holds ONE persistent connection
+    to the C++ process, so per-caller method ordering is the connection's
+    FIFO order (like any actor); results flow through the normal object
+    plane. Use: h = cpp_actor("w", "Counter"); h.call.remote("add", b"2")."""
+    host, port = _worker_endpoint(worker_name)
+    key = _server.authkey.hex()
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    class _CppActorProxy:
+        def __init__(self, h, p, k, cls, payload):
+            import struct as _struct
+
+            from ray_tpu.core import xlang as _x
+
+            self._x = _x
+            self._struct = _struct
+            self._sock = _x._dial_worker(h, p, k)
+            req = bytes([_x.OP_NEW_ACTOR]) + _struct.pack("<H", len(cls)) + cls.encode() + bytes(payload)
+            body = _x._worker_roundtrip(self._sock, req)
+            (self._iid,) = _struct.unpack("<Q", body[:8])
+
+        def call(self, method: str, payload: bytes = b"") -> bytes:
+            req = (
+                bytes([self._x.OP_CALL_METHOD])
+                + self._struct.pack("<Q", self._iid)
+                + self._struct.pack("<H", len(method))
+                + method.encode()
+                + bytes(payload)
+            )
+            return self._x._worker_roundtrip(self._sock, req)
+
+        def __ray_shutdown__(self):
+            try:
+                self._x._worker_roundtrip(self._sock, bytes([self._x.OP_DEL_ACTOR]) + self._struct.pack("<Q", self._iid))
+                self._sock.close()
+            except Exception:
+                pass
+
+    return _CppActorProxy.remote(host, port, key, class_name, ctor_payload)

@@ -339,16 +339,87 @@ def _windowed(submits: Iterator, budget: "OpBudget | int"):
         budget.close()  # release this stage's pool claim
 
 
+@ray_tpu.remote
+def _split_block(block: Block, n: int):
+    """Split one oversized block into n row-balanced chunks (dynamic block
+    splitting; reference: _internal/execution block splitting at
+    DataContext.target_max_block_size). take() (not slice()) so each chunk
+    materializes its OWN buffers — an arrow zero-copy slice would ship the
+    full parent buffer with every chunk, defeating the split."""
+    acc = BlockAccessor(block)
+    rows = acc.num_rows()
+    bounds = [round(i * rows / n) for i in range(n + 1)]
+    return tuple(acc.take_indices(np.arange(bounds[i], bounds[i + 1])) for i in range(n))
+
+
+def _split_oversized(upstream: Iterator, target_bytes: int) -> Iterator:
+    """Transparently replace any block that SEALED above target_bytes with
+    ~target-sized chunks. Unsealed blocks park briefly (re-checked each
+    tick) so slow big blocks are not systematically missed; stragglers
+    split at stream end."""
+    from ray_tpu.core import context, direct
+
+    def entry_size(ref):
+        """-1 = still running; 0 = completed small (owned/inline — below
+        the split target by construction); >0 = sealed store entry size."""
+        k = ref.id.binary()
+        ready = direct.owned_ready(k)
+        if ready is True:
+            return 0  # direct-plane inline result: < 100KB by protocol
+        if ready is False:
+            return -1  # direct call still in flight
+        try:
+            entry = context.get_client().store.try_get_entry(ref.id)
+            return entry.size() if entry is not None else -1
+        except Exception:
+            return 0
+
+    def maybe_split(ref, size):
+        n = -(-size // target_bytes)
+        if n <= 1:
+            return [ref]
+        return list(_split_block.options(num_returns=int(n)).remote(ref, int(n)))
+
+    # FIFO with head-of-line gating: block order is part of Dataset
+    # semantics, so a block whose size is still unknown holds later ones
+    # back (they are already submitted upstream, so execution still
+    # overlaps; only the yield order waits)
+    pending = collections.deque()
+    for ref in upstream:
+        pending.append(ref)
+        while pending:
+            size = entry_size(pending[0])
+            if size < 0:
+                break  # head still running; keep order
+            yield from maybe_split(pending.popleft(), size)
+    import ray_tpu as rt
+
+    while pending:
+        r = pending.popleft()
+        size = entry_size(r)
+        if size < 0:
+            rt.wait([r], num_returns=1, timeout=None)  # force seal
+            size = max(entry_size(r), 0)
+        yield from maybe_split(r, size)
+
+
 def execute_plan(source_tasks: list, ops: list) -> Iterator:
     """Returns an iterator of ObjectRef[Block]. Pulling drives execution."""
+    from ray_tpu._config import get_config
+
     num_stages = 1 + sum(isinstance(op, MapSpec) for op in ops)
+    target = get_config().target_max_block_size
     stream: Iterator = _windowed(
         (lambda t=t: _exec_read_task.remote(t) for t in source_tasks),
         OpBudget(num_stages=num_stages),
     )
+    if target > 0:
+        stream = _split_oversized(stream, target)
     for op in ops:
         if isinstance(op, MapSpec):
             stream = _map_stage(stream, op, num_stages)
+            if target > 0:
+                stream = _split_oversized(stream, target)
         elif isinstance(op, LimitSpec):
             stream = _limit_stage(stream, op.n)
         elif isinstance(op, AllToAllSpec):
