@@ -154,10 +154,11 @@ def main(config: str = "sft"):
 
 if __name__ == "__main__":
     cfg_name = "sft"
-    if "--config" in sys.argv:
-        cfg_name = sys.argv[sys.argv.index("--config") + 1]
     try:
+        if "--config" in sys.argv:
+            cfg_name = sys.argv[sys.argv.index("--config") + 1]
         main(cfg_name)
     except Exception as e:  # noqa: BLE001
-        print(json.dumps({"metric": "llama_sft_mfu", "value": 0.0, "unit": "mfu", "vs_baseline": 0.0, "error": str(e)[:300]}))
+        failed_metric = "llama_sft_mfu_seq8192" if cfg_name == "longctx" else "llama_sft_mfu"
+        print(json.dumps({"metric": failed_metric, "value": 0.0, "unit": "mfu", "vs_baseline": 0.0, "error": str(e)[:300]}))
         sys.exit(1)

@@ -36,6 +36,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <random>
 #include <stdexcept>
 #include <string>
 #include <thread>
@@ -150,20 +151,28 @@ class Worker {
     ::close(fd);
   }
 
+  static void need(const std::string& req, size_t n) {
+    if (req.size() < n) throw std::runtime_error("truncated frame");
+  }
+
   std::string Dispatch(const std::string& req) {
     uint8_t op = uint8_t(req[0]);
     std::string out;
     if (op == kExecFn) {
+      need(req, 3);
       uint16_t n;
       std::memcpy(&n, req.data() + 1, 2);
+      need(req, 3 + size_t(n));
       std::string name = req.substr(3, n), payload = req.substr(3 + n);
       auto it = fns_.find(name);
       if (it == fns_.end()) throw std::runtime_error("no function " + name);
       out.push_back(char(0));
       out += it->second(payload);
     } else if (op == kNewActor) {
+      need(req, 3);
       uint16_t n;
       std::memcpy(&n, req.data() + 1, 2);
+      need(req, 3 + size_t(n));
       std::string cls = req.substr(3, n), payload = req.substr(3 + n);
       auto it = classes_.find(cls);
       if (it == classes_.end()) throw std::runtime_error("no actor class " + cls);
@@ -176,10 +185,12 @@ class Worker {
       out.push_back(char(0));
       out.append((char*)&iid, 8);
     } else if (op == kCallMethod) {
+      need(req, 11);
       uint64_t iid;
       std::memcpy(&iid, req.data() + 1, 8);
       uint16_t n;
       std::memcpy(&n, req.data() + 9, 2);
+      need(req, 11 + size_t(n));
       std::string method = req.substr(11, n), payload = req.substr(11 + n);
       Actor* a;
       {
@@ -191,6 +202,7 @@ class Worker {
       out.push_back(char(0));
       out += a->Call(method, payload);
     } else if (op == kDelActor) {
+      need(req, 9);
       uint64_t iid;
       std::memcpy(&iid, req.data() + 1, 8);
       std::lock_guard<std::mutex> g(mu_);
@@ -262,8 +274,13 @@ class Worker {
   }
 
   void auth_server(int fd) {
+    // real entropy: an unseeded rand() would hand every worker process
+    // the same predictable challenge sequence (replayable auth)
     std::string challenge(20, '\0');
-    for (auto& c : challenge) c = char(rand());
+    {
+      std::random_device rd;
+      for (auto& c : challenge) c = char(rd());
+    }
     send_frame(fd, challenge);
     std::string resp = recv_frame(fd);
     uint8_t mac[32];

@@ -1218,17 +1218,25 @@ def raw_eligible(args, kwargs) -> bool:
 
 
 def _dump_raw_frame(st, frame) -> tuple[bytes, list | None] | None:
-    """Serialize a raw-args call frame in ONE pickle pass, collecting
-    nested-ref pins via the serialization sink. None = ineligible
-    (unpicklable content or too large for inline transport)."""
+    """Serialize a raw-args call frame in ONE pass, collecting nested-ref
+    pins via the serialization sink. None = ineligible (unserializable
+    content or too large for inline transport).
+
+    cloudpickle, NOT plain pickle: plain pickle serializes __main__
+    functions/classes BY REFERENCE, which dumps fine on the driver and
+    then fails to load in the worker (whose __main__ is empty for
+    stdin/REPL drivers) — cloudpickle ships them by value like the
+    encoded ArgSpec path does, at C-pickler speed for plain data."""
+    import cloudpickle as _cp
+
     from ray_tpu.core import object_ref as _oref
 
     sink: list = []
     token = _oref.push_ref_sink(sink)
     try:
-        data = pickle.dumps(frame, protocol=5)
+        data = _cp.dumps(frame, protocol=5)
     except Exception:
-        return None  # cloudpickle-only content: ArgSpec path handles it
+        return None  # genuinely unserializable: ArgSpec path decides
     finally:
         _oref.pop_ref_sink(token)
     if len(data) > st.inline_threshold + 4096:

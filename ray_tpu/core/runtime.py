@@ -1344,7 +1344,8 @@ class Runtime:
                 if wkey == renv_key or wkey is None:
                     idle.append(w)
             idle.sort(key=lambda w: w.env_binding.get("runtime_env") != renv_key)
-            if chips or spec.is_actor_creation:
+            _reuse_dbg = os.environ.get("RT_DEBUG_REUSE_ACTOR_WORKERS") == "1"
+            if chips or (spec.is_actor_creation and not _reuse_dbg):
                 # never-used workers only: chip-isolation env must precede
                 # any jax import, and actors get a dedicated fresh process
                 # (reference parity: the raylet does not recycle task
@@ -1395,7 +1396,7 @@ class Runtime:
                         x
                         for x in idle
                         if x.state == "idle"
-                        and (not (chips or spec.is_actor_creation) or x.fresh)
+                        and (not (chips or (spec.is_actor_creation and not _reuse_dbg)) or x.fresh)
                         and "TPU_VISIBLE_CHIPS" not in x.env_binding
                         and x.env_binding.get("runtime_env") in (renv_key, None)
                     ),

@@ -162,6 +162,7 @@ class ObjectTransferServer:
                     return  # peer closed / retired the pooled socket
                 conn.settimeout(30.0)
                 head_want = None
+                stat_only = False
                 if req.startswith(b"PULLR"):
                     off, length = struct.unpack("<QQ", req[5:21])
                     name = req[21:].decode()
@@ -178,19 +179,19 @@ class ObjectTransferServer:
                     name = req[4:].decode()
                 elif req.startswith(b"STAT"):
                     name = req[4:].decode()
-                    if "/" in name or not name.startswith(self.allowed_prefixes):
-                        raise ConnectionError("illegal segment name")
-                    try:
-                        conn.sendall(struct.pack("<Q", os.path.getsize("/dev/shm/" + name)))
-                    except OSError:
-                        conn.sendall(struct.pack("<Q", _ERR))
-                        _send_frame(conn, b"not found")
-                    continue
+                    off, length, stat_only = 0, 0, True
                 else:
                     raise ConnectionError(f"bad transfer op {req[:8]!r}")
                 if "/" in name or not name.startswith(self.allowed_prefixes):
                     raise ConnectionError("illegal segment name")
                 path = "/dev/shm/" + name
+                if stat_only:
+                    try:
+                        conn.sendall(struct.pack("<Q", os.path.getsize(path)))
+                    except OSError:
+                        conn.sendall(struct.pack("<Q", _ERR))
+                        _send_frame(conn, b"not found")
+                    continue
                 try:
                     f = open(path, "rb")
                 except OSError:
@@ -515,7 +516,7 @@ def _pull_parallel(addr, authkey: bytes, src_name: str, tmp: str, start: int, si
         os.close(fd)
     if errors:
         raise errors[0]
-    return size
+    return todo  # bytes THIS call transferred (the caller holds [0, start))
 
 
 def _capture(errors: list, fn, *a):

@@ -491,7 +491,7 @@ class WorkerClient:
         """Fast-path reply: plain values ride the result frame as one
         pickle. Falls back (False) for cloudpickle-only or store-sized
         results."""
-        import pickle as _pickle
+        import cloudpickle as _cp
 
         from ray_tpu._config import get_config
         from ray_tpu.core import object_ref as _oref
@@ -499,7 +499,9 @@ class WorkerClient:
         sink: list = []
         token = _oref.push_ref_sink(sink)
         try:
-            data = _pickle.dumps(
+            # cloudpickle: results may reference classes the driver only
+            # knows by value (see direct._dump_raw_frame)
+            data = _cp.dumps(
                 {"op": "result", "cid": msg["cid"], "vals": values, "error": None},
                 protocol=5,
             )

