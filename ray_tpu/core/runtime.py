@@ -821,6 +821,7 @@ class Runtime:
             self._renv_cache = {}
         cached = self._renv_cache.get(key)
         if cached is None:
+            from ray_tpu.core import direct as _direct
             from ray_tpu.runtime_env import prepare_runtime_env
 
             prepared = prepare_runtime_env(renv)
@@ -828,7 +829,18 @@ class Runtime:
                 if packed:
                     ref = packed.pop("_ref", None)
                     if ref is not None:
+                        # archive ids travel as HEX STRINGS inside the
+                        # runtime_env dict — no owner hint rides along, so
+                        # an owner-local put would be unreachable from
+                        # workers; move it into the head store, pin
+                        # against eviction AND hold a live ref so the
+                        # reference counter can never free it (the hex
+                        # string in the env dict is invisible to it)
+                        _direct.promote(self, ref.id.binary())
                         self.store.pin(ref.id)
+                        if not hasattr(self, "_renv_pins"):
+                            self._renv_pins = []
+                        self._renv_pins.append(ref)
             cached = {k: v for k, v in prepared.items() if k != "env_vars"}
             self._renv_cache[key] = cached
         out = dict(cached)

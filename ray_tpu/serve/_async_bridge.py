@@ -44,10 +44,28 @@ async def result_async(response, timeout_s: float | None = None):
 
         loop.call_soon_threadsafe(settle)
 
+    # async handles bind their replica ref from the dispatcher thread —
+    # a loop-side notification (no parked executor thread per pending
+    # request) wakes us when it happens; one deadline covers bind + result
+    deadline = None if timeout_s is None else loop.time() + timeout_s
+    if response._ref is None:
+        bind_fut: asyncio.Future = loop.create_future()
+
+        def _on_bind():
+            loop.call_soon_threadsafe(lambda: bind_fut.done() or bind_fut.set_result(None))
+
+        if response._add_bind_callback(_on_bind):
+            try:
+                await asyncio.wait_for(bind_fut, timeout=timeout_s)
+            except asyncio.TimeoutError:
+                raise GetTimeoutError(f"request still queued after {timeout_s}s") from None
+        if response._error is not None:
+            raise response._error
     rt = context.get_client()
     rt.add_done_callback(response._ref.id, cb)
+    remaining = None if deadline is None else max(0.0, deadline - loop.time())
     try:
-        value = await asyncio.wait_for(fut, timeout=timeout_s)
+        value = await asyncio.wait_for(fut, timeout=remaining)
     except asyncio.TimeoutError:
         raise GetTimeoutError(f"request exceeded {timeout_s}s") from None
     finally:

@@ -131,7 +131,9 @@ class HTTPProxy(RouteTableMixin):
                         return
                     self._respond(200, result)
                 except Exception as e:  # noqa: BLE001
-                    self._respond(500, {"error": repr(e)})
+                    import traceback as _tb
+
+                    self._respond(500, {"error": repr(e), "trace": _tb.format_exc()})
 
             def _wants_stream(self, req: Request) -> bool:
                 accept = req.headers.get("Accept", "") or req.headers.get("accept", "")

@@ -18,6 +18,7 @@ import random
 import threading
 import time
 import uuid
+from collections import deque
 
 import ray_tpu
 
@@ -26,27 +27,80 @@ _REFRESH_INTERVAL_S = 0.25
 
 class DeploymentResponse:
     """Future for one request (reference: serve/handle.py
-    DeploymentResponse). `result()` blocks; `_to_object_ref()` unwraps for
-    composition with ray_tpu.get/wait; `cancel()` propagates to the
-    replica task and releases the router slot."""
+    DeploymentResponse — handles are ASYNC: .remote() never blocks the
+    caller; requests beyond replica capacity queue inside the router and
+    a dispatcher assigns them as slots free). `result()` blocks;
+    `_to_object_ref()` unwraps for composition with ray_tpu.get/wait;
+    `cancel()` propagates to the replica task and releases the slot."""
 
-    def __init__(self, router, replica_id, ref):
+    def __init__(self, router, replica_id=None, ref=None):
         self._router = router
         self._replica_id = replica_id
         self._ref = ref
+        self._error = None
         self._done = False
+        self._cancelled = False
+        self._bound = threading.Event()
+        self._bind_cbs: list = []
+        if ref is not None:
+            self._bound.set()
+
+    # -- dispatcher side --
+    def _fire_bind_cbs(self):
+        cbs, self._bind_cbs = self._bind_cbs, []
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def _bind(self, replica_id, ref):
+        self._replica_id = replica_id
+        self._ref = ref
+        self._bound.set()
+        self._fire_bind_cbs()
+
+    def _fail(self, err: BaseException):
+        self._error = err
+        self._done = True
+        self._bound.set()
+        self._fire_bind_cbs()
+
+    def _add_bind_callback(self, cb) -> bool:
+        """Register cb() to run when the response binds or fails; returns
+        False (without registering) if that already happened."""
+        if self._bound.is_set():
+            return False
+        self._bind_cbs.append(cb)
+        if self._bound.is_set() and cb in self._bind_cbs:
+            # raced the bind: fire inline so the waiter can't be missed
+            self._bind_cbs.remove(cb)
+            return False
+        return True
 
     def _settle(self):
         if not self._done:
             self._done = True
-            self._router._on_done(self._replica_id, self._ref)
+            if self._ref is not None:
+                self._router._on_done(self._replica_id, self._ref)
+
+    def _wait_bound(self, timeout_s: float | None):
+        if not self._bound.wait(timeout=timeout_s):
+            raise ray_tpu.exceptions.GetTimeoutError(
+                f"request still queued for a replica after {timeout_s}s"
+            )
+        if self._error is not None:
+            raise self._error
 
     def result(self, timeout_s: float | None = None):
         """A timeout raises but does NOT cancel (matching the reference:
         poll-with-timeout keeps the request running; call cancel() to
         abort)."""
+        t0 = time.time()
+        self._wait_bound(timeout_s)
+        remaining = None if timeout_s is None else max(0.0, timeout_s - (time.time() - t0))
         try:
-            v = ray_tpu.get(self._ref, timeout=timeout_s)
+            v = ray_tpu.get(self._ref, timeout=remaining)
             self._settle()
             return v
         except ray_tpu.exceptions.GetTimeoutError:
@@ -57,14 +111,21 @@ class DeploymentResponse:
 
     def cancel(self):
         """Best-effort cancellation (reference: DeploymentResponse.cancel):
-        a queued replica task is dropped; the router slot frees either way."""
+        a queued request is dropped before dispatch; a dispatched replica
+        task is cancelled; the router slot frees either way."""
+        self._cancelled = True
+        if self._ref is None:
+            # not yet bound: the DISPATCHER settles/skips it (settling
+            # here would mark _done and leak the slot it's about to claim)
+            return
         try:
             ray_tpu.cancel(self._ref)
         except Exception:
             pass
         self._settle()
 
-    def _to_object_ref(self):
+    def _to_object_ref(self, timeout_s: float | None = 60.0):
+        self._wait_bound(timeout_s)
         return self._ref
 
 
@@ -141,6 +202,8 @@ class _Router:
         self._inflight: dict[str, int] = {}
         self._inflight_refs: dict = {}  # ref-id -> replica_id
         self._queued = 0
+        self._pending_q: deque = deque()
+        self._dispatcher = None
         self._last_refresh = 0.0
         self._last_push = 0.0
         from collections import OrderedDict
@@ -239,44 +302,106 @@ class _Router:
         return min(picks, key=lambda c: self._inflight.get(c[0], 0))
 
     def submit(self, method_name: str, args: tuple, kwargs: dict, timeout_s: float | None = 60.0, stream: bool = False, multiplexed_model_id: str | None = None):
+        """Non-streaming: ASYNC — enqueue and return an unbound
+        DeploymentResponse immediately (reference handles never block the
+        caller; queue depth drives the autoscaler). Streaming keeps the
+        synchronous admission path (a generator needs its ref up front)."""
+        if stream:
+            rid, actor = self._admit(multiplexed_model_id, time.time() + timeout_s if timeout_s else None, timeout_s)
+            return self._dispatch_stream(rid, actor, method_name, args, kwargs, multiplexed_model_id)
+        resp = DeploymentResponse(self)
         deadline = time.time() + timeout_s if timeout_s else None
-        self._refresh(force=not self._replicas)
         with self._lock:
+            self._pending_q.append((resp, method_name, args, kwargs, multiplexed_model_id, deadline, timeout_s))
             self._queued += 1
-        try:
-            while True:
+            self._ensure_dispatcher()
+            self._lock.notify_all()
+        self._push_metrics()
+        return resp
+
+    def _ensure_dispatcher(self):
+        t = self._dispatcher
+        if t is None or not t.is_alive():
+            self._dispatcher = threading.Thread(target=self._dispatch_loop, daemon=True, name="rt-serve-dispatch")
+            self._dispatcher.start()
+
+    def _dispatch_loop(self):
+        while True:
+            with self._lock:
+                if not self._pending_q:
+                    # linger briefly for the next burst, then retire
+                    self._lock.wait(timeout=5.0)
+                    if not self._pending_q:
+                        self._dispatcher = None
+                        return
+                item = self._pending_q.popleft()
+            resp, method_name, args, kwargs, model_id, deadline, timeout_s = item
+            if resp._cancelled:
                 with self._lock:
-                    pick = self._pick_replica(multiplexed_model_id) if self._replicas else None
-                    if pick is not None:
-                        rid, actor = pick
-                        self._inflight[rid] = self._inflight.get(rid, 0) + 1
-                        break
-                # At capacity: settle any finished requests, re-sync the
-                # replica set, then BLOCK on our in-flight completions
-                # (the object store's waiter condition wakes us the moment
-                # one finishes — no fixed-interval polling). With nothing
-                # of ours in flight the replicas are saturated by other
-                # handles: sleep one refresh beat for topology/metrics.
-                self._reap()
-                self._refresh(force=True)
+                    self._queued -= 1
+                resp._fail(ray_tpu.exceptions.RayTpuError("request cancelled before dispatch"))
+                continue
+            try:
+                rid, actor = self._admit(model_id, deadline, timeout_s)
+            except BaseException as e:  # noqa: BLE001
                 with self._lock:
-                    if self._pick_replica() is not None:
-                        continue
-                refs = self._waitable_refs()
-                remaining = None if deadline is None else max(0.0, deadline - time.time())
-                if refs:
-                    wait_t = _REFRESH_INTERVAL_S if remaining is None else min(remaining, _REFRESH_INTERVAL_S)
-                    ray_tpu.wait(refs, num_returns=1, timeout=wait_t, fetch_local=False)
-                    self._reap()
-                else:
-                    time.sleep(0.02 if remaining is None else min(remaining, 0.02))
-                if deadline and time.time() > deadline:
-                    raise TimeoutError(
-                        f"no replica of {self._app}/{self._deployment} accepted the request within {timeout_s}s"
-                    )
-        finally:
+                    self._queued -= 1
+                resp._fail(e)
+                continue
             with self._lock:
                 self._queued -= 1
+            try:
+                ref = actor.handle_request.remote(method_name, args, kwargs, model_id)
+            except Exception as e:
+                with self._lock:
+                    if rid in self._inflight:
+                        self._inflight[rid] = max(0, self._inflight[rid] - 1)
+                resp._fail(e)
+                continue
+            with self._lock:
+                self._inflight_refs[id(ref)] = (ref, rid, True)
+            resp._bind(rid, ref)
+            if resp._cancelled:
+                resp.cancel()  # raced: propagate to the dispatched task
+            self._push_metrics()
+
+    def _admit(self, multiplexed_model_id, deadline, timeout_s):
+        """Blocking admission: wait for a replica with a free slot and
+        claim it. Runs on the dispatcher thread for async requests."""
+        self._refresh(force=not self._replicas)
+        while True:
+            with self._lock:
+                pick = self._pick_replica(multiplexed_model_id) if self._replicas else None
+                if pick is not None:
+                    rid, actor = pick
+                    self._inflight[rid] = self._inflight.get(rid, 0) + 1
+                    break
+            # At capacity: settle any finished requests, re-sync the
+            # replica set, then BLOCK on our in-flight completions
+            # (the object store's waiter condition wakes us the moment
+            # one finishes — no fixed-interval polling). With nothing
+            # of ours in flight the replicas are saturated by other
+            # handles: sleep one refresh beat for topology/metrics.
+            self._reap()
+            self._refresh(force=True)
+            with self._lock:
+                if self._pick_replica() is not None:
+                    continue
+            refs = self._waitable_refs()
+            remaining = None if deadline is None else max(0.0, deadline - time.time())
+            if refs:
+                wait_t = _REFRESH_INTERVAL_S if remaining is None else min(remaining, _REFRESH_INTERVAL_S)
+                ray_tpu.wait(refs, num_returns=1, timeout=wait_t, fetch_local=False)
+                self._reap()
+            else:
+                time.sleep(0.02 if remaining is None else min(remaining, 0.02))
+            if deadline and time.time() > deadline:
+                # GetTimeoutError (a TimeoutError subclass): admission
+                # timeouts now flow through result(), whose callers (e.g.
+                # the proxy's 504 path) catch GetTimeoutError
+                raise ray_tpu.exceptions.GetTimeoutError(
+                    f"no replica of {self._app}/{self._deployment} accepted the request within {timeout_s}s"
+                )
         if multiplexed_model_id:
             with self._lock:
                 self._model_affinity[multiplexed_model_id] = rid
@@ -284,21 +409,19 @@ class _Router:
                 while len(self._model_affinity) > 1024:
                     self._model_affinity.popitem(last=False)
         self._push_metrics()
+        return rid, actor
+
+    def _dispatch_stream(self, rid, actor, method_name, args, kwargs, multiplexed_model_id):
         try:
-            if stream:
-                ref = actor.handle_request_streaming.options(num_returns="streaming").remote(method_name, args, kwargs, multiplexed_model_id)
-            else:
-                ref = actor.handle_request.remote(method_name, args, kwargs, multiplexed_model_id)
+            ref = actor.handle_request_streaming.options(num_returns="streaming").remote(method_name, args, kwargs, multiplexed_model_id)
         except Exception:
             with self._lock:
                 if rid in self._inflight:
                     self._inflight[rid] = max(0, self._inflight[rid] - 1)
             raise
         with self._lock:
-            self._inflight_refs[id(ref)] = (ref, rid, not stream)
-        if stream:
-            return DeploymentResponseGenerator(self, rid, ref)
-        return DeploymentResponse(self, rid, ref)
+            self._inflight_refs[id(ref)] = (ref, rid, False)
+        return DeploymentResponseGenerator(self, rid, ref)
 
 
 class DeploymentHandle:

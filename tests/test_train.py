@@ -464,11 +464,14 @@ def test_repeated_elasticity_chaos_cycles(tmp_path):
         # checkpoint integrity across EVERY transition: each step exactly
         # once, strictly ordered, none lost
         assert steps == list(range(TOTAL)), steps
-        # at least 3 shrink (2->1) and 2 regrow (1->2) transitions observed
+        # the chaos thread's counter is authoritative for cycle count;
+        # REPORTED sizes can miss a transition when a shrink lands before
+        # the regrown group commits any ws=2 step, so require >=2 of each
+        # observed in the metrics
         shrinks = sum(1 for a, b in zip(sizes, sizes[1:]) if a == 2 and b == 1)
         regrows = sum(1 for a, b in zip(sizes, sizes[1:]) if a == 1 and b == 2)
         assert cycles_done[0] >= 3, f"chaos thread completed {cycles_done[0]} cycles"
-        assert shrinks >= 3 and regrows >= 2, (sizes, shrinks, regrows)
+        assert shrinks >= 2 and regrows >= 2, (sizes, shrinks, regrows)
     finally:
         from ray_tpu.core import rpc_chaos
 
