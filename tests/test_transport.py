@@ -202,7 +202,9 @@ def test_agent_join_over_tcp(rt_start):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     try:
-        deadline = time.monotonic() + 30
+        # generous: the agent's python boot + forkserver warmup competes
+        # with the whole suite for the single core under full-suite load
+        deadline = time.monotonic() + 120
         joined = None
         while joined is None:
             assert time.monotonic() < deadline, f"agent never joined: {proc.stdout.read1(4096)}"
