@@ -379,11 +379,15 @@ def hint_node_hex(owner: str) -> str | None:
 # client side: one persistent connection to a peer
 # ---------------------------------------------------------------------------
 class _CallRec:
-    __slots__ = ("kind", "actor_hex", "task_id", "oids", "method", "func_id", "args", "kwargs", "num_returns", "retries_left", "trace", "done_counted", "pins", "raw", "cancelled")
+    __slots__ = ("kind", "actor_hex", "task_id", "oids", "method", "func_id", "args", "kwargs", "num_returns", "retries_left", "trace", "done_counted", "pins", "raw", "cancelled", "registered")
 
     def __init__(self, kind, actor_hex, task_id, oids, method, func_id, args, kwargs, num_returns, retries_left, trace, pins=None, raw=None):
         self.done_counted = False
         self.cancelled = False
+        # True once the rec is in a PeerConn's _calls: from then on,
+        # conn-death failover owns it. False on a ConnectionError means
+        # NOBODY will complete the oids unless the submitter fails over.
+        self.registered = False
         # live ObjectRefs pinning this call's arguments until completion
         # (the head pins spec args on its path; here the caller does)
         self.pins = pins
@@ -450,6 +454,7 @@ class PeerConn:
             if self.dead:
                 raise ConnectionError("direct peer is down")
             self._calls[cid] = rec
+            rec.registered = True
             self.inflight += 1
         self.last_used = time.monotonic()
         try:
@@ -514,8 +519,8 @@ class PeerConn:
                         slot[1] = msg
                         slot[0].set()
                 # unknown ops ignored (forward compat)
-        except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):
-            pass
+        except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):  # tpulint: disable=TPL007
+            pass  # death observed below: _on_death fails over every in-flight rec
         finally:
             self._on_death()
 
@@ -670,8 +675,8 @@ class DirectServer:
                     cd.add(msg["task"])
                 elif op == "ping":
                     reply({"op": "value", "cid": msg["cid"], "payload": None})
-        except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):
-            pass
+        except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):  # tpulint: disable=TPL007
+            pass  # server side: a vanished client owes us nothing (it fails over)
         finally:
             try:
                 conn.close()
@@ -1334,7 +1339,12 @@ def try_actor_call(client, actor_id, method_name: str, arg_specs, kw_specs, opti
     try:
         conn.send_call(rec, frame, data)
     except ConnectionError:
-        pass  # failover path completes the pending entries
+        # conn-death failover only covers recs that made it into _calls;
+        # a conn that died BEFORE registration would leave the oids
+        # PENDING forever (ray.get hangs) — fail over here instead
+        if not rec.registered:
+            threading.Thread(target=st._failover, args=(rec,), daemon=True).start()
+        # else: failover path completes the pending entries
     return _owned_refs(st, oids)
 
 
@@ -1397,7 +1407,12 @@ def try_task_call(client, name: str, func_id: str, blob, arg_specs, kw_specs, op
         lease.conn.ensure_func(func_id, st.func_blobs[func_id])
         lease.conn.send_call(rec, frame, data)
     except ConnectionError:
-        pass  # failover resubmits via the head
+        # ensure_func can raise before the rec is registered (and
+        # send_call before registration on an already-dead conn): those
+        # recs are invisible to conn-death failover — resubmit here
+        if not rec.registered:
+            threading.Thread(target=st._failover, args=(rec,), daemon=True).start()
+        # else: failover resubmits via the head
     return _owned_refs(st, oids)
 
 
@@ -1514,22 +1529,47 @@ def is_owned_or_hinted(k: bytes) -> bool:
     return get_hint(k) is not None
 
 
-def owned_ready(k: bytes) -> bool | None:
-    """True/False readiness for an owned/hinted id; None = not ours.
-    Remote-owned ids poll the owner (a borrowed ref to an in-flight
-    direct result must not report ready early)."""
+def _owned_ready_local(k: bytes) -> bool | None:
+    """Readiness from the LOCAL owned table only (dict lookup, no
+    network); None = this process can't answer locally (hinted-remote or
+    unknown id)."""
     st = _state
     if st is not None:
         e = st.owned.entry(k)
         if e is not None and e.state != REDIRECT:
             return e.state != PENDING
+    return None
+
+
+def owned_ready(k: bytes, poll_timeout: float | None = None) -> bool | None:
+    """True/False readiness for an owned/hinted id; None = not ours.
+    Remote-owned ids poll the owner (a borrowed ref to an in-flight
+    direct result must not report ready early).
+
+    ``poll_timeout`` set means the CALLER is deadline-bounded
+    (wait_mixed passes its remaining budget): a poll timeout reports
+    not-ready so a small-timeout ray.wait never blocks ~10s on one slow
+    owner. Unbounded callers (executor's entry_size probe) keep the
+    legacy behavior — a timed-out poll reports ready so the downstream
+    get() surfaces the owner's true state instead of stalling forever on
+    a blackholed host."""
+    st = _state
+    local = _owned_ready_local(k)
+    if local is not None:
+        return local
     owner = get_hint(k)
     if owner is not None:
         if st is None:
             return True
         try:
-            resp = st.get_conn(hint_addr(owner)).request("poll", timeout=10.0, id=k)
+            resp = st.get_conn(hint_addr(owner)).request(
+                "poll", timeout=10.0 if poll_timeout is None else poll_timeout, id=k
+            )
             return bool(resp.get("ready", True))
+        except GetTimeoutError:
+            if poll_timeout is not None:
+                return False  # slow owner: not-ready, never block past the deadline
+            return True  # unbounded caller: let get() surface the owner state
         except Exception:
             return True  # owner gone: get() surfaces the real error
     return None
@@ -1539,11 +1579,28 @@ def wait_mixed(client, obj_ids, num_returns: int, timeout: float | None, fallbac
     """ray.wait over a mix of owned and head-tracked ids. `fallback` is the
     client's head-path wait_ready."""
     ids = list(obj_ids)
-    split = [owned_ready(o.binary() if hasattr(o, "binary") else o) for o in ids]
+    deadline = None if timeout is None else time.monotonic() + timeout
+
+    def _poll_t() -> float | None:
+        # owner polls must respect the caller's remaining budget (a
+        # ray.wait(timeout=0.1) blocking 10s per slow owner violates
+        # wait semantics); floor keeps a near-expired wait from turning
+        # the poll into a busy no-op. An UNBOUNDED wait passes None so
+        # owned_ready keeps its legacy ready-on-poll-timeout escape — a
+        # blackholed owner must not spin this loop forever, and the
+        # follow-up get() surfaces the owner's true state.
+        if deadline is None:
+            return None
+        return max(0.05, min(10.0, deadline - time.monotonic()))
+
+    # classification is local (owned table + hint map, no network): the
+    # per-id readiness POLLS belong to the loop below, where they are
+    # deadline-bounded — polling here would let a slow owner eat the whole
+    # budget before the wait even starts
+    split = [is_owned_or_hinted(o.binary() if hasattr(o, "binary") else o) or None for o in ids]
     if all(s is None for s in split):
         return fallback(ids, num_returns, timeout)
     head_ids = [o for o, s in zip(ids, split) if s is None]
-    deadline = None if timeout is None else time.monotonic() + timeout
     known_ready: set = set()  # readiness is sticky: poll each id once
     delay = 0.002
     while True:
@@ -1552,7 +1609,18 @@ def wait_mixed(client, obj_ids, num_returns: int, timeout: float | None, fallbac
             if o in known_ready:
                 ready.append(o)
                 continue
-            s = owned_ready(o.binary() if hasattr(o, "binary") else o)
+            k = o.binary() if hasattr(o, "binary") else o
+            # the local owned-table check is a dict lookup and ALWAYS
+            # runs — even at timeout=0, ray.wait must see an
+            # already-completed local result; only the networked owner
+            # poll is gated on remaining budget (one slow owner must not
+            # make the round overshoot by a floor-poll per remaining id)
+            s = _owned_ready_local(k)
+            if s is None:
+                if deadline is not None and time.monotonic() >= deadline:
+                    not_ready.append(o)
+                    continue
+                s = owned_ready(k, poll_timeout=_poll_t())
             if s is True:
                 known_ready.add(o)
                 ready.append(o)

@@ -21,6 +21,7 @@ returns and dataset blocks between hosts.
 from __future__ import annotations
 
 import os
+import select
 import socket
 import struct
 import threading
@@ -368,6 +369,23 @@ def _recv_to_file(sock: socket.socket, fd: int, file_off: int, length: int) -> i
             while got < length:
                 try:
                     n = os.splice(sock.fileno(), pw, min(1 << 20, length - got))
+                except BlockingIOError:
+                    # the Python-level socket timeout puts the fd in
+                    # non-blocking mode, so a momentarily-empty receive
+                    # buffer surfaces as EAGAIN — routine mid-stream on
+                    # real networks, NOT a transport failure. The stream
+                    # offset is well-defined here (nothing left the
+                    # socket): wait for readability and resume. poll(),
+                    # not select(): a busy head can sit above FD_SETSIZE
+                    # and select() would raise ValueError there.
+                    waiter = select.poll()
+                    waiter.register(sock, select.POLLIN)
+                    t = sock.gettimeout()
+                    if not waiter.poll(None if t is None else max(0, int(t * 1000))):
+                        raise socket.timeout(
+                            "splice read stalled past the socket timeout"
+                        ) from None
+                    continue
                 except OSError:
                     if consumed_any:
                         raise ConnectionError("splice transfer failed mid-stream") from None
@@ -509,9 +527,12 @@ def _pull_parallel(addr, authkey: bytes, src_name: str, tmp: str, start: int, si
             fetch_range(ranges[0][0], ranges[0][1])
         finally:
             # join BEFORE the fd closes below: a failed range must not
-            # leave siblings writing into a recycled fd number
+            # leave siblings writing into a recycled fd number. The join
+            # is transitively bounded: every sibling socket op carries
+            # the pull timeout, so an unbounded join here cannot outlive
+            # the siblings' own deadlines.
             for t in threads:
-                t.join()
+                t.join()  # tpulint: disable=TPL006
     finally:
         os.close(fd)
     if errors:

@@ -116,12 +116,19 @@ class SplitCoordinator:
     SPMD training loops (reference: output_splitter equal splitting)."""
 
     EQUAL_CHUNK_ROWS = 256
+    # bound on one upstream block materializing (tpulint TPL001): the
+    # coordinator is an actor, and an unbounded get on a wedged producer
+    # would deadlock every consumer behind it with no error surfacing;
+    # generous enough for a slow lineage reconstruction, finite so a hang
+    # becomes a GetTimeoutError the consumers actually see
+    STREAM_GET_TIMEOUT_S = 600.0
 
     def __init__(self, dataset, n: int, equal: bool, locality_hints=None):
         self.n = n
         self.equal = equal
         self.queues = [collections.deque() for _ in range(n)]
         self._stream = dataset._ref_stream()
+        self._pending = None  # equal mode: pulled-but-not-gotten block ref
         self._exhausted = False
         self._next = 0
         self._carry = None  # equal mode: residual rows awaiting a full chunk
@@ -184,11 +191,17 @@ class SplitCoordinator:
             rows = self._carry.num_rows if self._carry is not None else 0
             if rows >= chunk * self.n:
                 break
-            try:
-                block = ray_tpu.get(next(self._stream))
-            except StopIteration:
-                self._exhausted = True
-                break
+            if self._pending is None:
+                try:
+                    self._pending = next(self._stream)
+                except StopIteration:
+                    self._exhausted = True
+                    break
+            # a timeout leaves the ref parked in _pending: the next call
+            # re-gets the SAME block, so a slow producer surfaces as an
+            # error without silently dropping its rows from the stream
+            block = ray_tpu.get(self._pending, timeout=self.STREAM_GET_TIMEOUT_S)
+            self._pending = None
             self._carry = block if self._carry is None else BlockAccessor.concat([self._carry, block])
         buf = self._carry
         if buf is None:

@@ -1,0 +1,27 @@
+"""tpulint: AST-based static analysis for the distributed runtime.
+
+The dynamic `lock_sanitizer` (core/lock_sanitizer.py) catches ordering
+inversions the test suite happens to EXECUTE; this package is its static
+complement — the TPU-native analogue of the TSAN + clang-tidy pair the
+reference leans on for its C++ raylet (SURVEY §5.2). A visitor core walks
+each module once per rule; rules encode the runtime's own invariants
+(blocking gets inside actors, dropped ObjectRefs, non-serializable remote
+captures, lock-order cycles, JAX purity under jit, unbounded polls inside
+deadline loops).
+
+Usage:
+
+    python -m ray_tpu.lint ray_tpu/              # check vs checked-in baseline
+    python -m ray_tpu.lint --list-rules
+    python -m ray_tpu.lint ray_tpu/ --update-baseline
+
+Accepted pre-existing findings live in ``ray_tpu/lint/baseline.json``;
+the CLI exits non-zero only on findings NOT in the baseline, so the
+tier-1 self-check (tests/test_lint.py) gates new hazards without a
+flag-day cleanup.
+"""
+
+from ray_tpu.lint.engine import Finding, Rule, lint_paths, lint_source  # noqa: F401
+from ray_tpu.lint.rules import all_rules  # noqa: F401
+
+DEFAULT_BASELINE = "baseline.json"  # sibling of this package's __init__

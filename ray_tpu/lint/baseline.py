@@ -1,0 +1,100 @@
+"""Baseline suppression: accepted pre-existing findings, keyed by
+line-independent fingerprint.
+
+Format (JSON, sorted, diff-friendly):
+
+    {
+      "version": 1,
+      "tool": "tpulint",
+      "entries": {
+        "<sha1[:16]>": {"rule": "TPL004", "path": "ray_tpu/core/x.py",
+                         "context": "Cls.meth", "message": "...", "count": 2}
+      }
+    }
+
+``count`` is how many identical (rule, path, context, message) findings
+are accepted: a new duplicate of an accepted finding still fails the
+check. Fingerprints exclude line numbers, so edits elsewhere in a file
+never churn the baseline; a stale entry (finding fixed — fully or just
+part of its accepted count) is reported so the baseline shrinks over
+time instead of fossilizing into silent headroom for reintroductions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from dataclasses import dataclass, field
+
+from ray_tpu.lint.engine import Finding
+
+
+@dataclass
+class BaselineDiff:
+    new: list[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    stale: list[dict] = field(default_factory=list)  # baseline entries no longer found
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+
+def load(path: str) -> dict[str, dict]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    return dict(doc.get("entries", {}))
+
+
+def entries_from_findings(findings: list[Finding]) -> dict[str, dict]:
+    counts: Counter[str] = Counter(f.fingerprint() for f in findings)
+    entries: dict[str, dict] = {}
+    for f in findings:
+        fp = f.fingerprint()
+        if fp not in entries:
+            entries[fp] = {
+                "rule": f.rule,
+                "path": f.path,
+                "context": f.context,
+                "message": f.message,
+                "count": counts[fp],
+            }
+    return entries
+
+
+def save_entries(path: str, entries: dict[str, dict]) -> int:
+    doc = {"version": 1, "tool": "tpulint", "entries": dict(sorted(entries.items()))}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    return len(entries)
+
+
+def save(path: str, findings: list[Finding]) -> int:
+    return save_entries(path, entries_from_findings(findings))
+
+
+def diff(findings: list[Finding], entries: dict[str, dict]) -> BaselineDiff:
+    out = BaselineDiff()
+    budget = {fp: int(e.get("count", 1)) for fp, e in entries.items()}
+    used: Counter[str] = Counter()
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            used[fp] += 1
+            out.suppressed += 1
+        else:
+            out.new.append(f)
+    # stale includes PARTIALLY-fixed entries: leaving an unused budget of
+    # n would let n future reintroductions of the same finding slide
+    # through the gate silently — force an --update-baseline instead
+    out.stale = [
+        dict(entries[fp], fingerprint=fp, unused=int(entries[fp].get("count", 1)) - used[fp])
+        for fp in sorted(entries)
+        if used[fp] < int(entries[fp].get("count", 1))
+    ]
+    return out

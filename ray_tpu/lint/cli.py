@@ -1,0 +1,127 @@
+"""``python -m ray_tpu.lint`` — check, update-baseline, list-rules.
+
+Exit codes: 0 clean (or baseline-covered), 1 new OR stale findings
+(stale = an accepted entry no longer fully reproduces; it must be
+re-accepted or its unused budget silently absorbs a reintroduction),
+2 usage error. Also reachable as ``python -m ray_tpu.scripts.cli lint``.
+
+Baseline entries are judged only when this run could have re-found them:
+an entry whose file is outside the linted paths, or whose rule was
+deselected, is neither consulted for suppression nor reported stale —
+so ``--select``/subset runs never produce phantom staleness, and
+``--update-baseline`` on a subset MERGES (entries outside the run's
+coverage are kept verbatim, never silently deleted).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ray_tpu.lint import baseline as baseline_mod
+from ray_tpu.lint.engine import lint_paths
+from ray_tpu.lint.rules import all_rules, rule_catalog
+
+
+def _coverage(paths: list[str], root: str, rule_ids: set[str]):
+    """entry -> bool: could this run have re-found the entry?"""
+    rel_roots = []
+    for p in paths:
+        rel = os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
+        rel_roots.append("" if rel == "." else rel)
+
+    def covered(entry: dict) -> bool:
+        if entry.get("rule") not in rule_ids:
+            return False
+        path = entry.get("path", "")
+        return any(r == "" or path == r or path.startswith(r + "/") for r in rel_roots)
+
+    return covered
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m ray_tpu.lint",
+        description="tpulint: AST-based distributed-runtime & JAX hazard analyzer",
+    )
+    p.add_argument("paths", nargs="*", default=["ray_tpu"], help="files/dirs to lint (default: ray_tpu)")
+    p.add_argument("--root", default=None, help="path fingerprints are stored relative to (default: cwd)")
+    p.add_argument("--baseline", default=None, help="baseline JSON (default: ray_tpu/lint/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true", help="report every finding; ignore the baseline")
+    p.add_argument("--update-baseline", action="store_true", help="accept current findings into the baseline and exit 0")
+    p.add_argument("--select", default=None, help="comma-separated rule ids/names to run (default: all)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--stats", action="store_true", help="print per-rule totals")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rid, name, summary in rule_catalog():
+            print(f"{rid}  {name:34s} {summary}")
+        return 0
+
+    select = {s.strip() for s in args.select.split(",") if s.strip()} if args.select else None
+    rules = all_rules(select)
+    if select and not rules:
+        print(f"no rules match --select {args.select}", file=sys.stderr)
+        return 2
+    root = os.path.abspath(args.root or os.getcwd())
+    try:
+        findings = lint_paths(args.paths, root=root, rules=rules)
+    except FileNotFoundError as e:
+        print(f"tpulint: {e}", file=sys.stderr)
+        return 2
+    covered = _coverage(args.paths, root, {r.id for r in rules})
+
+    bl_path = args.baseline or baseline_mod.default_baseline_path()
+    if args.update_baseline:
+        prior = baseline_mod.load(bl_path)
+        kept = {fp: e for fp, e in prior.items() if not covered(e)}
+        merged = {**kept, **baseline_mod.entries_from_findings(findings)}
+        n = baseline_mod.save_entries(bl_path, merged)
+        print(
+            f"tpulint: wrote {n} baseline entries ({len(findings)} findings, "
+            f"{len(kept)} kept from outside this run's coverage) to {bl_path}"
+        )
+        return 0
+
+    entries = {} if args.no_baseline else baseline_mod.load(bl_path)
+    entries = {fp: e for fp, e in entries.items() if covered(e)}
+    d = baseline_mod.diff(findings, entries)
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [f.__dict__ for f in d.new],
+            "suppressed": d.suppressed,
+            "stale": d.stale,
+        }, indent=1))
+    else:
+        for f in d.new:
+            print(f.render())
+        if args.stats:
+            per_rule: dict[str, int] = {}
+            for f in findings:
+                per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+            for rid in sorted(per_rule):
+                print(f"  {rid}: {per_rule[rid]} total")
+        for e in d.stale:
+            print(
+                f"tpulint: stale baseline entry {e['fingerprint']} "
+                f"({e['rule']} {e['path']} [{e.get('context', '')}], unused budget "
+                f"{e.get('unused', '?')}) — fixed? re-run with --update-baseline to drop it",
+                file=sys.stderr,
+            )
+        tail = f"{len(d.new)} new finding(s), {d.suppressed} baseline-suppressed, {len(d.stale)} stale"
+        print(f"tpulint: {tail}", file=sys.stderr)
+    # stale fails too: unused budget left in place would silently absorb
+    # the next reintroduction of the same finding
+    return 1 if (d.new or d.stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

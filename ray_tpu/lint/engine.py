@@ -1,0 +1,245 @@
+"""Visitor core: file walking, per-file rule dispatch, findings,
+fingerprints, inline suppression.
+
+Design notes:
+
+- One ``ast.parse`` per file; every rule gets the same tree via a
+  ``FileContext``. Rules are independent visitors (the codebase is
+  ~32 KLoC — clarity beats a fused single-pass dispatcher).
+- Fingerprints deliberately EXCLUDE line/col: a baseline must survive
+  unrelated edits above a finding. Identity is
+  (rule, path, enclosing scope, message); multiple identical findings in
+  one scope are disambiguated by count, not index, so reordering inside
+  a function never churns the baseline.
+- ``# tpulint: disable=TPL004`` (or ``=all``) on the flagged line
+  suppresses in-source, for hazards that are deliberate and locally
+  explainable; the baseline is for accepted pre-existing debt instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str  # "TPL001"
+    path: str  # root-relative posix path
+    line: int
+    col: int
+    message: str
+    context: str = ""  # enclosing def/class qualname ("" = module level)
+
+    def fingerprint(self) -> str:
+        key = f"{self.rule}|{self.path}|{self.context}|{self.message}"
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        where = f" [{self.context}]" if self.context else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}{where}"
+
+
+@dataclass
+class FileContext:
+    path: str  # root-relative posix path
+    tree: ast.Module
+    source: str
+    lines: list[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.lines:
+            self.lines = self.source.splitlines()
+
+
+class Rule:
+    """One checker. Subclasses set ``id``/``name``/``summary`` and yield
+    Findings from ``check``; ``finding()`` stamps the rule id and path."""
+
+    id = "TPL000"
+    name = "abstract"
+    summary = ""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node: ast.AST, message: str, context: str = "") -> Finding:
+        return Finding(
+            rule=self.id,
+            path=ctx.path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            context=context,
+        )
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (every rule needs these; keep them in one place)
+# ---------------------------------------------------------------------------
+def dotted(expr: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain; None for anything else."""
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def decorator_names(node: ast.FunctionDef | ast.AsyncFunctionDef | ast.ClassDef) -> list[str]:
+    """Dotted names of decorators, with call wrappers unwrapped:
+    ``@ray.remote(num_cpus=1)`` -> 'ray.remote'. For ``partial(...)``
+    decorators the partial'd callable's name is appended too, so
+    ``@partial(jax.jit, static_argnums=0)`` yields both
+    'functools.partial' and 'jax.jit'."""
+    out: list[str] = []
+    for dec in node.decorator_list:
+        target = dec
+        if isinstance(target, ast.Call):
+            inner = dotted(target.func)
+            if inner is not None:
+                out.append(inner)
+                if inner.split(".")[-1] == "partial" and target.args:
+                    arg0 = dotted(target.args[0])
+                    if arg0 is not None:
+                        out.append(arg0)
+            continue
+        name = dotted(target)
+        if name is not None:
+            out.append(name)
+    return out
+
+
+def has_decorator(node, suffixes: tuple[str, ...]) -> bool:
+    """True when any decorator's dotted name ends with one of ``suffixes``
+    (last segment match: 'remote' hits ray.remote / ray_tpu.remote /
+    bare remote)."""
+    return any(d.split(".")[-1] in suffixes for d in decorator_names(node))
+
+
+def call_keyword(call: ast.Call, *names: str) -> ast.keyword | None:
+    for kw in call.keywords:
+        if kw.arg in names:
+            return kw
+    return None
+
+
+class ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor that maintains a qualname scope stack. Subclasses call
+    ``self.qualname`` for finding context and may override
+    ``enter_scope``/``leave_scope`` hooks."""
+
+    def __init__(self):
+        self._scope: list[str] = []
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self._scope)
+
+    def _scoped(self, node):
+        self._scope.append(node.name)
+        try:
+            self.enter_scope(node)
+            self.generic_visit(node)
+        finally:
+            self.leave_scope(node)
+            self._scope.pop()
+
+    def enter_scope(self, node):  # hook
+        pass
+
+    def leave_scope(self, node):  # hook
+        pass
+
+    visit_FunctionDef = _scoped
+    visit_AsyncFunctionDef = _scoped
+    visit_ClassDef = _scoped
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+def _suppressed(ctx: FileContext, f: Finding) -> bool:
+    if not (1 <= f.line <= len(ctx.lines)):
+        return False
+    m = _SUPPRESS_RE.search(ctx.lines[f.line - 1])
+    if m is None:
+        return False
+    spec = m.group(1)
+    if spec.strip() == "all":
+        return True
+    return f.rule in {s.strip() for s in spec.split(",")}
+
+
+def lint_source(source: str, path: str = "<string>", rules: Iterable[Rule] | None = None) -> list[Finding]:
+    """Lint one source string (the unit-test entry point)."""
+    from ray_tpu.lint.rules import all_rules
+
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("TPLERR", path, e.lineno or 0, e.offset or 0, f"syntax error: {e.msg}")]
+    ctx = FileContext(path=path, tree=tree, source=source)
+    out: list[Finding] = []
+    for rule in rules if rules is not None else all_rules():
+        for f in rule.check(ctx):
+            if not _suppressed(ctx, f):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    # overlapping path args (a tree and a file inside it) must not lint a
+    # file twice: duplicate findings would overflow the baseline's
+    # count-based suppression and fail a clean tree
+    seen: set[str] = set()
+
+    def once(fp: str) -> bool:
+        ap = os.path.abspath(fp)
+        if ap in seen:
+            return False
+        seen.add(ap)
+        return True
+
+    for p in paths:
+        if os.path.isfile(p):
+            if once(p):
+                yield p
+        elif os.path.isdir(p):
+            for base, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in ("__pycache__", ".git"))
+                for fn in sorted(files):
+                    if fn.endswith(".py") and once(os.path.join(base, fn)):
+                        yield os.path.join(base, fn)
+        else:
+            # a typo'd path (or wrong cwd for the relative default) must
+            # not turn into a silently-green zero-file "clean" run
+            raise FileNotFoundError(f"lint path does not exist: {p}")
+
+
+def lint_paths(paths: Iterable[str], root: str | None = None, rules: Iterable[Rule] | None = None) -> list[Finding]:
+    """Lint files/trees. Finding paths are stored relative to ``root``
+    (default cwd) in posix form so fingerprints are machine-independent."""
+    root = os.path.abspath(root or os.getcwd())
+    rules = list(rules) if rules is not None else None
+    out: list[Finding] = []
+    for fp in iter_py_files(paths):
+        try:
+            with open(fp, encoding="utf-8", errors="replace") as fh:
+                src = fh.read()
+        except OSError:
+            continue
+        rel = os.path.relpath(os.path.abspath(fp), root).replace(os.sep, "/")
+        out.extend(lint_source(src, path=rel, rules=rules))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out

@@ -1,0 +1,33 @@
+"""Rule registry: one module per rule, one instance per run."""
+
+from __future__ import annotations
+
+from ray_tpu.lint.engine import Rule
+from ray_tpu.lint.rules.blocking_get import BlockingGetInActor
+from ray_tpu.lint.rules.dropped_ref import DroppedObjectRef
+from ray_tpu.lint.rules.jax_purity import JaxImpureJit
+from ray_tpu.lint.rules.lock_order import LockOrderCycle
+from ray_tpu.lint.rules.remote_capture import RemoteCapturesUnserializable
+from ray_tpu.lint.rules.swallowed_conn_error import SwallowedConnError
+from ray_tpu.lint.rules.unbounded_poll import UnboundedPollInDeadlineLoop
+
+_RULES = (
+    BlockingGetInActor,
+    DroppedObjectRef,
+    RemoteCapturesUnserializable,
+    LockOrderCycle,
+    JaxImpureJit,
+    UnboundedPollInDeadlineLoop,
+    SwallowedConnError,
+)
+
+
+def all_rules(select: set[str] | None = None) -> list[Rule]:
+    rules = [cls() for cls in _RULES]
+    if select:
+        rules = [r for r in rules if r.id in select or r.name in select]
+    return rules
+
+
+def rule_catalog() -> list[tuple[str, str, str]]:
+    return [(cls.id, cls.name, cls.summary) for cls in _RULES]

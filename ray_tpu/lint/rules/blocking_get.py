@@ -1,0 +1,78 @@
+"""TPL001: blocking ``ray.get()``/``ray.wait()`` inside an actor method or
+an async coroutine.
+
+An actor method blocking on ``get`` of another task running on the SAME
+actor (or on a cycle of actors) deadlocks with no timeout to save it; in
+an ``async def`` the call parks the whole event loop, starving every
+other coroutine sharing it (the serve proxy, async actor method queues).
+The head path can't see either: the caller looks merely "busy".
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.lint.engine import FileContext, Finding, Rule, ScopedVisitor, call_keyword, dotted, has_decorator
+
+_BLOCKING = {"get", "wait"}
+_MODULES = {"ray", "ray_tpu", "rt"}
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, rule: "BlockingGetInActor", ctx: FileContext):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        self.out: list[Finding] = []
+        self._actor_depth = 0  # inside a @remote class body
+        self._fn_kind: list[str] = []  # "sync" | "async" per enclosing function
+
+    def enter_scope(self, node):
+        if isinstance(node, ast.ClassDef):
+            self._actor_depth += has_decorator(node, ("remote",))
+        else:
+            self._fn_kind.append("async" if isinstance(node, ast.AsyncFunctionDef) else "sync")
+
+    def leave_scope(self, node):
+        if isinstance(node, ast.ClassDef):
+            self._actor_depth -= has_decorator(node, ("remote",))
+        else:
+            self._fn_kind.pop()
+
+    def visit_Call(self, node: ast.Call):
+        name = dotted(node.func)
+        if name is not None:
+            parts = name.split(".")
+            if len(parts) == 2 and parts[0] in _MODULES and parts[1] in _BLOCKING:
+                in_async = bool(self._fn_kind) and self._fn_kind[-1] == "async"
+                in_actor_method = self._actor_depth > 0 and bool(self._fn_kind)
+                bounded = call_keyword(node, "timeout") is not None
+                if bounded and not in_async:
+                    pass  # a deadlined get inside an actor surfaces instead of deadlocking
+                elif in_async:
+                    self.out.append(self.rule.finding(
+                        self.ctx, node,
+                        f"blocking {name}() inside an async coroutine parks the event loop; "
+                        "await an async variant or hand off to a thread",
+                        context=self.qualname,
+                    ))
+                elif in_actor_method:
+                    self.out.append(self.rule.finding(
+                        self.ctx, node,
+                        f"blocking {name}() inside an actor method risks actor deadlock "
+                        "(self-call or actor-cycle waits forever); restructure or pass a timeout",
+                        context=self.qualname,
+                    ))
+        self.generic_visit(node)
+
+
+class BlockingGetInActor(Rule):
+    id = "TPL001"
+    name = "blocking-get-in-actor"
+    summary = "ray.get()/ray.wait() called from an actor method or async coroutine (deadlock / event-loop stall)"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        v = _Visitor(self, ctx)
+        v.visit(ctx.tree)
+        yield from v.out

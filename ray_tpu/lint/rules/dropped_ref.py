@@ -1,0 +1,55 @@
+"""TPL002: fire-and-forget ``.remote()`` whose ObjectRef is dropped.
+
+A ``f.remote()`` expression statement discards the only handle to the
+task's result: if the task raises, the error completes an ObjectRef
+nobody will ever ``get``, so the failure is silent (and under
+ref-counting the return may be freed before the task even finishes).
+Bind the ref — even to ``_last =`` for ordering-only calls — or get it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.lint.engine import FileContext, Finding, Rule, ScopedVisitor
+
+
+def _is_remote_call(expr: ast.AST) -> bool:
+    """Matches ``x.remote(...)`` and ``x.options(...).remote(...)``."""
+    return (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Attribute)
+        and expr.func.attr == "remote"
+    )
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, rule: "DroppedObjectRef", ctx: FileContext):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        self.out: list[Finding] = []
+
+    def visit_Expr(self, node: ast.Expr):
+        # `await f.remote()` wraps the call in Await: the result was
+        # consumed by the coroutine machinery, not dropped — skip.
+        if _is_remote_call(node.value):
+            self.out.append(self.rule.finding(
+                self.ctx, node,
+                "ObjectRef from .remote() is dropped; task errors vanish silently "
+                "(bind the ref or ray.get it)",
+                context=self.qualname,
+            ))
+        self.generic_visit(node)
+
+
+class DroppedObjectRef(Rule):
+    id = "TPL002"
+    name = "dropped-object-ref"
+    summary = "ObjectRef returned by .remote() is discarded, losing the task's error channel"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        v = _Visitor(self, ctx)
+        v.visit(ctx.tree)
+        yield from v.out

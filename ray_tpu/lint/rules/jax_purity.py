@@ -1,0 +1,149 @@
+"""TPL005: Python side effects and host calls inside ``jit``/``pjit``
+bodies, plus tracer leaks via ``global``/``nonlocal``.
+
+A jitted function's Python body runs ONCE, at trace time; ``print``,
+``time.time()``, host I/O, or stdlib/numpy RNG execute during tracing
+and then never again — the compiled executable replays only the traced
+ops, so the "side effect" silently disappears on the steps that matter
+(and a wallclock read bakes a constant into the program). Writing a
+traced value to a ``global``/``nonlocal`` leaks a tracer out of the
+trace, which blows up later with the infamous leaked-tracer error.
+MPMD-pipeline and Podracer-style designs (PAPERS.md) assume jit bodies
+are pure; this rule keeps ours that way. Use ``jax.debug.print`` /
+``jax.debug.callback`` and ``jax.random`` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.lint.engine import FileContext, Finding, Rule, decorator_names, dotted
+
+_JIT_SUFFIXES = ("jit", "pjit")
+
+# dotted names whose CALL inside a jit body is a trace-time side effect
+_IMPURE_EXACT = {
+    "print", "input", "breakpoint", "open",
+    "time.time", "time.monotonic", "time.perf_counter", "time.sleep", "time.time_ns",
+    "os.system", "os.popen", "os.read", "os.write", "os.remove", "os.unlink",
+}
+_IMPURE_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+
+def _is_jitted(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    return any(d.split(".")[-1] in _JIT_SUFFIXES for d in decorator_names(fn))
+
+
+def _call_form_jitted_names(tree: ast.Module) -> set[str]:
+    """Function names wrapped by the CALL form: ``jax.jit(f)``,
+    ``jit(partial(f, ...))`` — the dominant idiom in this codebase
+    (model_runner builds prefill_fn/decode_fn this way)."""
+    out: set[str] = set()
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        fname = dotted(n.func)
+        if fname is None or fname.split(".")[-1] not in _JIT_SUFFIXES or not n.args:
+            continue
+        target = n.args[0]
+        if isinstance(target, ast.Call) and (dotted(target.func) or "").split(".")[-1] == "partial" and target.args:
+            target = target.args[0]
+        tname = dotted(target)
+        if tname is not None:
+            out.add(tname.split(".")[-1])
+    return out
+
+
+def _impure_name(call: ast.Call) -> str | None:
+    name = dotted(call.func)
+    if name is None:
+        return None
+    if name in _IMPURE_EXACT or name.startswith(_IMPURE_PREFIXES):
+        return name
+    return None
+
+
+class _BodyVisitor(ast.NodeVisitor):
+    """Walk one jitted function body. Nested NON-jitted defs are included
+    (they trace too when called); nested defs that _Finder will match on
+    its own (jitted, or wrapped via the call form) are skipped so their
+    findings report exactly once, under their own context."""
+
+    def __init__(self, rule: "JaxImpureJit", ctx: FileContext, qual: str, call_form: set[str]):
+        self.rule = rule
+        self.ctx = ctx
+        self.qual = qual
+        self.call_form = call_form
+        self.out: list[Finding] = []
+
+    def _nested_def(self, node):
+        if not (_is_jitted(node) or node.name in self.call_form):
+            self.generic_visit(node)
+
+    visit_FunctionDef = _nested_def
+    visit_AsyncFunctionDef = _nested_def
+
+    def visit_Call(self, node: ast.Call):
+        name = _impure_name(node)
+        if name is not None:
+            fix = "jax.random with an explicit key" if "random" in name else "jax.debug.print/callback (or hoist out of jit)"
+            self.out.append(self.rule.finding(
+                self.ctx, node,
+                f"{name}() inside a jit-compiled function runs only at trace time "
+                f"(effect vanishes / value becomes a baked constant); use {fix}",
+                context=self.qual,
+            ))
+        self.generic_visit(node)
+
+    def visit_Global(self, node: ast.Global):
+        self.out.append(self.rule.finding(
+            self.ctx, node,
+            f"`global {', '.join(node.names)}` inside a jit-compiled function can leak a "
+            "tracer out of the trace; return the value instead",
+            context=self.qual,
+        ))
+
+    def visit_Nonlocal(self, node: ast.Nonlocal):
+        self.out.append(self.rule.finding(
+            self.ctx, node,
+            f"`nonlocal {', '.join(node.names)}` inside a jit-compiled function can leak a "
+            "tracer out of the trace; return the value instead",
+            context=self.qual,
+        ))
+
+
+class _Finder(ast.NodeVisitor):
+    def __init__(self, rule, ctx, call_form: set[str]):
+        self.rule = rule
+        self.ctx = ctx
+        self.call_form = call_form
+        self.out: list[Finding] = []
+        self._qual: list[str] = []
+
+    def _scoped(self, node):
+        self._qual.append(node.name)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            _is_jitted(node) or node.name in self.call_form
+        ):
+            bv = _BodyVisitor(self.rule, self.ctx, ".".join(self._qual), self.call_form)
+            for stmt in node.body:
+                bv.visit(stmt)
+            self.out.extend(bv.out)
+        self.generic_visit(node)
+        self._qual.pop()
+
+    visit_FunctionDef = _scoped
+    visit_AsyncFunctionDef = _scoped
+    visit_ClassDef = _scoped
+
+
+class JaxImpureJit(Rule):
+    id = "TPL005"
+    name = "jax-impure-jit"
+    summary = "side effect / host call / global write inside a jit-compiled function (trace-time-only execution)"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        f = _Finder(self, ctx, _call_form_jitted_names(ctx.tree))
+        f.visit(ctx.tree)
+        yield from f.out

@@ -1,0 +1,139 @@
+"""TPL004: static lock-ordering cycle detection over ``with`` nesting.
+
+The runtime lock_sanitizer builds this same ordering graph DYNAMICALLY —
+but only over orderings the test run happens to execute. This rule builds
+it lexically, per module: every ``with <lock>:`` whose body contains
+another ``with <lock>:`` contributes an edge outer->inner (including
+multi-item ``with a, b:``), and a cycle in the module graph is a
+potential ABBA deadlock even if no test has interleaved the two paths
+yet.
+
+Lock expressions are Name/Attribute chains (never calls) whose final
+segment looks lock-ish (lock/mutex/cond/cv/sem suffix). ``self.X`` inside
+class C keys as ``C.X`` so methods of one class share nodes; other
+prefixes keep their dotted spelling (``route.lock`` stays distinct from
+``self._lock``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ray_tpu.lint.engine import FileContext, Finding, Rule, dotted
+
+_LOCKISH = re.compile(r"(?:^|_)(lock|mutex|mu|cond|cv|sem)$", re.IGNORECASE)
+
+
+def _lock_key(expr: ast.AST, cls: str | None) -> str | None:
+    name = dotted(expr)
+    if name is None:
+        return None
+    if not _LOCKISH.search(name.split(".")[-1]):
+        return None
+    if cls and name.startswith("self."):
+        return f"{cls}.{name[len('self.'):]}"
+    return name
+
+
+class _Visitor(ast.NodeVisitor):
+    """Collect outer->inner edges with the location of the inner acquire."""
+
+    def __init__(self):
+        self.edges: dict[tuple[str, str], ast.AST] = {}
+        self._held: list[str] = []
+        self._cls: list[str] = []
+        self._fn: list[str] = []
+
+    def visit_ClassDef(self, node):
+        self._cls.append(node.name)
+        self.generic_visit(node)
+        self._cls.pop()
+
+    def _visit_fn(self, node):
+        # a new function body starts with nothing lexically held: `with`
+        # nesting does not cross call boundaries (that's the dynamic
+        # sanitizer's job)
+        held, self._held = self._held, []
+        self._fn.append(node.name)
+        self.generic_visit(node)
+        self._fn.pop()
+        self._held = held
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def _visit_with(self, node):
+        cls = self._cls[-1] if self._cls else None
+        keys = []
+        for item in node.items:
+            k = _lock_key(item.context_expr, cls)
+            if k is not None:
+                keys.append(k)
+                for outer in self._held + keys[:-1]:
+                    if outer != k:
+                        self.edges.setdefault((outer, k), item.context_expr)
+        self._held.extend(keys)
+        for stmt in node.body:
+            self.visit(stmt)
+        if keys:
+            del self._held[-len(keys):]
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    @property
+    def scope(self) -> str:
+        return ".".join(self._cls + self._fn)
+
+
+def _cycles(edges: dict[tuple[str, str], ast.AST]) -> list[list[str]]:
+    graph: dict[str, set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    out: list[list[str]] = []
+    seen_cycles: set[tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: list[str], visited: set[str]):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                cyc = path[:]
+                # canonicalize rotation so each cycle reports once
+                i = cyc.index(min(cyc))
+                canon = tuple(cyc[i:] + cyc[:i])
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    out.append(list(canon))
+            elif nxt not in visited and len(path) < 8:
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return out
+
+
+class LockOrderCycle(Rule):
+    id = "TPL004"
+    name = "lock-order-cycle"
+    summary = "lexical `with` nesting acquires module locks in inconsistent order (potential ABBA deadlock)"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        v = _Visitor()
+        v.visit(ctx.tree)
+        for cyc in _cycles(v.edges):
+            # anchor the report at the acquire site of the first inverted
+            # edge; every consecutive cycle pair is an edge key by
+            # construction, so index directly — drift should fail loudly,
+            # not anchor the finding (and its suppression point) elsewhere
+            a, b = cyc[0], cyc[1 % len(cyc)]
+            node = v.edges[(a, b)]
+            order = " -> ".join(cyc + [cyc[0]])
+            yield self.finding(
+                ctx, node,
+                f"lock ordering cycle {order}: two paths acquire these locks in "
+                "opposite order; pick one global order (see core/lock_sanitizer.py)",
+                context="",
+            )

@@ -1,0 +1,152 @@
+"""TPL003: ``@remote`` function/class capturing non-serializable state.
+
+Remote bodies are shipped as cloudpickle blobs (core direct plane:
+``func_blobs``; head path: task specs). A nested ``@remote`` def whose
+closure captures a lock, socket, file handle, subprocess, or live JAX
+tracer pickles BY VALUE — the export either fails at submission time or,
+worse, resurrects a dead handle on the worker. Same for hazard objects
+baked into default arguments (evaluated once, at definition time, on the
+driver). Pass such state in as an argument or construct it inside the
+task.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.lint.engine import FileContext, Finding, Rule, dotted, has_decorator
+
+# dotted-suffix patterns of constructors whose instances do not pickle
+_HAZARD_SUFFIXES = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore", "Event", "Barrier",
+    "allocate_lock", "socket", "create_connection", "socketpair",
+    "open", "popen", "Popen", "mmap", "connect", "TemporaryFile", "NamedTemporaryFile",
+}
+# jax trace-time objects leaking into a remote body
+_HAZARD_EXACT = {"jax.core.new_main", "jax.make_jaxpr"}
+
+
+def _hazard_call(expr: ast.AST) -> str | None:
+    """Dotted name when ``expr`` constructs a known non-serializable."""
+    if not isinstance(expr, ast.Call):
+        return None
+    name = dotted(expr.func)
+    if name is None:
+        return None
+    if name in _HAZARD_EXACT or name.split(".")[-1] in _HAZARD_SUFFIXES:
+        return name
+    return None
+
+
+def _local_bindings(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> dict[str, str]:
+    """name -> hazard ctor dotted name, for simple assignments in ``fn``'s
+    own body (nested defs excluded: their locals aren't this closure)."""
+    out: dict[str, str] = {}
+    for stmt in _walk_own(fn):
+        if isinstance(stmt, ast.Assign):
+            hz = _hazard_call(stmt.value)
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    if hz:
+                        out[t.id] = hz
+                    else:
+                        out.pop(t.id, None)  # rebound to something benign
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                hz = _hazard_call(item.context_expr)
+                if hz and isinstance(item.optional_vars, ast.Name):
+                    out[item.optional_vars.id] = hz
+    return out
+
+
+def _walk_own(fn) -> Iterator[ast.stmt]:
+    """Statements of ``fn`` excluding nested function/class bodies."""
+    stack: list[ast.stmt] = list(fn.body)
+    while stack:
+        stmt = stack.pop()
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        for fname in ("body", "orelse", "finalbody"):
+            stack.extend(getattr(stmt, fname, None) or [])
+        for handler in getattr(stmt, "handlers", None) or []:
+            stack.extend(handler.body)
+
+
+def _assigned_names(node: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store, ast.Del)):
+            out.add(n.id)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            out.add(n.name)
+        elif isinstance(n, ast.arg):
+            out.add(n.arg)
+    return out
+
+
+class RemoteCapturesUnserializable(Rule):
+    id = "TPL003"
+    name = "remote-captures-unserializable"
+    summary = "@remote body closure-captures (or defaults to) a lock/socket/file/tracer that cannot pickle"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # enclosing-function hazard bindings, maintained along a DFS
+        yield from self._scan(ctx, ctx.tree, enclosing={}, qual=[])
+
+    def _scan(self, ctx, node, enclosing: dict[str, str], qual: list[str]) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual.append(child.name)
+                if has_decorator(child, ("remote",)):
+                    yield from self._check_remote_def(ctx, child, enclosing, ".".join(qual))
+                merged = dict(enclosing)
+                merged.update(_local_bindings(child))
+                yield from self._scan(ctx, child, merged, qual)
+                qual.pop()
+            elif isinstance(child, ast.ClassDef):
+                qual.append(child.name)
+                if has_decorator(child, ("remote",)):
+                    for meth in child.body:
+                        if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            yield from self._check_remote_def(
+                                ctx, meth, enclosing, ".".join(qual + [meth.name]), actor=True
+                            )
+                yield from self._scan(ctx, child, enclosing, qual)
+                qual.pop()
+            else:
+                yield from self._scan(ctx, child, enclosing, qual)
+
+    def _check_remote_def(self, ctx, fn, enclosing: dict[str, str], qual: str, actor: bool = False) -> Iterator[Finding]:
+        # default arguments evaluated on the driver at def time
+        args = fn.args
+        for default in list(args.defaults) + [d for d in args.kw_defaults if d is not None]:
+            hz = _hazard_call(default)
+            if hz:
+                yield self.finding(
+                    ctx, default,
+                    f"@remote default argument constructs {hz}() on the driver; "
+                    "it cannot pickle to the worker — create it inside the task",
+                    context=qual,
+                )
+        if not enclosing:
+            return
+        local = _assigned_names(fn)
+        reported: set[str] = set()
+        for n in ast.walk(fn):
+            if (
+                isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+                and n.id in enclosing
+                and n.id not in local
+                and n.id not in reported
+            ):
+                reported.add(n.id)
+                kind = "actor method" if actor else "remote function"
+                yield self.finding(
+                    ctx, n,
+                    f"{kind} closure-captures '{n.id}' bound to {enclosing[n.id]}() in an "
+                    "enclosing scope; cloudpickle ships it by value and it cannot pickle",
+                    context=qual,
+                )

@@ -1,0 +1,70 @@
+"""TPL007: ``except ConnectionError: pass`` — a dead peer vanishes
+silently.
+
+In a distributed runtime a ConnectionError is a STATE TRANSITION (peer
+died, failover owed), not noise: a handler whose entire body is ``pass``
+drops that transition on the floor. The round-5 ADVICE bug was exactly
+this shape — ``send_call`` raising before its ``_CallRec`` registered,
+the swallow leaving return oids PENDING forever so ``ray.get()`` hung.
+A bare swallow is only safe when some OTHER mechanism provably observes
+the death (say so in a comment and suppress, or better: handle it).
+Plain ``except OSError`` cleanup swallows (close/unlink paths) are not
+flagged — only the ConnectionError family carries failover obligations.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.lint.engine import FileContext, Finding, Rule, ScopedVisitor, dotted
+
+_CONN_ERRORS = {
+    "ConnectionError", "ConnectionResetError", "ConnectionAbortedError",
+    "ConnectionRefusedError", "BrokenPipeError",
+}
+
+
+def _names(type_expr: ast.AST | None) -> list[str]:
+    if type_expr is None:
+        return []
+    exprs = list(type_expr.elts) if isinstance(type_expr, ast.Tuple) else [type_expr]
+    out = []
+    for e in exprs:
+        name = dotted(e)
+        if name is not None:
+            out.append(name.split(".")[-1])
+    return out
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        self.out: list[Finding] = []
+
+    def visit_Try(self, node: ast.Try):
+        for handler in node.handlers:
+            caught = set(_names(handler.type))
+            conn = sorted(caught & _CONN_ERRORS)
+            if conn and len(handler.body) == 1 and isinstance(handler.body[0], ast.Pass):
+                self.out.append(self.rule.finding(
+                    self.ctx, handler,
+                    f"swallowed {'/'.join(conn)} with a bare pass: the peer-death event is "
+                    "lost (pending work never fails over); complete/fail the in-flight "
+                    "state or record why another path observes it",
+                    context=self.qualname,
+                ))
+        self.generic_visit(node)
+
+
+class SwallowedConnError(Rule):
+    id = "TPL007"
+    name = "swallowed-connection-error"
+    summary = "except ConnectionError: pass — peer-death transition silently dropped, failover lost"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        v = _Visitor(self, ctx)
+        v.visit(ctx.tree)
+        yield from v.out

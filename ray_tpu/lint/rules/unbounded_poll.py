@@ -1,0 +1,169 @@
+"""TPL006: unbounded blocking call inside a loop that owns a caller
+timeout.
+
+The wait/get/pull paths all share one shape: the caller hands in a
+``timeout``/``deadline``, the function spins until it expires. A
+``recv``/``request``/``wait`` inside that loop with NO bound of its own
+can sit far past the caller's deadline on a slow peer (the round-5
+``wait_mixed`` bug: a 0.1s ``ray.wait`` blocking ~10s per id inside
+``owned_ready``). Every blocking call inside a deadline loop must carry
+its own timeout — ideally derived from the remaining deadline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.lint.engine import FileContext, Finding, Rule, call_keyword, dotted
+
+_DEADLINE_PARAMS = {"timeout", "deadline", "timeout_s", "deadline_s", "timeout_ms"}
+# attribute calls that block until data/events arrive; `timeout=` (or a
+# positional beyond the data args) is their only bound
+_BLOCKING_ATTRS = {"recv", "recv_into", "recvfrom", "request", "accept", "join"}
+_SLEEP_FLOOR_S = 1.0  # fixed sleeps >= this inside a deadline loop defeat its granularity
+
+
+def _own_nodes(fn: ast.AST):
+    """ast.walk restricted to ``fn``'s own scope: everything inside a
+    nested def/class is excluded — a helper's local ``timeout`` must not
+    make the OUTER function 'own' a deadline (and a helper's settimeout
+    must not vouch for the outer body's socket ops)."""
+    skip: set[int] = set()
+    for d in ast.walk(fn):
+        if d is not fn and isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            for x in ast.walk(d):
+                if x is not d:
+                    skip.add(id(x))
+    for n in ast.walk(fn):
+        if id(n) not in skip:
+            yield n
+
+
+def _owns_deadline(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    args = fn.args
+    names = {a.arg for a in args.args + args.kwonlyargs + args.posonlyargs}
+    if names & _DEADLINE_PARAMS:
+        return True
+    for n in _own_nodes(fn):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store) and n.id in _DEADLINE_PARAMS:
+            return True
+    return False
+
+
+def _settimeout_present(fn: ast.AST) -> bool:
+    for n in _own_nodes(fn):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) and n.func.attr in ("settimeout", "setblocking"):
+            return True
+    return False
+
+
+class _LoopVisitor(ast.NodeVisitor):
+    def __init__(self, rule, ctx, fn, qual: str):
+        self.rule = rule
+        self.ctx = ctx
+        self.qual = qual
+        self.out: list[Finding] = []
+        self._loop_depth = 0
+        self._sock_bounded = _settimeout_present(fn)
+
+    def _loop(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = _loop
+    visit_AsyncFor = _loop
+    visit_While = _loop
+
+    def _nested_fn(self, node):
+        pass  # nested defs own their own deadlines (or lack thereof)
+
+    visit_FunctionDef = _nested_fn
+    visit_AsyncFunctionDef = _nested_fn
+    visit_ClassDef = _nested_fn
+
+    def visit_Call(self, node: ast.Call):
+        if self._loop_depth > 0:
+            self._check(node)
+        self.generic_visit(node)
+
+    def _check(self, node: ast.Call):
+        name = dotted(node.func)
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in _BLOCKING_ATTRS:
+                if call_keyword(node, "timeout", "deadline") is not None:
+                    return
+                if attr in ("recv", "recv_into", "recvfrom", "accept") and self._sock_bounded:
+                    return  # settimeout in this function bounds the socket ops
+                if attr == "join" and (node.args or node.keywords):
+                    return  # thread.join(t) is bounded
+                self.out.append(self.rule.finding(
+                    self.ctx, node,
+                    f".{attr}() inside this deadline loop has no timeout of its own; "
+                    "a slow peer blocks past the caller's deadline — bound it by the "
+                    "remaining deadline",
+                    context=self.qual,
+                ))
+                return
+            if attr == "get" and not node.args and not node.keywords:
+                # queue-style zero-arg .get() blocks forever; dict-style
+                # .get(k, d) carries args and is not a blocking call
+                self.out.append(self.rule.finding(
+                    self.ctx, node,
+                    ".get() with no timeout inside this deadline loop blocks until an item "
+                    "arrives; use .get(timeout=...) bounded by the remaining deadline",
+                    context=self.qual,
+                ))
+                return
+            if attr == "wait" and not node.args and call_keyword(node, "timeout") is None:
+                self.out.append(self.rule.finding(
+                    self.ctx, node,
+                    ".wait() with no timeout inside this deadline loop can block forever "
+                    "if the event is never set; pass the remaining deadline",
+                    context=self.qual,
+                ))
+                return
+        if name in ("time.sleep", "sleep") and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float)) and arg.value >= _SLEEP_FLOOR_S:
+                self.out.append(self.rule.finding(
+                    self.ctx, node,
+                    f"fixed {arg.value:g}s sleep inside a deadline loop overshoots small "
+                    "caller timeouts; sleep min(step, remaining deadline)",
+                    context=self.qual,
+                ))
+
+
+class _Finder(ast.NodeVisitor):
+    def __init__(self, rule, ctx):
+        self.rule = rule
+        self.ctx = ctx
+        self.out: list[Finding] = []
+        self._qual: list[str] = []
+
+    def _scoped(self, node):
+        self._qual.append(node.name)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and _owns_deadline(node):
+            lv = _LoopVisitor(self.rule, self.ctx, node, ".".join(self._qual))
+            for stmt in node.body:
+                lv.visit(stmt)
+            self.out.extend(lv.out)
+        self.generic_visit(node)
+        self._qual.pop()
+
+    visit_FunctionDef = _scoped
+    visit_AsyncFunctionDef = _scoped
+    visit_ClassDef = _scoped
+
+
+class UnboundedPollInDeadlineLoop(Rule):
+    id = "TPL006"
+    name = "unbounded-poll-in-deadline-loop"
+    summary = "recv/request/wait/sleep with no bound inside a loop owning a caller timeout"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        f = _Finder(self, ctx)
+        f.visit(ctx.tree)
+        yield from f.out

@@ -124,6 +124,13 @@ def cmd_agent(args):
 
 
 def main(argv=None):
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv[:1] == ["lint"]:
+        # forwarded verbatim: argparse.REMAINDER drops options that appear
+        # before the first positional, which breaks `rt lint --list-rules`
+        from ray_tpu.lint.cli import main as lint_main
+
+        sys.exit(lint_main(argv[1:]))
     p = argparse.ArgumentParser(prog="rt", description="ray_tpu cluster CLI")
     sub = p.add_subparsers(dest="cmd", required=True)
     sub.add_parser("status")
@@ -142,8 +149,18 @@ def main(argv=None):
     up = sub.add_parser("up", help="launch a cluster from a YAML/JSON config (head + autoscaler)")
     up.add_argument("config")
     sub.add_parser("down", help="stop the most recent `rt up` head")
+    sub.add_parser("lint", help="run tpulint, the static runtime/JAX hazard analyzer (args forwarded)", add_help=False)
     args = p.parse_args(argv)
-    {"status": cmd_status, "list": cmd_list, "summary": cmd_summary, "agent": cmd_agent, "up": cmd_up, "down": cmd_down}[args.cmd](args)
+    {"status": cmd_status, "list": cmd_list, "summary": cmd_summary, "agent": cmd_agent, "up": cmd_up, "down": cmd_down, "lint": cmd_lint}[args.cmd](args)
+
+
+def cmd_lint(_args):
+    # normally unreachable (main() forwards `lint` argv verbatim before
+    # argparse); kept so a direct parse of "lint" still runs the default
+    # check instead of dying on a missing dispatch key
+    from ray_tpu.lint.cli import main as lint_main
+
+    sys.exit(lint_main([]))
 
 
 def cmd_up(args):

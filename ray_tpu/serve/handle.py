@@ -206,6 +206,7 @@ class _Router:
         self._dispatcher = None
         self._last_refresh = 0.0
         self._last_push = 0.0
+        self._last_push_ref = None  # latest metrics-push ref (see _push_metrics)
         from collections import OrderedDict
 
         # model_id -> last replica, LRU-capped so unbounded id
@@ -243,7 +244,14 @@ class _Router:
         with self._lock:
             demand = self._queued + sum(self._inflight.values())
         try:
-            self._controller.record_handle_metrics.remote(self._app, self._deployment, self._handle_id, demand)
+            # keep the latest push's ref alive (tpulint TPL002): a dropped
+            # ref frees the return immediately and loses the error channel;
+            # holding the newest one lets a dead controller surface on the
+            # next refresh instead of vanishing, and releases the previous
+            # push's return as a side effect
+            self._last_push_ref = self._controller.record_handle_metrics.remote(
+                self._app, self._deployment, self._handle_id, demand
+            )
         except Exception:
             pass
 

@@ -298,3 +298,169 @@ def test_direct_disabled_flag_round3_mode():
         assert context.get_client().store.contains(ref.id)
     finally:
         ray_tpu.shutdown()
+
+
+# ----------------------------------------- unregistered-rec failover (ADVICE)
+class _DeadConn:
+    """A conn that died between get_conn() and the send: every op raises
+    BEFORE the _CallRec can register in _calls, so conn-death failover
+    never sees the rec (the round-5 ADVICE hang: oids PENDING forever)."""
+
+    def reserve_cid(self):
+        return 1
+
+    def ensure_func(self, *a, **k):
+        raise ConnectionError("direct peer is down")
+
+    def send_call(self, *a, **k):
+        raise ConnectionError("direct peer is down")
+
+
+def test_unregistered_actor_rec_fails_over(rt_start, monkeypatch):
+    @ray_tpu.remote(max_task_retries=2)
+    class Echo:
+        def hi(self, x):
+            return x
+
+    e = Echo.remote()
+    assert ray_tpu.get(e.hi.remote(1), timeout=60) == 1  # direct route warm
+    st = _state()
+    monkeypatch.setattr(st, "get_conn", lambda addr: _DeadConn())
+    # pre-fix this hung: the ConnectionError was swallowed, nobody owned
+    # the pending oids, and ray.get waited out its full timeout
+    assert ray_tpu.get(e.hi.remote(2), timeout=60) == 2
+
+
+def test_unregistered_task_rec_fails_over(rt_start, monkeypatch):
+    @ray_tpu.remote(max_retries=2)
+    def sq(x):
+        return x * x
+
+    assert ray_tpu.get(sq.remote(2), timeout=60) == 4
+    st = _state()
+
+    class _Lease:
+        conn = _DeadConn()
+
+    monkeypatch.setattr(st, "pick_lease", lambda: _Lease())
+    # ensure_func raises before send_call ever registers the rec
+    assert ray_tpu.get(sq.remote(3), timeout=60) == 9
+
+
+# ------------------------------------- wait_mixed deadline-bounded polling
+class _Id:
+    def binary(self):
+        return b"k" * 8
+
+
+def test_wait_mixed_poll_timeout_bounded_by_deadline(monkeypatch):
+    polls = []
+
+    def fake_owned_ready(k, poll_timeout=None):
+        polls.append(poll_timeout)
+        return False  # perpetually not-ready remote-owned id
+
+    monkeypatch.setattr(direct, "owned_ready", fake_owned_ready)
+    monkeypatch.setattr(direct, "is_owned_or_hinted", lambda k: True)
+
+    t0 = time.monotonic()
+    ready, not_ready = direct.wait_mixed(None, [_Id()], 1, 0.3, fallback=None)
+    took = time.monotonic() - t0
+    assert ready == [] and len(not_ready) == 1
+    # pre-fix: each poll carried a fixed 10s timeout, so a slow owner
+    # stalled a 0.3s ray.wait for ~10s; now every poll is deadline-bounded
+    assert took < 2.0, f"wait_mixed overshot its 0.3s timeout: {took:.1f}s"
+    assert polls and all(t <= 10.0 for t in polls)
+    assert min(polls) <= 0.35, f"poll timeouts never tightened to the deadline: {polls}"
+
+
+def test_wait_mixed_many_ids_respects_small_timeout(monkeypatch):
+    # the owned-vs-head SPLIT must classify locally: with 50 slow owners a
+    # 0.2s wait must not pay even a floor-poll per id before starting
+    slow_poll = 0.05
+
+    def fake_owned_ready(k, poll_timeout=None):
+        time.sleep(slow_poll if poll_timeout is None else min(slow_poll, poll_timeout))
+        return False
+
+    monkeypatch.setattr(direct, "owned_ready", fake_owned_ready)
+    monkeypatch.setattr(direct, "is_owned_or_hinted", lambda k: True)
+    ids = [_Id() for _ in range(50)]
+    t0 = time.monotonic()
+    ready, not_ready = direct.wait_mixed(None, ids, 50, 0.2, fallback=None)
+    took = time.monotonic() - t0
+    assert ready == [] and len(not_ready) == 50
+    assert took < 1.5, f"50-id wait(0.2s) took {took:.1f}s (per-id polls not deadline-gated)"
+
+
+def test_wait_mixed_timeout_zero_sees_locally_ready(monkeypatch):
+    # the non-blocking poll idiom ray.wait(refs, timeout=0) must report a
+    # locally-completed owned result: the local table check is free and
+    # runs even with the deadline already expired
+    import types
+
+    class _Owned:
+        def entry(self, k):
+            return types.SimpleNamespace(state=direct.VALUE)
+
+        def owns(self, k):
+            return True
+
+    monkeypatch.setattr(direct, "_state", types.SimpleNamespace(owned=_Owned(), server=object()))
+    ready, not_ready = direct.wait_mixed(None, [_Id()], 1, 0, fallback=None)
+    assert len(ready) == 1 and not_ready == []
+
+
+def test_wait_mixed_unbounded_wait_keeps_legacy_poll(monkeypatch):
+    # timeout=None must pass poll_timeout=None: owned_ready's legacy
+    # ready-on-poll-timeout escape is what stops a blackholed owner from
+    # spinning an unbounded ray.wait forever
+    polls = []
+
+    def fake_owned_ready(k, poll_timeout=None):
+        polls.append(poll_timeout)
+        return len(polls) >= 3  # "owner answers" on the third poll
+
+    monkeypatch.setattr(direct, "owned_ready", fake_owned_ready)
+    monkeypatch.setattr(direct, "is_owned_or_hinted", lambda k: True)
+    ready, not_ready = direct.wait_mixed(None, [_Id()], 1, None, fallback=None)
+    assert len(ready) == 1 and not_ready == []
+    assert polls and all(t is None for t in polls), polls
+
+
+def test_owned_ready_poll_timeout_means_not_ready(monkeypatch):
+    from ray_tpu.exceptions import GetTimeoutError
+
+    class _Owned:
+        def entry(self, k):
+            return None
+
+    class _SlowConn:
+        def request(self, op, timeout=None, **kw):
+            raise GetTimeoutError("owner poll timed out")
+
+    class _St:
+        owned = _Owned()
+        server = object()
+
+        def get_conn(self, addr):
+            return _SlowConn()
+
+    monkeypatch.setattr(direct, "_state", _St())
+    monkeypatch.setattr(direct, "get_hint", lambda k: "owner1")
+    monkeypatch.setattr(direct, "hint_addr", lambda o: ("127.0.0.1", 1))
+    # a slow owner is NOT-READY for deadline-bounded callers (never
+    # blocks the wait loop)...
+    assert direct.owned_ready(b"k", poll_timeout=0.01) is False
+    # ...but UNBOUNDED callers (executor entry_size probe) keep legacy
+    # ready-on-timeout so the downstream get() surfaces the owner state
+    # instead of stalling the stream forever on a blackholed host
+    assert direct.owned_ready(b"k") is True
+
+    class _GoneConn:
+        def request(self, *a, **k):
+            raise ConnectionError("owner is gone")
+
+    _St.get_conn = lambda self, addr: _GoneConn()
+    # ...but a DEAD owner still reports ready so get() surfaces the error
+    assert direct.owned_ready(b"k") is True

@@ -1,0 +1,137 @@
+"""tpulint self-check: the analyzer runs over ray_tpu/ itself and must
+report nothing beyond the checked-in baseline.
+
+This is the CI gate the ISSUE asks for: any NEW static hazard (blocking
+get in an actor, dropped ref, lock-order inversion, jit impurity,
+unbounded poll, swallowed conn error) fails tier-1 until it is fixed or
+explicitly accepted via --update-baseline. Runs from any cwd: paths are
+anchored at the repo root so fingerprints match the baseline.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu.lint import baseline as bl
+from ray_tpu.lint.cli import main as lint_main
+from ray_tpu.lint.engine import lint_paths
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+PKG = os.path.join(ROOT, "ray_tpu")
+
+
+def test_self_check_no_new_findings():
+    findings = lint_paths([PKG], root=ROOT)
+    d = bl.diff(findings, bl.load(bl.default_baseline_path()))
+    assert d.new == [], (
+        "tpulint found NEW hazards (fix them, or accept deliberate ones "
+        "with `python -m ray_tpu.lint ray_tpu/ --update-baseline`):\n"
+        + "\n".join(f.render() for f in d.new)
+    )
+
+
+def test_self_check_baseline_not_stale():
+    findings = lint_paths([PKG], root=ROOT)
+    d = bl.diff(findings, bl.load(bl.default_baseline_path()))
+    assert d.stale == [], (
+        "baseline entries no longer reproduce (a finding was fixed): "
+        "re-run --update-baseline to shrink the baseline:\n"
+        + "\n".join(str(e) for e in d.stale)
+    )
+
+
+def test_cli_exit_codes(tmp_path):
+    # clean tree against the real baseline -> 0
+    assert lint_main([PKG, "--root", ROOT]) == 0
+    # same tree with an empty baseline -> 1 iff any findings exist at all
+    empty = tmp_path / "empty.json"
+    empty.write_text('{"version": 1, "tool": "tpulint", "entries": {}}')
+    findings = lint_paths([PKG], root=ROOT)
+    expected = 1 if findings else 0
+    assert lint_main([PKG, "--root", ROOT, "--baseline", str(empty)]) == expected
+
+
+def test_cli_update_baseline_roundtrip(tmp_path):
+    out = tmp_path / "bl.json"
+    assert lint_main([PKG, "--root", ROOT, "--baseline", str(out), "--update-baseline"]) == 0
+    doc = json.loads(out.read_text())
+    assert doc["tool"] == "tpulint" and isinstance(doc["entries"], dict)
+    # a freshly-written baseline always yields a clean run
+    assert lint_main([PKG, "--root", ROOT, "--baseline", str(out)]) == 0
+
+
+def test_cli_select_restricts_rules():
+    # TPL005-only run over the jax ops tree is clean (its jit bodies are pure)
+    assert lint_main([os.path.join(PKG, "ops"), "--root", ROOT, "--select", "TPL005", "--no-baseline"]) == 0
+    assert lint_main([PKG, "--select", "NOPE"]) == 2
+
+
+def test_cli_stale_baseline_fails_the_gate(tmp_path):
+    # an accepted entry that no longer reproduces (here: a fabricated one
+    # inside the linted tree) must fail, or its unused budget would
+    # silently absorb a reintroduced finding
+    stale = tmp_path / "stale.json"
+    stale.write_text(json.dumps({
+        "version": 1, "tool": "tpulint",
+        "entries": {
+            "deadbeefdeadbeef": {
+                "rule": "TPL006", "path": "ray_tpu/ops/layers.py",
+                "context": "nope", "message": "never existed", "count": 1,
+            },
+        },
+    }))
+    assert lint_main([os.path.join(PKG, "ops"), "--root", ROOT, "--baseline", str(stale)]) == 1
+
+
+def test_cli_subset_runs_have_no_phantom_staleness(tmp_path):
+    # the real baseline's node_agent TPL006 entries are OUTSIDE ray_tpu/ops
+    # (and outside --select TPL001): neither run may call them stale
+    assert lint_main([os.path.join(PKG, "ops"), "--root", ROOT]) == 0
+    assert lint_main([PKG, "--root", ROOT, "--select", "TPL001"]) == 0
+
+
+def test_cli_update_baseline_merges_outside_coverage(tmp_path):
+    out = tmp_path / "bl.json"
+    # full-tree accept first
+    assert lint_main([PKG, "--root", ROOT, "--baseline", str(out), "--update-baseline"]) == 0
+    before = json.loads(out.read_text())["entries"]
+    # subset re-accept must keep entries for files outside ray_tpu/ops
+    assert lint_main([os.path.join(PKG, "ops"), "--root", ROOT, "--baseline", str(out), "--update-baseline"]) == 0
+    after = json.loads(out.read_text())["entries"]
+    assert after == before, "subset --update-baseline dropped out-of-coverage entries"
+    # and the merged file still yields a clean full run
+    assert lint_main([PKG, "--root", ROOT, "--baseline", str(out)]) == 0
+
+
+def test_cli_overlapping_paths_lint_each_file_once():
+    # a tree plus a file inside it must not double-lint the file: the
+    # duplicates would overflow the baseline's accepted counts
+    overlap = [PKG, os.path.join(PKG, "core", "node_agent.py")]
+    assert lint_main(overlap + ["--root", ROOT]) == 0
+    findings = lint_paths(overlap, root=ROOT)
+    assert findings == lint_paths([PKG], root=ROOT)
+
+
+def test_cli_nonexistent_path_is_a_usage_error(tmp_path):
+    # a typo'd path must not produce a silently-green zero-file run
+    assert lint_main([str(tmp_path / "no_such_tree"), "--root", ROOT]) == 2
+    with pytest.raises(FileNotFoundError):
+        lint_paths([str(tmp_path / "no_such_tree")], root=ROOT)
+
+
+def test_module_entrypoint_and_rt_wiring():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint", "--list-rules"],
+        capture_output=True, text=True, cwd=ROOT, env=env, timeout=120,
+    )
+    assert r.returncode == 0 and "TPL001" in r.stdout and "TPL007" in r.stdout
+    r2 = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "lint", "ray_tpu", "--root", ROOT],
+        capture_output=True, text=True, cwd=ROOT, env=env, timeout=300,
+    )
+    assert r2.returncode == 0, r2.stdout + r2.stderr

@@ -1,0 +1,482 @@
+"""Per-rule positive/negative fixtures for tpulint (ray_tpu/lint/).
+
+Each rule gets at least one fixture that MUST fire and one that MUST
+stay silent — the silent side is what keeps the analyzer usable (a noisy
+rule gets baselined into oblivion). Engine-level behavior (fingerprints,
+inline suppression, baseline counts) is covered at the bottom.
+"""
+
+import textwrap
+
+import pytest
+
+from ray_tpu.lint import baseline as bl
+from ray_tpu.lint.engine import Finding, lint_source
+from ray_tpu.lint.rules import all_rules, rule_catalog
+
+
+def run(src: str, rule_id: str | None = None):
+    out = lint_source(textwrap.dedent(src), path="fixture.py")
+    assert not any(f.rule == "TPLERR" for f in out), out
+    if rule_id is None:
+        return out
+    return [f for f in out if f.rule == rule_id]
+
+
+def test_catalog_has_at_least_six_rules():
+    cat = rule_catalog()
+    assert len(cat) >= 6
+    assert len({rid for rid, _, _ in cat}) == len(cat), "duplicate rule ids"
+    assert len(all_rules()) == len(cat)
+
+
+# ------------------------------------------------------------------ TPL001
+def test_tpl001_flags_get_in_actor_method():
+    out = run("""
+        import ray_tpu
+
+        @ray_tpu.remote
+        class Pump:
+            def step(self, ref):
+                return ray_tpu.get(ref)
+    """, "TPL001")
+    assert len(out) == 1
+    assert out[0].context == "Pump.step"
+
+
+def test_tpl001_flags_blocking_get_in_async_def():
+    out = run("""
+        import ray_tpu
+
+        async def handler(ref):
+            return ray_tpu.get(ref)
+    """, "TPL001")
+    assert len(out) == 1
+
+
+def test_tpl001_silent_on_plain_function_and_bounded_get():
+    assert run("""
+        import ray_tpu
+
+        def driver(ref):
+            return ray_tpu.get(ref)
+
+        @ray_tpu.remote
+        class Pump:
+            def step(self, ref):
+                return ray_tpu.get(ref, timeout=30.0)
+    """, "TPL001") == []
+
+
+def test_tpl001_silent_on_non_actor_class():
+    assert run("""
+        import ray_tpu
+
+        class Helper:
+            def step(self, ref):
+                return ray_tpu.get(ref)
+    """, "TPL001") == []
+
+
+# ------------------------------------------------------------------ TPL002
+def test_tpl002_flags_dropped_remote_result():
+    out = run("""
+        def kick(actor):
+            actor.ping.remote()
+            actor.options(num_cpus=1).remote()
+    """, "TPL002")
+    assert len(out) == 2
+
+
+def test_tpl002_silent_when_ref_is_kept_or_awaited():
+    assert run("""
+        async def kick(actor, f):
+            r = actor.ping.remote()
+            refs = [f.remote() for _ in range(3)]
+            await actor.ping.remote()
+            return r, refs
+    """, "TPL002") == []
+
+
+# ------------------------------------------------------------------ TPL003
+def test_tpl003_flags_closure_captured_lock():
+    out = run("""
+        import threading
+        import ray_tpu
+
+        def make_job():
+            lock = threading.Lock()
+
+            @ray_tpu.remote
+            def job():
+                with lock:
+                    return 1
+
+            return job
+    """, "TPL003")
+    assert len(out) == 1
+    assert "lock" in out[0].message
+
+
+def test_tpl003_flags_hazard_default_argument():
+    out = run("""
+        import threading
+        import ray_tpu
+
+        @ray_tpu.remote
+        def job(l=threading.Lock()):
+            return l
+    """, "TPL003")
+    assert len(out) == 1
+
+
+def test_tpl003_silent_when_constructed_inside_or_shadowed():
+    assert run("""
+        import threading
+        import ray_tpu
+
+        def make_job():
+            lock = threading.Lock()
+
+            @ray_tpu.remote
+            def job():
+                lock = threading.Lock()  # local, not a capture
+                with lock:
+                    return 1
+
+            @ray_tpu.remote
+            def other(n):
+                return n + 1  # never touches the enclosing lock
+
+            return job, other
+    """, "TPL003") == []
+
+
+# ------------------------------------------------------------------ TPL004
+def test_tpl004_flags_abba_inversion():
+    out = run("""
+        import threading
+
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+
+        def fwd():
+            with a_lock:
+                with b_lock:
+                    pass
+
+        def rev():
+            with b_lock:
+                with a_lock:
+                    pass
+    """, "TPL004")
+    assert len(out) == 1
+    assert "a_lock" in out[0].message and "b_lock" in out[0].message
+
+
+def test_tpl004_flags_self_lock_inversion_across_methods():
+    out = run("""
+        class Registry:
+            def put(self):
+                with self._lock:
+                    with self._conns_lock:
+                        pass
+
+            def drop(self):
+                with self._conns_lock:
+                    with self._lock:
+                        pass
+    """, "TPL004")
+    assert len(out) == 1
+
+
+def test_tpl004_silent_on_consistent_order_and_multi_item_with():
+    assert run("""
+        import threading
+
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+
+        def one():
+            with a_lock, b_lock:
+                pass
+
+        def two():
+            with a_lock:
+                with b_lock:
+                    pass
+    """, "TPL004") == []
+
+
+def test_tpl004_nesting_does_not_cross_function_boundaries():
+    # a nested def's body starts with an empty held-set: this is the
+    # dynamic sanitizer's territory, not lexical nesting
+    assert run("""
+        def outer():
+            with a_lock:
+                def inner():
+                    with b_lock:
+                        pass
+                return inner
+
+        def other():
+            with b_lock:
+                with a_lock:
+                    pass
+    """, "TPL004") == []
+
+
+# ------------------------------------------------------------------ TPL005
+def test_tpl005_flags_print_and_time_in_decorated_jit():
+    out = run("""
+        import functools
+        import time
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def step(x, n):
+            print("tracing", n)
+            return x * time.time()
+    """, "TPL005")
+    assert len(out) == 2
+
+
+def test_tpl005_flags_call_form_jit():
+    out = run("""
+        import jax
+        import numpy as np
+
+        def sample(x):
+            return x + np.random.rand()
+
+        sample_fn = jax.jit(sample)
+    """, "TPL005")
+    assert len(out) == 1
+    assert "np.random.rand" in out[0].message
+
+
+def test_tpl005_flags_global_write_tracer_leak():
+    out = run("""
+        import jax
+
+        @jax.jit
+        def leak(x):
+            global acc
+            acc = x
+            return x
+    """, "TPL005")
+    assert len(out) == 1
+    assert "global" in out[0].message
+
+
+def test_tpl005_nested_jitted_def_reports_once():
+    out = run("""
+        import jax
+
+        @jax.jit
+        def outer(x):
+            @jax.jit
+            def inner(y):
+                print(y)
+                return y
+            return inner(x)
+    """, "TPL005")
+    assert len(out) == 1
+    assert out[0].context == "outer.inner"
+
+
+def test_tpl005_silent_on_debug_print_and_unjitted_code():
+    assert run("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            jax.debug.print("x={x}", x=x)
+            return x + 1
+
+        def host_side(x):
+            print(x)  # not jitted: fine
+            return x
+    """, "TPL005") == []
+
+
+# ------------------------------------------------------------------ TPL006
+def test_tpl006_flags_unbounded_recv_and_bare_queue_get():
+    out = run("""
+        import time
+
+        def pump(conn, q, timeout):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                msg = conn.recv()
+                item = q.get()
+    """, "TPL006")
+    assert len(out) == 2
+
+
+def test_tpl006_flags_unbounded_request_and_eventwait():
+    out = run("""
+        def spin(peer, ev, deadline):
+            for _ in range(100):
+                peer.request("poll")
+                ev.wait()
+    """, "TPL006")
+    assert len(out) == 2
+
+
+def test_tpl006_flags_long_fixed_sleep():
+    out = run("""
+        import time
+
+        def spin(timeout):
+            while True:
+                time.sleep(5)
+    """, "TPL006")
+    assert len(out) == 1
+
+
+def test_tpl006_silent_when_bounded_or_no_deadline():
+    assert run("""
+        import time
+
+        def bounded(sock, peer, ev, q, timeout):
+            sock.settimeout(timeout)
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                sock.recv(4096)
+                peer.request("poll", timeout=1.0)
+                ev.wait(timeout=0.5)
+                q.get(timeout=0.1)
+                time.sleep(0.01)
+
+        def no_deadline(conn):
+            while True:
+                conn.recv()  # caller made no timeout promise
+    """, "TPL006") == []
+
+
+def test_tpl006_nested_helper_deadline_does_not_leak_to_outer():
+    # a helper's local `timeout` is ITS deadline contract, not the outer
+    # function's — the outer loop made no promise to any caller
+    assert run("""
+        def outer(q):
+            def helper():
+                timeout = 5.0
+                return timeout
+            while True:
+                item = q.get()
+    """, "TPL006") == []
+
+
+def test_tpl006_nested_settimeout_does_not_vouch_for_outer():
+    # only a settimeout in the OUTER body bounds the outer recv
+    out = run("""
+        def outer(sock, timeout):
+            def configure(s):
+                s.settimeout(1.0)
+            deadline = 1.0
+            while True:
+                sock.recv(4096)
+    """, "TPL006")
+    assert len(out) == 1
+
+
+def test_tpl006_silent_outside_loops():
+    assert run("""
+        def once(conn, timeout):
+            return conn.recv()
+    """, "TPL006") == []
+
+
+# ------------------------------------------------------------------ TPL007
+def test_tpl007_flags_bare_pass_swallow():
+    out = run("""
+        def send(sock, data):
+            try:
+                sock.sendall(data)
+            except ConnectionError:
+                pass
+    """, "TPL007")
+    assert len(out) == 1
+
+
+def test_tpl007_flags_tuple_catch_with_conn_member():
+    out = run("""
+        def send(sock, data):
+            try:
+                sock.sendall(data)
+            except (BrokenPipeError, ValueError):
+                pass
+    """, "TPL007")
+    assert len(out) == 1
+
+
+def test_tpl007_silent_on_handled_or_cleanup_oserror():
+    assert run("""
+        def close(sock):
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+        def send(st, sock, data):
+            try:
+                sock.sendall(data)
+            except ConnectionError:
+                st.failover()
+    """, "TPL007") == []
+
+
+# -------------------------------------------------------------- engine bits
+def test_inline_suppression_comment():
+    src = """
+        def send(sock, data):
+            try:
+                sock.sendall(data)
+            except ConnectionError:  # tpulint: disable=TPL007
+                pass
+    """
+    assert run(src, "TPL007") == []
+    src_all = src.replace("disable=TPL007", "disable=all")
+    assert run(src_all) == []
+
+
+def test_fingerprint_is_line_independent():
+    base = """
+        def send(sock, data):
+            try:
+                sock.sendall(data)
+            except ConnectionError:
+                pass
+    """
+    shifted = "# a new header comment\n\n" + textwrap.dedent(base)
+    f1 = lint_source(textwrap.dedent(base), path="m.py")
+    f2 = lint_source(shifted, path="m.py")
+    assert len(f1) == len(f2) == 1
+    assert f1[0].line != f2[0].line
+    assert f1[0].fingerprint() == f2[0].fingerprint()
+
+
+def test_baseline_counts_cap_accepted_duplicates(tmp_path):
+    def mk(n):
+        return [Finding("TPL007", "m.py", 10 + i, 0, "swallowed ConnectionError", "f") for i in range(n)]
+
+    path = str(tmp_path / "bl.json")
+    bl.save(path, mk(2))
+    entries = bl.load(path)
+    ok = bl.diff(mk(2), entries)
+    assert ok.new == [] and ok.suppressed == 2 and ok.stale == []
+    worse = bl.diff(mk(3), entries)
+    assert len(worse.new) == 1  # third duplicate is NEW, not grandfathered
+    better = bl.diff(mk(0), entries)
+    assert better.new == [] and len(better.stale) == 1
+    # PARTIAL fix is also stale: unused budget must not become silent
+    # headroom for a later reintroduction of the same finding
+    partial = bl.diff(mk(1), entries)
+    assert partial.new == [] and len(partial.stale) == 1
+    assert partial.stale[0]["unused"] == 1
+
+
+def test_syntax_error_reported_not_raised():
+    out = lint_source("def broken(:\n", path="bad.py")
+    assert len(out) == 1 and out[0].rule == "TPLERR"
