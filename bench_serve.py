@@ -18,9 +18,51 @@ Quick CPU sanity: JAX_PLATFORMS=cpu python bench_serve.py --tiny
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import threading
 import time
+
+# HBM bandwidth (GB/s) by device kind prefix, for the decode roofline
+# (decode is memory-bound: every step must stream the weights plus the
+# occupied KV working set from HBM at least once).
+_HBM_GBPS = {
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,
+    "TPU v5e": 819.0,
+    "TPU v5p": 2765.0,
+    "TPU v5": 2765.0,
+    "TPU v6 lite": 1640.0,
+    "TPU v6e": 1640.0,
+}
+
+
+def _device_info() -> dict:
+    """Prove which device the numbers came from (VERDICT r5: the artifact
+    must show it ran on the TPU)."""
+    import jax
+
+    d = jax.devices()
+    return {"device": d[0].platform, "device_kind": d[0].device_kind, "n_devices": len(d)}
+
+
+def _roofline(eng, cfg, batch: int, mean_len: float, device_kind: str) -> dict:
+    """HBM-roofline decode estimate: ms/step >= (param bytes + occupied
+    KV bytes) / HBM bandwidth. Unknown device kinds (e.g. cpu) report
+    the byte traffic with no time bound."""
+    import jax
+    import numpy as np
+
+    param_bytes = int(sum(x.nbytes for x in jax.tree.leaves(eng.params)))
+    kv_itemsize = np.dtype(getattr(eng, "_pcfg", cfg).dtype).itemsize
+    kv_bytes = int(2 * cfg.num_layers * batch * mean_len * cfg.num_kv_heads * cfg.hd * kv_itemsize)
+    bw = next((v for k, v in _HBM_GBPS.items() if device_kind.startswith(k)), None)
+    out = {"roofline_param_bytes": param_bytes, "roofline_kv_bytes": kv_bytes}
+    if bw is not None:
+        ms = (param_bytes + kv_bytes) / (bw * 1e9) * 1e3
+        out["roofline_decode_step_ms"] = round(ms, 3)
+        out["roofline_decode_tokens_per_s"] = round(batch / ms * 1e3, 1)
+    return out
 
 
 def _model(tiny: bool):
@@ -42,42 +84,95 @@ def _model(tiny: bool):
     return cfg, 512, 128
 
 
-def bench_engine(cfg, prompt_len: int, gen_len: int, kv_layout: str, max_num_seqs: int = 8) -> dict:
+def bench_engine(
+    cfg,
+    prompt_len: int,
+    gen_len: int,
+    kv_layout: str,
+    max_num_seqs: int = 8,
+    device_resident: bool | None = None,
+    trace_dir: str | None = None,
+    repeats: int = 1,
+) -> dict:
     import numpy as np
 
     from ray_tpu.llm.engine import LLMEngine
     from ray_tpu.llm.sampling import SamplingParams
 
     kw = {"kv_layout": kv_layout, "page_size": 64} if kv_layout == "paged" else {}
+    if device_resident is not None:
+        kw["device_resident"] = device_resident
     eng = LLMEngine(cfg, max_num_seqs=max_num_seqs, max_seq_len=cfg.max_seq_len, enable_prefix_caching=False, **kw)
     rng = np.random.default_rng(0)
     prompts = [list(rng.integers(1, cfg.vocab_size - 1, size=prompt_len)) for _ in range(max_num_seqs)]
     sp = SamplingParams(temperature=0.7, max_tokens=gen_len)
 
-    # warm/compile
-    eng.generate([prompts[0][:prompt_len]], SamplingParams(temperature=0.7, max_tokens=4))
+    # warm/compile with a FULL batch so the batched-prefill program and
+    # the fused decode program both compile outside the timed region
+    eng.generate(prompts, SamplingParams(temperature=0.7, max_tokens=4))
 
-    # prefill throughput: admit a full batch, time until all prefills done
-    t0 = time.perf_counter()
-    ids = [eng.add_request(p, sp) for p in prompts]
-    while eng.num_waiting:
-        eng.step()
-    prefill_s = time.perf_counter() - t0
+    # best-of-N repeats: on shared/loaded hosts a single sample is noise
+    # (the min is the least-contended measurement of the same program)
+    prefill_s = decode_s = float("inf")
+    steps = prefill_waves = 1
+    for r in range(max(repeats, 1)):
+        # prefill phase: admit a full batch, time until all prefills done
+        t0 = time.perf_counter()
+        ids = [eng.add_request(p, sp) for p in prompts]
+        waves = 0
+        while eng.num_waiting:
+            eng.step()
+            waves += 1
+        p_s = time.perf_counter() - t0
+        if p_s < prefill_s:
+            prefill_s, prefill_waves = p_s, waves
+
+        # decode phase: step until done, count generated tokens
+        trace = contextlib.nullcontext()
+        if trace_dir and r == 0:
+            from ray_tpu.util.profiling import profile_trace
+
+            trace = profile_trace(trace_dir)
+        t0 = time.perf_counter()
+        n_steps = 0
+        with trace:
+            while eng.has_unfinished():
+                eng.step()
+                n_steps += 1
+        d_s = time.perf_counter() - t0
+        if d_s / max(n_steps, 1) < decode_s / max(steps, 1):
+            decode_s, steps = d_s, n_steps
+        del ids
     prefill_tok_s = max_num_seqs * prompt_len / prefill_s
-
-    # steady-state decode: step until done, count generated tokens
-    t0 = time.perf_counter()
-    steps = 0
-    while eng.has_unfinished():
-        eng.step()
-        steps += 1
-    decode_s = time.perf_counter() - t0
     gen_tokens = max_num_seqs * gen_len
+
+    info = _device_info()
+    decode_step_ms = decode_s / max(steps, 1) * 1e3
+    roof = _roofline(eng, cfg, max_num_seqs, prompt_len + gen_len / 2, info["device_kind"])
+    roof_ms = roof.get("roofline_decode_step_ms")
+    if roof_ms:
+        print(
+            f"  decode {decode_step_ms:.2f} ms/step vs HBM roofline ~{roof_ms:.2f} ms/step "
+            f"({decode_step_ms / roof_ms:.1f}x off) on {info['device_kind']}",
+            flush=True,
+        )
+    else:
+        print(
+            f"  decode {decode_step_ms:.2f} ms/step on {info['device_kind']} "
+            f"(no HBM roofline for this device; step must move >= "
+            f"{(roof['roofline_param_bytes'] + roof['roofline_kv_bytes']) / 1e9:.2f} GB)",
+            flush=True,
+        )
     return {
         "metric": f"engine_{kv_layout}",
+        **info,
+        "device_resident": eng._device_resident,
         "prefill_tokens_per_s": round(prefill_tok_s, 1),
+        "prefill_ms_per_step": round(prefill_s / max(prefill_waves, 1) * 1e3, 2),
+        "prefill_ms_per_seq": round(prefill_s / max_num_seqs * 1e3, 2),
         "decode_tokens_per_s": round(gen_tokens / decode_s, 1),
-        "decode_step_ms": round(decode_s / max(steps, 1) * 1e3, 2),
+        "decode_step_ms": round(decode_step_ms, 2),
+        **roof,
         "batch": max_num_seqs,
         "prompt_len": prompt_len,
         "gen_len": gen_len,
@@ -137,6 +232,7 @@ def bench_full_stack(cfg, prompt_len: int, gen_len: int, concurrency: int, tiny:
         n = len(lat)
         return {
             "metric": "serve_full_stack",
+            **_device_info(),
             "concurrency": concurrency,
             "requests": n,
             "errors": len(errors),
@@ -158,18 +254,44 @@ def bench_full_stack(cfg, prompt_len: int, gen_len: int, concurrency: int, tiny:
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--tiny", action="store_true", help="CPU sanity mode")
+    ap.add_argument("--small", action="store_true", help="~125M model (CPU-runnable engine bench)")
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--out", default="BENCH_serve.json")
     ap.add_argument("--only", default="")
+    ap.add_argument("--compare", action="store_true", help="also run the synchronous host-driven loop (before/after)")
+    ap.add_argument("--trace", default="", help="capture a jax.profiler trace of each decode phase under DIR/<metric>")
+    ap.add_argument("--write", action="store_true", help="write --out even in --tiny/--small/--only modes")
+    ap.add_argument("--repeats", type=int, default=3, help="best-of-N engine phases (min = least-contended sample)")
     args = ap.parse_args(argv)
 
-    cfg, prompt_len, gen_len = _model(args.tiny)
+    cfg, prompt_len, gen_len = _model(args.tiny or args.small)
+    if args.small:
+        from ray_tpu.models.llama import LlamaConfig
+
+        cfg = LlamaConfig(
+            vocab_size=8192,
+            hidden_size=768,
+            intermediate_size=2048,
+            num_layers=10,
+            num_heads=12,
+            num_kv_heads=12,
+            max_seq_len=1024,
+            dtype="float32",
+            remat=False,
+        )
+        prompt_len, gen_len = 256, 64
     results = []
-    for name, fn in (
-        ("engine_slots", lambda: bench_engine(cfg, prompt_len, gen_len, "slots")),
-        ("engine_paged", lambda: bench_engine(cfg, prompt_len, gen_len, "paged")),
-        ("full_stack", lambda: bench_full_stack(cfg, prompt_len, gen_len, args.concurrency, args.tiny)),
-    ):
+    benches = [
+        ("engine_slots", lambda: bench_engine(cfg, prompt_len, gen_len, "slots", trace_dir=args.trace and f"{args.trace}/engine_slots", repeats=args.repeats)),
+        ("engine_paged", lambda: bench_engine(cfg, prompt_len, gen_len, "paged", trace_dir=args.trace and f"{args.trace}/engine_paged", repeats=args.repeats)),
+    ]
+    if args.compare:
+        benches += [
+            ("engine_slots_sync", lambda: bench_engine(cfg, prompt_len, gen_len, "slots", device_resident=False, trace_dir=args.trace and f"{args.trace}/engine_slots_sync", repeats=args.repeats)),
+            ("engine_paged_sync", lambda: bench_engine(cfg, prompt_len, gen_len, "paged", device_resident=False, trace_dir=args.trace and f"{args.trace}/engine_paged_sync", repeats=args.repeats)),
+        ]
+    benches.append(("full_stack", lambda: bench_full_stack(cfg, prompt_len, gen_len, args.concurrency, args.tiny or args.small)))
+    for name, fn in benches:
         if args.only and args.only not in name:
             continue
         print(f"=== {name} ===", flush=True)
@@ -177,11 +299,19 @@ def main(argv=None):
             rec = fn()
         except BaseException as e:  # noqa: BLE001
             rec = {"metric": name, "error": f"{type(e).__name__}: {e}"}
+        if "metric" in rec:
+            rec["metric"] = name
         results.append(rec)
         print(json.dumps(rec), flush=True)
-    if not args.only and not args.tiny:
+    if args.write or (not args.only and not args.tiny and not args.small):
+        blob = {
+            "benchmarks": results,
+            "model": "tiny" if args.tiny else ("small" if args.small else "1B"),
+            "note": "each record carries device/device_kind; regenerate on-chip with: python bench_serve.py [--compare --trace bench_artifacts/serve_traces]",
+            "ts": time.time(),
+        }
         with open(args.out, "w") as f:
-            json.dump({"benchmarks": results, "ts": time.time()}, f, indent=1)
+            json.dump(blob, f, indent=1)
         print(f"wrote {args.out}")
 
 

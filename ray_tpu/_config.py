@@ -134,6 +134,23 @@ class Config:
     # Seconds an owned object lingers after its last reference drops
     # (absorbs the async borrow-registration race).
     owned_object_grace_s: float = 1.0
+    # Entries whose ref was SERIALIZED OUT of this process but never saw a
+    # registered borrow use this much longer window instead: the owner
+    # waits for the explicit borrow-release, and the timer is only the
+    # leak backstop for borrowers that died before registering (round-5
+    # advisory: time-based grace premature-frees a live borrowed ref when
+    # the ref pump stalls past the grace window).
+    owned_object_leak_backstop_s: float = 30.0
+
+    # --- llm serving ---
+    # Device-resident decode loop: per-step state (tokens, PRNG keys,
+    # sampling params, block tables, lengths) lives on device, mutated by
+    # one fused jitted step + small scatter deltas; token readback trails
+    # the dispatch by one step. RT_LLM_DEVICE_RESIDENT=0 restores the
+    # synchronous host-driven loop (also the equivalence-test oracle).
+    llm_device_resident: bool = True
+    # Batch same-bucket prompt prefills into one forward at admission.
+    llm_batch_prefill: bool = True
 
     # --- collective / mesh ---
     collective_timeout_s: float = 120.0

@@ -112,7 +112,18 @@ PENDING, READY, VALUE, ERROR, REDIRECT = range(5)
 
 
 class _Entry:
-    __slots__ = ("state", "payload", "value", "error", "event", "borrows", "zero_since", "callbacks", "contained")
+    __slots__ = (
+        "state",
+        "payload",
+        "value",
+        "error",
+        "event",
+        "borrows",
+        "zero_since",
+        "callbacks",
+        "contained",
+        "pending_serialized",
+    )
 
     def __init__(self, state: int):
         self.state = state
@@ -127,18 +138,34 @@ class _Entry:
         # while it lives, releasing on free (cascading GC — the owned-store
         # analogue of the head store's contained_refs wrapping)
         self.contained = None
+        # serialized-out copies of the ref not yet matched by a
+        # registered borrow: while > 0 a borrower may still be about to
+        # register, so the owner must wait for the explicit registration
+        # (then release) — the timer degrades to a LEAK BACKSTOP. A
+        # counter, not a flag: every new serialization re-opens the
+        # registration race, however many borrows came and went before.
+        self.pending_serialized = 0
 
 
 class OwnedStore:
     """The owner half of the per-owner metadata protocol: values (or their
     shm descriptors) created by this process, served to borrowers, freed on
     last release plus a short grace window (the grace absorbs the in-flight
-    register race inherent to async borrow registration)."""
+    register race inherent to async borrow registration).
 
-    def __init__(self, grace_s: float = 1.0):
+    Refs known to have been SERIALIZED OUT of this process wait for the
+    explicit borrow-release instead (reference: reference_counter.h
+    WaitForRefRemoved); until a borrow registers, the timer is only the
+    ``backstop_s`` leak backstop for borrowers that died before
+    registering — a ref-pump stall longer than ``grace_s`` no longer
+    premature-frees a live borrowed ref. Both windows are RT_* flags
+    (_config.py: owned_object_grace_s / owned_object_leak_backstop_s)."""
+
+    def __init__(self, grace_s: float = 1.0, backstop_s: float = 30.0):
         self._lock = threading.Lock()
         self._objects: dict[bytes, _Entry] = {}
         self.grace_s = grace_s
+        self.backstop_s = max(backstop_s, grace_s)
 
     def __contains__(self, k: bytes) -> bool:
         with self._lock:
@@ -183,6 +210,7 @@ class OwnedStore:
             old = self._objects.get(k)
             if old is not None:
                 e.borrows = old.borrows
+                e.pending_serialized = old.pending_serialized
             self._objects[k] = e
 
     def complete(self, k: bytes, payload: Payload | None = None, value=None, error=None, redirect=False):
@@ -258,14 +286,32 @@ class OwnedStore:
             return e is not None and e.state != PENDING
 
     # -- borrow protocol (owner side) --
+    def mark_serialized(self, k: bytes):
+        """The ref just left this process inside a pickle (ObjectRef.
+        __reduce__): hold the entry for the explicit borrow-release; the
+        timer becomes the leak backstop until a borrow registers."""
+        with self._lock:
+            e = self._objects.get(k)
+            if e is not None:
+                e.pending_serialized += 1
+
     def on_borrow(self, k: bytes, registered: bool):
         with self._lock:
             e = self._objects.get(k)
             if e is None:
                 return
             e.borrows += 1 if registered else -1
+            if registered and e.pending_serialized > 0:
+                e.pending_serialized -= 1
             if e.borrows > 0:
                 e.zero_since = None
+            elif e.zero_since is None and registered is False:
+                # explicit release brought borrows back to zero: (re)start
+                # the grace clock if the local count is already zero too
+                from ray_tpu.core.object_ref import local_ref_count
+
+                if local_ref_count(ObjectID(k)) == 0:
+                    e.zero_since = time.monotonic()
 
     def on_local_zero(self, k: bytes):
         from ray_tpu.core.object_ref import local_ref_count
@@ -306,16 +352,21 @@ class OwnedStore:
 
     def gc_pass(self):
         """Free entries whose local count has been zero (and borrow count
-        <= 0) for longer than the grace window."""
+        <= 0) for longer than the applicable window: the short grace for
+        entries that never left this process (or whose every serialized
+        copy registered its borrow, so release is the causal signal), the
+        leak backstop while any serialized-out copy's registration may
+        still be in flight."""
         from ray_tpu.core.object_ref import local_ref_count
 
         now = time.monotonic()
         doomed = []
         with self._lock:
             for k, e in self._objects.items():
+                window = self.backstop_s if e.pending_serialized > 0 else self.grace_s
                 if (
                     e.zero_since is not None
-                    and now - e.zero_since > self.grace_s
+                    and now - e.zero_since > window
                     and e.borrows <= 0
                     and e.state != PENDING
                 ):
@@ -357,6 +408,25 @@ def note_hint(k: bytes, owner: str):
 def get_hint(k: bytes) -> str | None:
     with _hints_lock:
         return _hints.get(k)
+
+
+def mark_serialized_out(k: bytes):
+    """ObjectRef.__reduce__ hook: if WE own this id, record that the ref
+    left the process so the owned store waits for the borrow-release
+    instead of the grace timer (see OwnedStore docstring).
+
+    __reduce__ also fires for pickles that never leave the process
+    (deepcopy; a value containing the ref entering the local store or
+    spill). Common local flows drain the counter anyway — a stored/
+    spilled container's contained-ref pin registers a borrow with the
+    owner — and the residual cost for a purely local pickle is bounded:
+    the entry frees after the backstop window (default 30s) instead of
+    the grace window, never leaks. Hooking the real egress path instead
+    would save that delay but needs boundary plumbing at every send
+    site; deliberately not done at this altitude."""
+    st = _state
+    if st is not None and st.owned.owns(k):
+        st.owned.mark_serialized(k)
 
 
 def drop_hint(k: bytes):
@@ -776,7 +846,10 @@ class DirectState:
         self.client = client
         self.authkey = authkey
         self.node_hex = node_hex
-        self.owned = OwnedStore(grace_s=get_config().owned_object_grace_s)
+        self.owned = OwnedStore(
+            grace_s=get_config().owned_object_grace_s,
+            backstop_s=get_config().owned_object_leak_backstop_s,
+        )
         self.exec_handler = exec_handler
         self.cancelled_direct: set = set()
         self.server = DirectServer(self) if serve else None

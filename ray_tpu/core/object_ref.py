@@ -92,6 +92,7 @@ def local_ref_count(obj_id: ObjectID) -> int:
 
 _note_hint = None  # lazily bound direct.note_hint (avoids per-ref import)
 _get_hint = None  # lazily bound direct.get_hint
+_mark_serialized = None  # lazily bound direct.mark_serialized_out
 
 
 class ObjectRef:
@@ -168,6 +169,17 @@ class ObjectRef:
         stack = getattr(_ref_sink, "stack", None)
         if stack:
             stack[-1].append(self.id)
+        global _mark_serialized
+        if _mark_serialized is None:
+            try:
+                from ray_tpu.core.direct import mark_serialized_out as _ms
+
+                _mark_serialized = _ms
+            except ImportError:  # partial teardown
+                _mark_serialized = lambda _k: None  # noqa: E731
+        # if we own this object, the owner store must now wait for the
+        # borrow-release instead of the short grace timer
+        _mark_serialized(self.id.binary())
         hint = self._owner_hint
         if hint is None and _get_hint is not None:
             # a ref rebuilt without its hint attribute (raw-id construction

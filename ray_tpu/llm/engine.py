@@ -3,16 +3,26 @@
 Architecture (TPU-native replacement for the reference's vLLM wrapping in
 python/ray/llm/_internal/serve/engines/vllm/vllm_engine.py):
 
-- a static slot-based KV cache (kv_cache.py) compiled once;
+- a static slot-based KV cache (kv_cache.py) or paged pool (paged_kv.py)
+  compiled once;
 - prompt prefill bucketed to powers of two (one compiled program per
-  bucket, not per prompt length);
-- one jitted decode program advances *all* slots one token per step;
+  bucket, not per prompt length), and BATCHED: same-bucket admissions
+  run as one forward with the batch dim padded to a power of two;
+- a DEVICE-RESIDENT decode loop (Podracer-style): tokens, PRNG keys,
+  sampling params, block tables and lengths live on device; one fused
+  jitted step advances *all* slots one token (decode -> sample ->
+  append-KV -> advance lengths) with the big buffers donated, scheduler
+  changes land as O(1) scatter deltas, and token readback overlaps the
+  next step's dispatch (emission trails the device by one step);
 - a host-side scheduler does admission (waiting queue -> free slot),
   completion (eos / max_tokens / stop ids), and slot recycling between
-  device steps. The device never sees dynamic shapes.
+  device steps against numpy shadow state. The device never sees dynamic
+  shapes, and nothing syncs the host per decode step.
 
-Engine steps are synchronous and cheap to drive from an actor or a Serve
-replica; `generate()` is the batteries-included loop.
+`device_resident=False` (RT_LLM_DEVICE_RESIDENT=0) keeps the old
+synchronous host-driven loop as the equivalence oracle. Engine steps are
+cheap to drive from an actor or a Serve replica; `generate()` is the
+batteries-included loop.
 """
 
 from __future__ import annotations
@@ -200,13 +210,25 @@ class LLMEngine:
         kv_layout: str = "slots",
         num_pages: int | None = None,
         page_size: int = 64,
+        device_resident: bool | None = None,
+        batch_prefill: bool | None = None,
     ):
         """kv_layout: "slots" (static per-sequence rows; llm/kv_cache.py)
         or "paged" (block-table page pool; llm/paged_kv.py — concurrency
         bounded by total pages, vLLM-class memory management). For paged,
         ``num_pages`` sizes the pool (default: the slot-equivalent HBM,
         max_num_seqs * max_seq_len / page_size) and ``page_size`` must
-        divide every prefill bucket and the prefix block."""
+        divide every prefill bucket and the prefix block.
+
+        device_resident (default: RT_LLM_DEVICE_RESIDENT, on): the decode
+        hot path keeps ALL per-step state on device — one fused jitted
+        step per token, scheduler changes applied as scatter deltas, and
+        token readback overlapped with the next step's dispatch (emission
+        trails the device by exactly one step). Off = the synchronous
+        host-driven loop (re-uploads + blocking readback per step), kept
+        as the equivalence oracle. batch_prefill (default:
+        RT_LLM_BATCH_PREFILL, on): same-bucket prompt prefills at
+        admission run as one batched forward."""
         import jax
         import jax.numpy as jnp
 
@@ -318,6 +340,41 @@ class LLMEngine:
         self._prefix_cache = (
             PrefixCache(block=prefix_block, max_bytes=prefix_cache_bytes) if enable_prefix_caching else None
         )
+        self.preemption_count = 0
+
+        from ray_tpu._config import get_config
+
+        _c = get_config()
+        self._device_resident = bool(_c.llm_device_resident if device_resident is None else device_resident)
+        self._batch_prefill = bool(_c.llm_batch_prefill if batch_prefill is None else batch_prefill)
+        # in-flight fused step awaiting host readback:
+        # (tokens [B] dev, logps [B] dev, [(RequestState, slot), ...])
+        self._pending = None
+        if self._device_resident:
+            from ray_tpu.llm.model_runner import make_delta_fns, make_fused_fns, make_fused_paged_fns
+
+            if kv_layout == "paged":
+                self._fused_attn, self._fused_append = make_fused_paged_fns(config)
+            else:
+                self._fused_step = make_fused_fns(config)
+            self._set_lane, self._set_table, self._set_table_cell = make_delta_fns()
+            if mesh is None:
+                _put = jnp.asarray
+            else:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                _repl = NamedSharding(mesh, P())
+                _put = lambda a: jax.device_put(a, _repl)  # noqa: E731
+            # device-resident decode state; host arrays above stay as the
+            # scheduler's shadow copies (never re-uploaded wholesale)
+            self._dtokens = _put(self._next_tokens)
+            self._dkeys = _put(self._keys)
+            self._dtemps = _put(self._temps)
+            self._dtopk = _put(self._top_k)
+            self._dtopp = _put(self._top_p)
+            if kv_layout == "paged":
+                self._dtables = _put(self._tables)
+                self._dlengths = _put(self._lengths)
 
     def _mesh_shardings(self, mesh):
         """Tensor-parallel serving (reference capability: the vLLM engine's
@@ -458,7 +515,7 @@ class LLMEngine:
 
     def has_unfinished(self) -> bool:
         with self._lock:
-            return bool(self._waiting) or any(s is not None for s in self._slots)
+            return bool(self._waiting) or any(s is not None for s in self._slots) or self._pending is not None
 
     @property
     def num_waiting(self) -> int:
@@ -482,11 +539,28 @@ class LLMEngine:
             st.out_queue.put(None)  # sentinel
 
     # ------------------------------------------------------ paged plumbing
+    def _push_table(self, slot: int):
+        """Scatter one slot's block-table row + length into the device
+        decode state (the delta that replaces whole-array re-uploads)."""
+        import jax.numpy as jnp
+
+        self._dtables, self._dlengths = self._set_table(
+            self._dtables,
+            self._dlengths,
+            np.int32(slot),
+            jnp.asarray(self._tables[slot]),
+            np.int32(self._lengths[slot]),
+        )
+
     def _release_slot_pages(self, slot: int):
         self._page_alloc.free(self._slot_pages[slot])
         self._slot_pages[slot] = []
         self._tables[slot, :] = 0
         self._lengths[slot] = 0
+        if self._device_resident:
+            # point the lane at the trash page so in-flight/idle steps
+            # scatter harmlessly instead of into recycled pages
+            self._push_table(slot)
 
     def _preempt_for(self, need: int, exclude: RequestState | None = None) -> bool:
         """Recompute-preemption (vLLM's default policy): the YOUNGEST
@@ -499,6 +573,7 @@ class LLMEngine:
                 return False
             victim = max(victims, key=lambda s: s.admit_seq)
             victim.preemptions += 1
+            self.preemption_count += 1
             slot = victim.slot
             self._release_slot_pages(slot)
             self._slots[slot] = None
@@ -512,9 +587,22 @@ class LLMEngine:
         sequence when the pool is dry; a sequence that cannot grow at all
         preempts itself back to waiting)."""
         page = self._pcfg.page_size
+        pending_lanes = (
+            {id(s) for s, _ in self._pending[2]}
+            if self._device_resident and self._pending is not None
+            else ()
+        )
         for st in [s for s in self._slots if s is not None]:
             if st.slot < 0 or self._slots[st.slot] is not st:
                 continue  # preempted by an earlier iteration's _preempt_for
+            if id(st) in pending_lanes and len(st.token_ids) + 1 >= st.params.max_tokens:
+                # the not-yet-drained token finishes this sequence at
+                # max_tokens: this call's step is its discarded trailing
+                # step — never grow (let alone PREEMPT a live sequence)
+                # for it; the unallocated-page write lands in the trash
+                # page. Matches the sync oracle, where the finish would
+                # already have freed the slot.
+                continue
             slot = st.slot
             pg_ix = int(self._lengths[slot]) // page
             if pg_ix < len(self._slot_pages[slot]):
@@ -528,6 +616,7 @@ class LLMEngine:
             if got is None:
                 # nothing left to preempt: this sequence itself re-queues
                 st.preemptions += 1
+                self.preemption_count += 1
                 self._release_slot_pages(slot)
                 self._slots[slot] = None
                 st.slot = -1
@@ -535,30 +624,21 @@ class LLMEngine:
                 continue
             self._slot_pages[slot].extend(got)
             self._tables[slot, pg_ix] = got[0]
+            if self._device_resident:
+                self._dtables = self._set_table_cell(
+                    self._dtables, np.int32(slot), np.int32(pg_ix), np.int32(got[0])
+                )
 
-    def _paged_admit(self, st: RequestState) -> bool:
-        """Admission on the page pool; False = not enough pages even after
-        preemption (request stays waiting)."""
-        import jax.numpy as jnp
-
+    def _pages_needed(self, st: RequestState, pref, prompt) -> int | None:
+        """Pages a request needs to admit (prompt bucket + one decode
+        headroom page). None = can never fit; the request is finished with
+        an error instead of spinning in the admission loop forever."""
         page = self._pcfg.page_size
-        slot = self._slots.index(None)
-        # preempted sequences resume with generated tokens as prompt tail
-        prompt = st.prompt_token_ids + st.token_ids
         n = len(prompt)
-        pref = None
-        if st.prefilled is None and self._prefix_cache is not None and not st.token_ids:
-            pref = self._prefix_cache.lookup(prompt)
-            if pref is not None:
-                n_p = pref[2]
-                Tm = _bucket(n - n_p, self.prefill_buckets)
-                if n_p + Tm > self.max_seq_len:
-                    pref = None
         if st.prefilled is not None:
-            kv = st.prefilled
             # the transferred KV is bucket-padded; pages cover the padding
             # too (garbage tail is masked by length, overwritten by appends)
-            T_pad = -(-int(kv["k"].shape[1]) // page) * page
+            T_pad = -(-int(st.prefilled["k"].shape[1]) // page) * page
             need = T_pad // page + 1
         elif pref is not None:
             n_p = pref[2]
@@ -572,26 +652,124 @@ class LLMEngine:
         # which finishes the sequence at the row edge)
         need = min(need, self._pcfg.max_pages_per_seq)
         if need > self._pcfg.num_pages - 1:
-            # can never fit (e.g. a preempted sequence re-admitting with
-            # prompt+generated beyond the pool): error out instead of
-            # spinning in the admission loop forever
             self._finish(st, f"error: needs {need} pages, pool holds {self._pcfg.num_pages - 1}")
-            return True
-        # ADMISSION never preempts running sequences: two contenders would
-        # otherwise evict each other inside one admission loop, generating
-        # their whole outputs one-recompute-prefill-per-token while decode
-        # stalls (vLLM semantics: waiting requests wait for free blocks;
-        # only DECODE growth may preempt — _paged_grow)
-        if self._page_alloc.free_pages < need:
-            return False
-        pages = self._page_alloc.alloc(need)
-        if pages is None:
-            return False
-        self._slot_pages[slot] = pages
-        self._tables[slot, :] = 0
-        self._tables[slot, : len(pages)] = pages
-        table_row = jnp.asarray(self._tables[slot])
+            return None
+        return need
 
+    def _admission_wave(self) -> list:
+        """Admit every waiting request that fits right now (FIFO; a
+        head-of-line request that cannot get pages blocks the wave —
+        vLLM semantics: waiting requests wait for free blocks, ADMISSION
+        never preempts running sequences). Plain prefills sharing a
+        bucket run as ONE batched forward instead of B=1 dispatches."""
+        admitted: list[RequestState] = []
+        wave: list[tuple] = []  # (st, slot, pref, pages, prompt)
+        while self._waiting and None in self._slots:
+            st = self._waiting[0]
+            if st.finished:  # aborted while waiting
+                self._waiting.popleft()
+                continue
+            slot = self._slots.index(None)
+            # preempted sequences resume with generated tokens as prompt tail
+            prompt = st.prompt_token_ids + st.token_ids
+            pref = None
+            if st.prefilled is None and self._prefix_cache is not None and not st.token_ids:
+                pref = self._prefix_cache.lookup(prompt)
+                if pref is not None:
+                    n_p = pref[2]
+                    Tm = _bucket(len(prompt) - n_p, self.prefill_buckets)
+                    if n_p + Tm > self.max_seq_len:
+                        # the bucket-padded suffix would overrun the cache
+                        # row (dynamic_update_slice would CLAMP the start
+                        # and silently corrupt the prefix) — full prefill
+                        pref = None
+            pages = None
+            if self.kv_layout == "paged":
+                need = self._pages_needed(st, pref, prompt)
+                if need is None:
+                    self._waiting.popleft()  # finished with an error
+                    continue
+                if self._page_alloc.free_pages < need:
+                    break  # pool full: head-of-line waits
+                pages = self._page_alloc.alloc(need)
+                if pages is None:
+                    break
+            self._waiting.popleft()
+            self._slots[slot] = st  # reserve; _bind_slot fills the rest
+            wave.append((st, slot, pref, pages, prompt))
+        if not wave:
+            return admitted
+        plains: list[tuple] = []
+        for st, slot, pref, pages, prompt in wave:
+            if self.kv_layout == "paged":
+                self._slot_pages[slot] = pages
+                self._tables[slot, :] = 0
+                self._tables[slot, : len(pages)] = pages
+            if st.prefilled is not None or pref is not None:
+                if self.kv_layout == "paged":
+                    self._admit_special_paged(st, slot, pref, prompt)
+                else:
+                    self._admit_special_slots(st, slot, pref, prompt)
+            else:
+                plains.append((st, slot, prompt))
+            admitted.append(st)
+        if plains:
+            for group in self._bucket_groups(plains):
+                self._admit_prefill_batch(group)
+        return admitted
+
+    def _bucket_groups(self, plains):
+        """Group (st, slot, prompt) triples by prefill bucket; without
+        batch_prefill every request is its own group."""
+        if not self._batch_prefill:
+            return [[p] for p in plains]
+        groups: dict[int, list] = {}
+        for item in plains:
+            T = _bucket(len(item[2]), self.prefill_buckets)
+            groups.setdefault(T, []).append(item)
+        return list(groups.values())
+
+    def _admit_prefill_batch(self, group):
+        """One batched forward prefills every prompt in the group (all in
+        the same length bucket). The batch dimension is padded to a power
+        of two so compile count stays (buckets x log2(max_num_seqs));
+        padding rows carry length 1 and produce garbage that is never
+        inserted. This is how forward-only prefill reaches training-step
+        MXU utilization instead of B=1 dispatch overhead."""
+        import jax.numpy as jnp
+
+        T = _bucket(max(len(p) for _, _, p in group), self.prefill_buckets)
+        B = len(group)
+        Bp = 1 << (B - 1).bit_length()
+        toks = np.zeros((Bp, T), np.int32)
+        lens = np.ones((Bp,), np.int32)
+        for i, (_, _, prompt) in enumerate(group):
+            toks[i, : len(prompt)] = prompt
+            lens[i] = len(prompt)
+        logits, ks, vs = self._prefill(self.params, jnp.asarray(toks), jnp.asarray(lens))
+        for i, (st, slot, prompt) in enumerate(group):
+            n = len(prompt)
+            if self._prefix_cache is not None and not st.token_ids:
+                self._prefix_cache.store(prompt, ks[:, i], vs[:, i], self.prefill_buckets)
+            if self.kv_layout == "paged":
+                page = self._pcfg.page_size
+                table_row = jnp.asarray(self._tables[slot])
+                self.pool = self._insert(self.pool, table_row[: T // page], ks[:, i], vs[:, i])
+                self._lengths[slot] = n
+                if self._device_resident:
+                    self._push_table(slot)
+            else:
+                self.cache = self._insert(self.cache, slot, ks[:, i], vs[:, i], n)
+            self._bind_slot(st, slot, logits[i : i + 1])
+
+    def _admit_special_paged(self, st: RequestState, slot: int, pref, prompt):
+        """Paged admission for transferred-KV / prefix-cache-hit requests
+        (pages already allocated and mirrored into the host table)."""
+        import jax.numpy as jnp
+
+        page = self._pcfg.page_size
+        n = len(prompt)
+        table_row = jnp.asarray(self._tables[slot])
         if st.prefilled is not None:
             kv = st.prefilled
             st.prefilled = None
@@ -604,7 +782,7 @@ class LLMEngine:
             self.pool = self._insert(self.pool, table_row[: T_pad // page], jnp.asarray(k_pad), jnp.asarray(v_pad))
             logits = jnp.asarray(kv["logits"])[None]
             self._lengths[slot] = n_real
-        elif pref is not None:
+        else:
             k_p, v_p, n_p = pref
             m = n - n_p
             Tm = _bucket(m, self.prefill_buckets)
@@ -621,17 +799,38 @@ class LLMEngine:
             )
             logits = logits[None]
             self._lengths[slot] = n
-        else:
-            T = _bucket(n, self.prefill_buckets)
-            toks = np.zeros((1, T), np.int32)
-            toks[0, :n] = prompt
-            logits, ks, vs = self._prefill(self.params, jnp.asarray(toks), jnp.asarray([n], np.int32))
-            if self._prefix_cache is not None and not st.token_ids:
-                self._prefix_cache.store(prompt, ks[:, 0], vs[:, 0], self.prefill_buckets)
-            self.pool = self._insert(self.pool, table_row[: T // page], ks[:, 0], vs[:, 0])
-            self._lengths[slot] = n
+        if self._device_resident:
+            self._push_table(slot)
         self._bind_slot(st, slot, logits)
-        return True
+
+    def _admit_special_slots(self, st: RequestState, slot: int, pref, prompt):
+        """Slot-layout admission for transferred-KV / prefix-cache-hit
+        requests."""
+        import jax.numpy as jnp
+
+        n = len(prompt)
+        if st.prefilled is not None:
+            # disaggregated admission: KV arrived from a prefill engine
+            kv = st.prefilled
+            st.prefilled = None
+            self.cache = self._insert(
+                self.cache, slot, jnp.asarray(kv["k"]), jnp.asarray(kv["v"]), int(kv["n"])
+            )
+            logits = jnp.asarray(kv["logits"])[None]
+        else:
+            # reuse the cached prefix KV; re-attend only the suffix
+            k_p, v_p, n_p = pref
+            m = n - n_p
+            Tm = _bucket(m, self.prefill_buckets)
+            self.cache = self._insert(self.cache, slot, k_p, v_p, n_p)
+            toks = np.zeros((Tm,), np.int32)
+            toks[:m] = prompt[n_p:]
+            logits, self.cache = self._extend(
+                self.params, self.cache, slot, jnp.asarray(toks), jnp.asarray(m, np.int32)
+            )
+            logits = logits[None]
+        # sample the first generated token from the prefill logits
+        self._bind_slot(st, slot, logits)
 
     def _bind_slot(self, st: RequestState, slot: int, logits):
         import jax
@@ -646,6 +845,14 @@ class LLMEngine:
         self._top_p[slot] = p.top_p
         if p.seed is not None:
             self._keys[slot] = np.asarray(jax.random.key_data(jax.random.PRNGKey(p.seed)))
+        elif self._device_resident:
+            # the lane's key lives on device (advanced by every fused
+            # step); pull its current value for the first-token sample.
+            # This blocks on the not-yet-drained in-flight step if one is
+            # pending — the price of exact key parity with the sync
+            # oracle, paid only on seedless admissions and bounded by one
+            # step per admission (the prefill about to run dwarfs it).
+            self._keys[slot] = np.asarray(self._dkeys[slot])
         tok, logp, key = self._sample(
             logits,
             jnp.asarray(self._keys[slot : slot + 1]),
@@ -654,146 +861,187 @@ class LLMEngine:
             jnp.asarray(self._top_p[slot : slot + 1]),
         )
         self._keys[slot] = np.asarray(key[0])
-        self._emit(st, int(tok[0]), float(logp[0]))
-
-    def _admit_one(self, st: RequestState):
-        import jax.numpy as jnp
-
-        slot = self._slots.index(None)
-        n = len(st.prompt_token_ids)
-        if st.prefilled is not None:
-            # disaggregated admission: KV arrived from a prefill engine
-            kv = st.prefilled
-            st.prefilled = None
-            self.cache = self._insert(
-                self.cache, slot, jnp.asarray(kv["k"]), jnp.asarray(kv["v"]), int(kv["n"])
+        token = int(tok[0])
+        if self._device_resident:
+            # lane delta: first input token, advanced key, sampling params
+            self._dtokens, self._dkeys, self._dtemps, self._dtopk, self._dtopp = self._set_lane(
+                self._dtokens,
+                self._dkeys,
+                self._dtemps,
+                self._dtopk,
+                self._dtopp,
+                np.int32(slot),
+                np.int32(token),
+                self._keys[slot],
+                np.float32(p.temperature),
+                np.int32(p.top_k),
+                np.float32(p.top_p),
             )
-            logits = jnp.asarray(kv["logits"])[None]
-        else:
-            pref = self._prefix_cache.lookup(st.prompt_token_ids) if self._prefix_cache else None
-            if pref is not None:
-                n_p = pref[2]
-                m = n - n_p
-                Tm = _bucket(m, self.prefill_buckets)
-                if n_p + Tm > self.max_seq_len:
-                    # the bucket-padded suffix would overrun the cache row
-                    # (dynamic_update_slice would CLAMP the start and
-                    # silently corrupt the prefix) — full prefill instead
-                    pref = None
-            if pref is not None:
-                # reuse the cached prefix KV; re-attend only the suffix
-                k_p, v_p, n_p = pref
-                self.cache = self._insert(self.cache, slot, k_p, v_p, n_p)
-                toks = np.zeros((Tm,), np.int32)
-                toks[:m] = st.prompt_token_ids[n_p:]
-                logits, self.cache = self._extend(
-                    self.params, self.cache, slot, jnp.asarray(toks), jnp.asarray(m, np.int32)
-                )
-                logits = logits[None]
-            else:
-                T = _bucket(n, self.prefill_buckets)
-                toks = np.zeros((1, T), np.int32)
-                toks[0, :n] = st.prompt_token_ids
-                logits, ks, vs = self._prefill(self.params, jnp.asarray(toks), jnp.asarray([n], np.int32))
-                if self._prefix_cache is not None:
-                    self._prefix_cache.store(st.prompt_token_ids, ks[:, 0], vs[:, 0], self.prefill_buckets)
-                self.cache = self._insert(self.cache, slot, ks[:, 0], vs[:, 0], n)
-        # sample the first generated token from the prefill logits
-        self._bind_slot(st, slot, logits)
+        self._emit(st, token, float(logp[0]))
 
     def _emit(self, st: RequestState, token: int, logp: float):
         st.token_ids.append(token)
         st.logprobs.append(logp)
         if st.out_queue is not None:
             st.out_queue.put(token)
-        self._next_tokens[st.slot if st.slot >= 0 else 0] = token
+        if st.slot >= 0:
+            self._next_tokens[st.slot] = token
         if token in st.params.stop_token_ids:
             self._finish(st, "stop")
         elif len(st.token_ids) >= st.params.max_tokens:
             self._finish(st, "length")
 
     def step(self) -> list[RequestOutput]:
-        """Admit what fits, run one decode step, return per-request deltas."""
-        import jax.numpy as jnp
+        """Admit what fits, advance decode one step, return per-request
+        deltas.
 
+        Device-resident mode (default): the fused jitted step is
+        DISPATCHED before the previous step's tokens are read back, so
+        step N's host transfer overlaps step N+1's device compute —
+        emission (streaming tokens, finish detection, slot recycling)
+        trails the device by exactly one step, and each sequence runs up
+        to one speculative trailing step whose token is discarded.
+        """
         with self._lock:
-            while self._waiting and None in self._slots:
-                st = self._waiting.popleft()
-                if st.finished:  # aborted while waiting
-                    continue
-                if self.kv_layout == "paged":
-                    if not self._paged_admit(st):
-                        self._waiting.appendleft(st)  # pool full: wait
-                        break
-                else:
-                    self._admit_one(st)
-
+            admitted = self._admission_wave()
             if self.kv_layout == "paged":
                 self._paged_grow()
-            active = [s for s in self._slots if s is not None]
-            outputs: list[RequestOutput] = []
-            if active:
-                if self.kv_layout == "paged":
-                    logits, self.pool, _ = self._decode(
-                        self.params,
-                        self.pool,
-                        jnp.asarray(self._tables),
-                        jnp.asarray(self._lengths),
-                        jnp.asarray(self._next_tokens),
-                    )
-                    for st in active:
-                        self._lengths[st.slot] += 1
-                else:
-                    logits, self.cache = self._decode(self.params, self.cache, jnp.asarray(self._next_tokens))
-                toks, logps, keys = self._sample(
-                    logits,
-                    jnp.asarray(self._keys),
-                    jnp.asarray(self._temps),
-                    jnp.asarray(self._top_k),
-                    jnp.asarray(self._top_p),
-                )
-                toks = np.asarray(toks)
-                logps = np.asarray(logps)
-                self._keys = np.array(keys)
-                for st in active:
-                    slot = st.slot
-                    self._emit(st, int(toks[slot]), float(logps[slot]))
+            if self._device_resident:
+                prev = self._pending
+                self._pending = None
+                self._dispatch_fused()
+                emitted = self._drain(prev)
+                reported = admitted + emitted
+            else:
+                reported = self._sync_decode()
+            return self._build_outputs(reported)
 
-            # build deltas for everything that changed this step
+    def _dispatch_fused(self):
+        """Launch the fused device step for the current occupancy; never
+        blocks on results (stored in self._pending for the next call)."""
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            return
+        if self.kv_layout == "paged":
+            (toks, logps, self._dkeys, k_new, v_new, wp, wo, self._dlengths) = self._fused_attn(
+                self.params,
+                self.pool,
+                self._dtables,
+                self._dlengths,
+                self._dtokens,
+                self._dkeys,
+                self._dtemps,
+                self._dtopk,
+                self._dtopp,
+            )
+            self.pool = self._fused_append(self.pool, wp, wo, k_new, v_new)
             for st in active:
+                self._lengths[st.slot] += 1  # host shadow, no upload
+        else:
+            self.cache, toks, logps, self._dkeys = self._fused_step(
+                self.params,
+                self.cache,
+                self._dtokens,
+                self._dkeys,
+                self._dtemps,
+                self._dtopk,
+                self._dtopp,
+            )
+        self._dtokens = toks
+        self._pending = (toks, logps, [(st, st.slot) for st in active])
+
+    def _drain(self, pending) -> list:
+        """Read back and emit the PREVIOUS step's tokens (blocks only on
+        work that overlapped the current step's dispatch)."""
+        if pending is None:
+            return []
+        toks_d, logps_d, lanes = pending
+        toks = np.asarray(toks_d)
+        logps = np.asarray(logps_d)
+        emitted = []
+        for st, slot in lanes:
+            if st.finished:
+                continue  # aborted (or finished) between dispatch and drain
+            self._emit(st, int(toks[slot]), float(logps[slot]))
+            emitted.append(st)
+        return emitted
+
+    def _sync_decode(self) -> list:
+        """The synchronous host-driven step (device_resident=False): full
+        re-upload of scheduler state, blocking readback before return.
+        Kept as the decode-equivalence oracle and host-debug mode."""
+        import jax.numpy as jnp
+
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            return []
+        if self.kv_layout == "paged":
+            logits, self.pool, _ = self._decode(
+                self.params,
+                self.pool,
+                jnp.asarray(self._tables),
+                jnp.asarray(self._lengths),
+                jnp.asarray(self._next_tokens),
+            )
+            for st in active:
+                self._lengths[st.slot] += 1
+        else:
+            logits, self.cache = self._decode(self.params, self.cache, jnp.asarray(self._next_tokens))
+        toks, logps, keys = self._sample(
+            logits,
+            jnp.asarray(self._keys),
+            jnp.asarray(self._temps),
+            jnp.asarray(self._top_k),
+            jnp.asarray(self._top_p),
+        )
+        toks = np.asarray(toks)
+        logps = np.asarray(logps)
+        self._keys = np.array(keys)
+        for st in active:
+            self._emit(st, int(toks[st.slot]), float(logps[st.slot]))
+        return active
+
+    def _build_outputs(self, reported: list) -> list[RequestOutput]:
+        """Per-request deltas for everything that changed this step."""
+        outputs: list[RequestOutput] = []
+        seen: set = set()
+        for st in reported:
+            if st.request_id in seen:
+                continue
+            seen.add(st.request_id)
+            outputs.append(
+                RequestOutput(
+                    request_id=st.request_id,
+                    prompt_token_ids=st.prompt_token_ids,
+                    token_ids=list(st.token_ids),
+                    new_token_ids=st.token_ids[-1:],
+                    finished=st.finished,
+                    finish_reason=st.finish_reason,
+                    logprobs=list(st.logprobs) if st.params.logprobs else None,
+                    streamed=st.out_queue is not None,
+                )
+            )
+        # also report requests finished outside the decode path (aborts,
+        # admission errors)
+        for st in list(self._requests.values()):
+            if st.finished and st.request_id not in seen and st.request_id in self._requests:
                 outputs.append(
                     RequestOutput(
                         request_id=st.request_id,
                         prompt_token_ids=st.prompt_token_ids,
                         token_ids=list(st.token_ids),
-                        new_token_ids=st.token_ids[-1:],
-                        finished=st.finished,
+                        new_token_ids=[],
+                        finished=True,
                         finish_reason=st.finish_reason,
                         logprobs=list(st.logprobs) if st.params.logprobs else None,
                         streamed=st.out_queue is not None,
                     )
                 )
-            # also report requests finished during this step's admission
-            done_ids = {o.request_id for o in outputs}
-            for st in list(self._requests.values()):
-                if st.finished and st.request_id not in done_ids and st.request_id in self._requests:
-                    outputs.append(
-                        RequestOutput(
-                            request_id=st.request_id,
-                            prompt_token_ids=st.prompt_token_ids,
-                            token_ids=list(st.token_ids),
-                            new_token_ids=[],
-                            finished=True,
-                            finish_reason=st.finish_reason,
-                            logprobs=list(st.logprobs) if st.params.logprobs else None,
-                            streamed=st.out_queue is not None,
-                        )
-                    )
-                    del self._requests[st.request_id]
-            for o in outputs:
-                if o.finished and o.request_id in self._requests:
-                    del self._requests[o.request_id]
-            return outputs
+                del self._requests[st.request_id]
+        for o in outputs:
+            if o.finished and o.request_id in self._requests:
+                del self._requests[o.request_id]
+        return outputs
 
     def generate(self, prompts, params: SamplingParams | list | None = None) -> list[RequestOutput]:
         """Blocking batch generation with continuous batching underneath."""

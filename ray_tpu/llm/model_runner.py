@@ -321,6 +321,73 @@ def extend_paged(params, pool, table_row, start, tokens, length, cfg: LlamaConfi
     return logits, pool
 
 
+def make_fused_fns(cfg: LlamaConfig):
+    """ONE jitted program for the slot layout's whole decode hot path:
+    decode -> sample -> append-KV -> advance lengths, cache and PRNG keys
+    donated. Nothing in it touches the host; the engine reads tokens back
+    asynchronously one step behind the dispatch (device-resident loop).
+
+    tokens is deliberately NOT donated: its buffer is the previous step's
+    sampled-token output, which the engine still holds for the delayed
+    host readback when this program is dispatched.
+    """
+    from ray_tpu.llm.sampling import sample
+
+    def fused(params, cache, tokens, keys, temps, top_k, top_p):
+        logits, cache = decode_step(params, cache, tokens, cfg)
+        toks, logps, new_keys = sample(logits, keys, temps, top_k, top_p)
+        return cache, toks, logps, new_keys
+
+    return jax.jit(fused, donate_argnums=(1, 3))
+
+
+def make_fused_paged_fns(cfg: LlamaConfig):
+    """Device-resident decode step for the paged layout: TWO programs
+    (attention+sample, then scatter-append) because a single program that
+    both gathers from and scatters into the pool buffer is the aliasing
+    hazard documented on decode_attn_paged — but neither program ever
+    syncs with the host. lengths and keys are donated; tokens is not
+    (same delayed-readback rationale as make_fused_fns); tables is read
+    every step and mutated only by scheduler deltas."""
+    from ray_tpu.llm.sampling import sample
+
+    def attn_sample(params, pool, tables, lengths, tokens, keys, temps, top_k, top_p):
+        write_page, write_off = decode_write_targets(tables, lengths, pool["k"].shape[2])
+        logits, k_new, v_new = decode_attn_paged(params, pool, tables, lengths, tokens, cfg)
+        toks, logps, new_keys = sample(logits, keys, temps, top_k, top_p)
+        return toks, logps, new_keys, k_new, v_new, write_page, write_off, lengths + 1
+
+    attn_fn = jax.jit(attn_sample, donate_argnums=(3, 5))
+    append_fn = jax.jit(append_paged, donate_argnums=(0,))
+    return attn_fn, append_fn
+
+
+def make_delta_fns():
+    """Jitted scatter updates for scheduler deltas on device-resident
+    decode state (admission / eviction / page growth). Each compiles once
+    (slot/index are traced scalars) and touches O(1) elements — the
+    replacement for re-uploading whole host arrays every step. Nothing is
+    donated: the engine may still hold the previous buffers for an
+    in-flight step's delayed readback."""
+
+    def set_lane(tokens, keys, temps, top_k, top_p, slot, token, key, temp, tk, tp):
+        return (
+            tokens.at[slot].set(token),
+            keys.at[slot].set(key),
+            temps.at[slot].set(temp),
+            top_k.at[slot].set(tk),
+            top_p.at[slot].set(tp),
+        )
+
+    def set_table(tables, lengths, slot, row, length):
+        return tables.at[slot].set(row), lengths.at[slot].set(length)
+
+    def set_table_cell(tables, slot, pg_ix, page):
+        return tables.at[slot, pg_ix].set(page)
+
+    return jax.jit(set_lane), jax.jit(set_table), jax.jit(set_table_cell)
+
+
 def make_runner_fns(cfg: LlamaConfig):
     """Jitted (prefill, insert, decode, extend) closures for an engine."""
     from ray_tpu.llm import kv_cache as kvc

@@ -464,3 +464,80 @@ def test_owned_ready_poll_timeout_means_not_ready(monkeypatch):
     _St.get_conn = lambda self, addr: _GoneConn()
     # ...but a DEAD owner still reports ready so get() surfaces the error
     assert direct.owned_ready(b"k") is True
+
+
+def test_owned_store_serialized_out_waits_for_borrow_release():
+    """ADVICE r5 (direct.py premature-free): an owned entry whose ref was
+    serialized out must NOT be freed by the short grace timer while its
+    borrower may still be registering — the timer degrades to the leak
+    backstop; an explicit borrow release restores the short grace."""
+    from ray_tpu.core.payloads import Payload
+
+    store = direct.OwnedStore(grace_s=0.05, backstop_s=0.5)
+    pay = Payload(shm=None, inline=b"x")
+
+    # never serialized: freed after the short grace
+    store.put_ready(b"a" * 20, pay)
+    store._objects[b"a" * 20].zero_since = time.monotonic() - 0.1
+    store.gc_pass()
+    assert store.entry(b"a" * 20) is None
+
+    # serialized out, no borrow registered yet: survives the grace window
+    store.put_ready(b"b" * 20, pay)
+    store.mark_serialized(b"b" * 20)
+    store._objects[b"b" * 20].zero_since = time.monotonic() - 0.1
+    store.gc_pass()
+    assert store.entry(b"b" * 20) is not None, "grace timer premature-freed a serialized-out ref"
+    # ... but the leak backstop still reclaims a borrower that died
+    # before registering
+    store._objects[b"b" * 20].zero_since = time.monotonic() - 1.0
+    store.gc_pass()
+    assert store.entry(b"b" * 20) is None
+
+    # serialized out, borrow registered then explicitly released: the
+    # release is the causal free signal; the short grace applies again
+    store.put_ready(b"c" * 20, pay)
+    store.mark_serialized(b"c" * 20)
+    store.on_borrow(b"c" * 20, True)
+    store.gc_pass()
+    assert store.entry(b"c" * 20) is not None  # borrowed: pinned
+    store.on_borrow(b"c" * 20, False)  # explicit release starts the clock
+    e = store._objects[b"c" * 20]
+    assert e.zero_since is not None
+    e.zero_since = time.monotonic() - 0.1
+    store.gc_pass()
+    assert store.entry(b"c" * 20) is None
+
+    # a LATER serialization re-opens the registration race even after a
+    # completed borrow cycle: the backstop must apply again, per copy
+    store.put_ready(b"d" * 20, pay)
+    store.mark_serialized(b"d" * 20)
+    store.on_borrow(b"d" * 20, True)
+    store.on_borrow(b"d" * 20, False)  # first borrower came and went
+    store.mark_serialized(b"d" * 20)  # second copy in flight, unregistered
+    store._objects[b"d" * 20].zero_since = time.monotonic() - 0.1
+    store.gc_pass()
+    assert store.entry(b"d" * 20) is not None, "re-serialized ref lost backstop protection"
+    store._objects[b"d" * 20].zero_since = time.monotonic() - 1.0
+    store.gc_pass()
+    assert store.entry(b"d" * 20) is None
+
+
+def test_owned_store_backstop_flag_plumbed():
+    """RT_OWNED_OBJECT_LEAK_BACKSTOP_S reaches the OwnedStore."""
+    import os
+
+    from ray_tpu import _config
+
+    os.environ["RT_OWNED_OBJECT_LEAK_BACKSTOP_S"] = "7.5"
+    try:
+        _config.reset_config()
+        assert _config.get_config().owned_object_leak_backstop_s == 7.5
+        store = direct.OwnedStore(
+            grace_s=_config.get_config().owned_object_grace_s,
+            backstop_s=_config.get_config().owned_object_leak_backstop_s,
+        )
+        assert store.backstop_s == 7.5
+    finally:
+        del os.environ["RT_OWNED_OBJECT_LEAK_BACKSTOP_S"]
+        _config.reset_config()

@@ -153,19 +153,18 @@ def test_paged_higher_concurrency_same_hbm():
         kv_layout="paged", page_size=64,
         num_pages=9, enable_prefix_caching=False,  # 2 slots' worth + trash
     )
-    peak = {"n": 0}
-    orig = eng._paged_admit
-
-    def spy(st):
-        ok = orig(st)
-        peak["n"] = max(peak["n"], sum(1 for s in eng._slots if s is not None))
-        return ok
-
-    eng._paged_admit = spy
     prompts = _prompts(4, lo=30, hi=50, seed=1)
-    outs = eng.generate(prompts, _g(10))
+    ids = [eng.add_request(p, _g(10)) for p in prompts]
+    finals = {}
+    peak = 0
+    while eng.has_unfinished():
+        for o in eng.step():
+            if o.finished:
+                finals[o.request_id] = o
+        peak = max(peak, eng.num_running)
+    outs = [finals[i] for i in ids]
     assert all(len(o.token_ids) == 10 for o in outs)
-    assert peak["n"] >= 3, f"paging should beat the 2-slot HBM equivalent (peak {peak['n']})"
+    assert peak >= 3, f"paging should beat the 2-slot HBM equivalent (peak {peak})"
     assert eng._page_alloc.free_pages == 8
 
 
