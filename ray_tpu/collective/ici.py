@@ -20,9 +20,30 @@ import functools
 import numpy as np
 
 from ray_tpu.collective.types import ReduceOp
+from ray_tpu.lint import jaxcheck
+
+
+def _bucket_reduce(W=8, rows=256, cols=1024):
+    import jax
+    import jax.numpy as jnp
+
+    return (jax.ShapeDtypeStruct((W, rows, cols), jnp.float32),), {}
+
+
+@jaxcheck.entry(
+    name="collective.ici.reduce_stacked",
+    shapes={"w8_256x1024": _bucket_reduce},
+    # no explicit collective primitives: the all-reduce is GSPMD-inserted
+    # by the P('d') -> P() resharding, so the jaxpr must stay collective-
+    # free and host-free — exactly what JXC002/JXC005 assert here
+    mesh_axes=(),
+)
+def _reduce_sum_stacked(x):
+    return x.sum(axis=0)
+
 
 _REDUCERS = {
-    ReduceOp.SUM: lambda x: x.sum(axis=0),
+    ReduceOp.SUM: _reduce_sum_stacked,
     ReduceOp.PRODUCT: lambda x: x.prod(axis=0),
     ReduceOp.MIN: lambda x: x.min(axis=0),
     ReduceOp.MAX: lambda x: x.max(axis=0),

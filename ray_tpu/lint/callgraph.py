@@ -1,0 +1,95 @@
+"""Module-level call graph for interprocedural AST rules.
+
+One level deep, by design: rules that follow a call resolve it to a
+definition in the SAME module (bare ``helper(...)`` to a module-level
+def) and inspect that body lexically — they do not chase further calls.
+That catches the dominant refactor pattern (hazard hoisted into a local
+helper, invisible to a purely lexical rule) without building a whole-
+program analysis whose approximations would drown the signal.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.lint.engine import call_keyword, dotted
+
+# The single definition of "a blocking runtime call" — blocking_get.py
+# (lexical pass) and the interprocedural helpers below both consume
+# these, so the two passes cannot drift apart.
+BLOCKING_ATTRS = {"get", "wait"}
+BLOCKING_MODULES = {"ray", "ray_tpu", "rt"}
+
+
+def blocking_ray_call(node: ast.Call) -> tuple[str, bool] | None:
+    """(dotted name, bounded?) when ``node`` is ``ray.get()``/``ray.wait()``
+    style; None otherwise. ``bounded`` means a ``timeout=`` was passed."""
+    name = dotted(node.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if len(parts) == 2 and parts[0] in BLOCKING_MODULES and parts[1] in BLOCKING_ATTRS:
+        return name, call_keyword(node, "timeout") is not None
+    return None
+
+
+class CallGraph:
+    """Resolves intra-module calls and answers the per-callee questions
+    the interprocedural rules ask."""
+
+    def __init__(self, tree: ast.Module):
+        self.module_fns: dict[str, ast.FunctionDef | ast.AsyncFunctionDef] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_fns[node.name] = node
+
+    def resolve(self, call: ast.Call) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+        """``helper(...)`` -> the module-level def, else None. Attribute
+        calls (``self.x()``, ``mod.f()``) are out of scope: methods are
+        already visited in their defining class's context, and foreign
+        modules are other files."""
+        if isinstance(call.func, ast.Name):
+            return self.module_fns.get(call.func.id)
+        return None
+
+    def blocking_calls(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[tuple[ast.Call, str, bool]]:
+        """(call node, dotted name, bounded?) for every ray.get()/
+        ray.wait() in ``fn``'s lexical body (nested defs excluded —
+        defining a closure executes nothing). Callers decide whether a
+        ``timeout=`` bound clears the hazard: it does for actor-deadlock,
+        it does NOT for an event loop, which a bounded get still parks."""
+        out: list[tuple[ast.Call, str, bool]] = []
+        for node in _walk_body(fn):
+            if isinstance(node, ast.Call):
+                hit = blocking_ray_call(node)
+                if hit is not None:
+                    out.append((node, hit[0], hit[1]))
+        return out
+
+    def returns_object_ref(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+        """True when some ``return`` in ``fn``'s lexical body returns a
+        ``.remote()`` call (directly or in a tuple) — the caller receives
+        an ObjectRef it must not drop."""
+        for node in _walk_body(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                values = node.value.elts if isinstance(node.value, ast.Tuple) else [node.value]
+                for v in values:
+                    if (
+                        isinstance(v, ast.Call)
+                        and isinstance(v.func, ast.Attribute)
+                        and v.func.attr == "remote"
+                    ):
+                        return True
+        return False
+
+
+def _walk_body(fn: ast.FunctionDef | ast.AsyncFunctionDef):
+    """ast.walk over the function body, skipping nested function/class
+    definitions (their bodies don't run when ``fn`` runs)."""
+    stack: list[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

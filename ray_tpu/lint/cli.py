@@ -5,12 +5,22 @@ Exit codes: 0 clean (or baseline-covered), 1 new OR stale findings
 re-accepted or its unused budget silently absorbs a reintroduction),
 2 usage error. Also reachable as ``python -m ray_tpu.scripts.cli lint``.
 
+``--jax`` adds the jaxpr-level pass (ray_tpu/lint/jaxcheck/): registered
+entry points are imported and traced abstractly, and JXC findings merge
+into the same baseline/suppression stream as the AST rules.
+
+``--format=json`` emits ONE finding per line (JSON Lines: rule, path,
+line, col, fingerprint, message, context) so CI and editors can consume
+findings without parsing the human format; stale baseline entries follow
+as lines with ``"stale": true``.
+
 Baseline entries are judged only when this run could have re-found them:
 an entry whose file is outside the linted paths, or whose rule was
-deselected, is neither consulted for suppression nor reported stale —
-so ``--select``/subset runs never produce phantom staleness, and
-``--update-baseline`` on a subset MERGES (entries outside the run's
-coverage are kept verbatim, never silently deleted).
+deselected (JXC rules count as deselected when --jax is off), is neither
+consulted for suppression nor reported stale — so ``--select``/subset
+runs never produce phantom staleness, and ``--update-baseline`` on a
+subset MERGES (entries outside the run's coverage are kept verbatim,
+never silently deleted).
 """
 
 from __future__ import annotations
@@ -26,16 +36,15 @@ from ray_tpu.lint.rules import all_rules, rule_catalog
 
 
 def _coverage(paths: list[str], root: str, rule_ids: set[str]):
-    """entry -> bool: could this run have re-found the entry?"""
+    """(rule, path) -> bool: could this run have re-found it?"""
     rel_roots = []
     for p in paths:
         rel = os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
         rel_roots.append("" if rel == "." else rel)
 
-    def covered(entry: dict) -> bool:
-        if entry.get("rule") not in rule_ids:
+    def covered(rule: str, path: str) -> bool:
+        if rule not in rule_ids:
             return False
-        path = entry.get("path", "")
         return any(r == "" or path == r or path.startswith(r + "/") for r in rel_roots)
 
     return covered
@@ -44,7 +53,7 @@ def _coverage(paths: list[str], root: str, rule_ids: set[str]):
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m ray_tpu.lint",
-        description="tpulint: AST-based distributed-runtime & JAX hazard analyzer",
+        description="tpulint: AST + jaxpr static analyzer for distributed-runtime & TPU hazards",
     )
     p.add_argument("paths", nargs="*", default=["ray_tpu"], help="files/dirs to lint (default: ray_tpu)")
     p.add_argument("--root", default=None, help="path fingerprints are stored relative to (default: cwd)")
@@ -52,7 +61,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-baseline", action="store_true", help="report every finding; ignore the baseline")
     p.add_argument("--update-baseline", action="store_true", help="accept current findings into the baseline and exit 0")
     p.add_argument("--select", default=None, help="comma-separated rule ids/names to run (default: all)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--jax", action="store_true", help="also trace registered entry points and run the JXC jaxpr rules")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="json = one finding per line (JSON Lines)")
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("--stats", action="store_true", help="print per-rule totals")
     return p
@@ -61,27 +72,62 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
-        for rid, name, summary in rule_catalog():
+        from ray_tpu.lint.jaxcheck import jax_rule_catalog
+
+        for rid, name, summary in rule_catalog() + jax_rule_catalog():
             print(f"{rid}  {name:34s} {summary}")
         return 0
 
     select = {s.strip() for s in args.select.split(",") if s.strip()} if args.select else None
     rules = all_rules(select)
-    if select and not rules:
+    root = os.path.abspath(args.root or os.getcwd())
+
+    jax_rules: list = []
+    if args.jax:
+        from ray_tpu.lint.jaxcheck.rules import all_jax_rules
+
+        jax_rules = all_jax_rules(select)
+    if select and not rules and not jax_rules:
         print(f"no rules match --select {args.select}", file=sys.stderr)
         return 2
-    root = os.path.abspath(args.root or os.getcwd())
     try:
-        findings = lint_paths(args.paths, root=root, rules=rules)
+        if rules:
+            findings = lint_paths(args.paths, root=root, rules=rules)
+        else:
+            # jax-only --select: skip the (pointless) full-tree parse but
+            # keep the typo'd-path usage error the parse would have raised
+            from ray_tpu.lint.engine import iter_py_files
+
+            list(iter_py_files(args.paths))  # walks dirs only, reads no files
+            findings = []
     except FileNotFoundError as e:
         print(f"tpulint: {e}", file=sys.stderr)
         return 2
-    covered = _coverage(args.paths, root, {r.id for r in rules})
+
+    if args.jax and jax_rules:
+        from ray_tpu.lint.jaxcheck import registry, run_jaxcheck
+
+        jax_findings = run_jaxcheck(root=root, select=select)
+        n_entries = len(registry.all_entries())
+        print(f"tpulint: jaxcheck traced {n_entries} entry point(s)", file=sys.stderr)
+        # subset runs keep subset semantics: a jax finding outside the
+        # linted paths is invisible, exactly like an AST finding would be
+        path_cov = _coverage(args.paths, root, {r.id for r in jax_rules} | {"JXCERR"})
+        findings = sorted(
+            findings + [f for f in jax_findings if path_cov(f.rule, f.path)],
+            key=lambda f: (f.path, f.line, f.col, f.rule),
+        )
+
+    # JXCERR is "covered" only when the jax pass actually ran (it always
+    # emits trace failures regardless of --select); otherwise a baseline
+    # JXCERR entry would go phantom-stale on --jax --select TPL00x runs
+    rule_ids = {r.id for r in rules} | {r.id for r in jax_rules} | ({"JXCERR"} if (args.jax and jax_rules) else set())
+    covered = _coverage(args.paths, root, rule_ids)
 
     bl_path = args.baseline or baseline_mod.default_baseline_path()
     if args.update_baseline:
         prior = baseline_mod.load(bl_path)
-        kept = {fp: e for fp, e in prior.items() if not covered(e)}
+        kept = {fp: e for fp, e in prior.items() if not covered(e.get("rule"), e.get("path", ""))}
         merged = {**kept, **baseline_mod.entries_from_findings(findings)}
         n = baseline_mod.save_entries(bl_path, merged)
         print(
@@ -91,15 +137,20 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     entries = {} if args.no_baseline else baseline_mod.load(bl_path)
-    entries = {fp: e for fp, e in entries.items() if covered(e)}
+    entries = {fp: e for fp, e in entries.items() if covered(e.get("rule"), e.get("path", ""))}
     d = baseline_mod.diff(findings, entries)
 
     if args.format == "json":
-        print(json.dumps({
-            "new": [f.__dict__ for f in d.new],
-            "suppressed": d.suppressed,
-            "stale": d.stale,
-        }, indent=1))
+        for f in d.new:
+            print(json.dumps({
+                "rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+                "fingerprint": f.fingerprint(), "message": f.message, "context": f.context,
+            }, sort_keys=False))
+        for e in d.stale:
+            print(json.dumps({
+                "stale": True, "rule": e.get("rule"), "path": e.get("path"),
+                "fingerprint": e.get("fingerprint"), "unused": e.get("unused"),
+            }, sort_keys=False))
     else:
         for f in d.new:
             print(f.render())
@@ -116,8 +167,8 @@ def main(argv: list[str] | None = None) -> int:
                 f"{e.get('unused', '?')}) — fixed? re-run with --update-baseline to drop it",
                 file=sys.stderr,
             )
-        tail = f"{len(d.new)} new finding(s), {d.suppressed} baseline-suppressed, {len(d.stale)} stale"
-        print(f"tpulint: {tail}", file=sys.stderr)
+    tail = f"{len(d.new)} new finding(s), {d.suppressed} baseline-suppressed, {len(d.stale)} stale"
+    print(f"tpulint: {tail}", file=sys.stderr)
     # stale fails too: unused budget left in place would silently absorb
     # the next reintroduction of the same finding
     return 1 if (d.new or d.stale) else 0

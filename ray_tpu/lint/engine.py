@@ -168,16 +168,23 @@ class ScopedVisitor(ast.NodeVisitor):
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
-def _suppressed(ctx: FileContext, f: Finding) -> bool:
-    if not (1 <= f.line <= len(ctx.lines)):
+def finding_suppressed(lines: list[str], f: Finding) -> bool:
+    """Inline ``# tpulint: disable=`` check against the flagged line.
+    Shared by the AST pass (via FileContext) and jaxcheck (which reads
+    the entry's source file itself)."""
+    if not (1 <= f.line <= len(lines)):
         return False
-    m = _SUPPRESS_RE.search(ctx.lines[f.line - 1])
+    m = _SUPPRESS_RE.search(lines[f.line - 1])
     if m is None:
         return False
     spec = m.group(1)
     if spec.strip() == "all":
         return True
     return f.rule in {s.strip() for s in spec.split(",")}
+
+
+def _suppressed(ctx: FileContext, f: Finding) -> bool:
+    return finding_suppressed(ctx.lines, f)
 
 
 def lint_source(source: str, path: str = "<string>", rules: Iterable[Rule] | None = None) -> list[Finding]:

@@ -1,0 +1,30 @@
+"""jaxcheck: trace-based jaxpr/SPMD hazard analysis.
+
+tpulint's AST rules see source text; jaxcheck sees the *program*. Entry
+points register themselves with ``@jaxcheck.entry(shapes=...)`` (a
+decorator on the module-level fns the production ``jax.jit`` calls
+wrap), and the checker traces each one to a jaxpr with abstract inputs —
+no FLOPs, no devices touched — then checks TPU invariants no AST rule
+can express: donation coverage of the hot-loop buffers (JXC001), host
+round trips inside a step (JXC002), silent bf16→f32 upcasts on
+flops-dominant ops (JXC003), Python scalars that drive per-value
+recompilation (JXC004), collective axis names that escape the declared
+mesh or diverge across cond branches (JXC005), and (8,128) tile padding
+waste (JXC006).
+
+Findings flow through the same engine as the AST rules: identical
+``Finding`` objects, fingerprints, baseline budgets, ``--select``, and
+inline ``# tpulint: disable=JXC00x`` suppression on the registered
+def's line.
+"""
+
+from ray_tpu.lint.jaxcheck.registry import (  # noqa: F401
+    ENTRY_MODULES,
+    EntrySpec,
+    all_entries,
+    clear_registry,
+    entry,
+    get_entry,
+)
+from ray_tpu.lint.jaxcheck.driver import import_entry_modules, run_jaxcheck  # noqa: F401
+from ray_tpu.lint.jaxcheck.rules import jax_rule_catalog, jax_rule_ids  # noqa: F401

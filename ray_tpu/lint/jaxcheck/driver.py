@@ -1,0 +1,115 @@
+"""jaxcheck driver: import entry modules, trace every registered entry,
+run the JXC rules, and hand back engine ``Finding``s.
+
+Failure posture: a registered entry that cannot trace is itself a
+finding (``JXCERR``), never a crash and never a silent skip — an entry
+that stops tracing is an invariant check that stopped running. The
+usual cause is a genuine hazard anyway (a ``jax.device_get``/``np.``
+coercion inside the step concretizes a tracer and raises here).
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import os
+from dataclasses import replace
+
+from ray_tpu.lint.engine import Finding, finding_suppressed
+from ray_tpu.lint.jaxcheck import registry
+from ray_tpu.lint.jaxcheck.rules import all_jax_rules
+from ray_tpu.lint.jaxcheck.tracing import ensure_trace_env, trace_bucket
+
+
+def import_entry_modules(modules: tuple[str, ...] = registry.ENTRY_MODULES) -> None:
+    """Importing the host modules runs their ``@jaxcheck.entry``
+    decorators. Sets up the CPU trace backend first if jax is not yet in."""
+    ensure_trace_env()
+    for mod in modules:
+        importlib.import_module(mod)
+
+
+def run_jaxcheck(
+    root: str | None = None,
+    select: set[str] | None = None,
+    modules: tuple[str, ...] | None = None,
+    entries=None,
+) -> list[Finding]:
+    """Trace all registered entries (importing ``modules`` first unless an
+    explicit ``entries`` list is given) and return rule findings with
+    paths relative to ``root``, inline suppressions already applied."""
+    root = os.path.abspath(root or os.getcwd())
+    if entries is None:
+        import_entry_modules(modules if modules is not None else registry.ENTRY_MODULES)
+        entries = registry.all_entries()
+    rules = all_jax_rules(select)
+    out: list[Finding] = []
+    lines_cache: dict[str, list[str]] = {}
+    for spec in entries:
+        rel = os.path.relpath(os.path.abspath(spec.path), root).replace(os.sep, "/") if spec.path else "<entry>"
+        if rel not in lines_cache:
+            try:
+                with open(os.path.abspath(spec.path), encoding="utf-8", errors="replace") as fh:
+                    lines_cache[rel] = fh.read().splitlines()
+            except OSError:
+                lines_cache[rel] = []
+        src_lines = lines_cache[rel]
+        def_line, arg_lines = _def_location(src_lines, spec)
+        spec = replace(spec, path=rel, line=def_line, arg_lines=arg_lines)
+        findings: list[Finding] = []
+        traced = []
+        for bucket in sorted(spec.shapes):
+            try:
+                traced.append(trace_bucket(spec, bucket))
+            except Exception as e:  # noqa: BLE001 — any trace failure is the finding
+                findings.append(Finding(
+                    rule="JXCERR", path=rel, line=spec.line, col=0,
+                    message=(
+                        f"entry failed to trace bucket '{bucket}': {type(e).__name__}: "
+                        f"{str(e).splitlines()[0] if str(e) else ''} (a concretization error "
+                        "here usually means a host sync inside the step)"
+                    ),
+                    context=f"jaxcheck:{spec.name}",
+                ))
+        for rule in rules:
+            # same posture as bucket tracing: a rule that blows up (e.g. a
+            # JXC004 probe value whose re-trace raises) degrades to a
+            # finding, never a crashed lint run
+            try:
+                findings.extend(rule.check(spec, traced))
+            except Exception as e:  # noqa: BLE001
+                findings.append(Finding(
+                    rule="JXCERR", path=rel, line=spec.line, col=0,
+                    message=(
+                        f"rule {rule.id} failed on this entry: {type(e).__name__}: "
+                        f"{str(e).splitlines()[0] if str(e) else ''}"
+                    ),
+                    context=f"jaxcheck:{spec.name}",
+                ))
+        out.extend(f for f in findings if not finding_suppressed(src_lines, f))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def _def_location(src_lines: list[str], spec) -> tuple[int, dict[str, int]]:
+    """(def line, {param -> signature line}) for the registered function.
+    ``co_firstlineno`` points at the first decorator; findings anchor at
+    the ``def`` (entry-wide rules) or the parameter's own signature line
+    (per-argument rules), which is where inline disables + rationale
+    comments live — a multi-line signature scopes a disable to one arg."""
+    name = getattr(spec.fn, "__name__", "")
+    try:
+        tree = ast.parse("\n".join(src_lines))
+    except SyntaxError:
+        return spec.line, {}
+    best = None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node.name == name:
+            # pick the def nearest (at or after) the registration line
+            if best is None or abs(node.lineno - spec.line) < abs(best.lineno - spec.line):
+                best = node
+    if best is None:
+        return spec.line, {}
+    a = best.args
+    params = [*a.posonlyargs, *a.args, *(p for p in [a.vararg] if p), *a.kwonlyargs, *(p for p in [a.kwarg] if p)]
+    return best.lineno, {p.arg: p.lineno for p in params}

@@ -1,0 +1,305 @@
+"""The six jaxpr-level rules. Each checks one TPU invariant the AST pass
+cannot see, over the traced buckets of one entry.
+
+Findings reuse the engine's ``Finding`` dataclass and anchor at the
+registered def's line — that line is where an inline
+``# tpulint: disable=JXC00x`` plus rationale comment lives, and the
+fingerprint context is ``jaxcheck:<entry name>`` so baselines survive
+any edit that doesn't change the traced program's verdict.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter
+from typing import Iterator
+
+from ray_tpu.lint.engine import Finding
+from ray_tpu.lint.jaxcheck.tracing import (
+    TracedBucket,
+    _sub_jaxprs,
+    aval_bytes,
+    canonical,
+    fmt_aval,
+    iter_eqns,
+    iter_jaxprs,
+    trace_bucket,
+)
+
+# TPU vector tiling: the last two dims of an operand land in (sublane,
+# lane) = (8, 128) tiles (f32 granularity; narrower dtypes pack more
+# sublanes but never fewer — (8, 128) is the conservative floor the
+# ISSUE's budget is defined against).
+_TILE = (8, 128)
+
+_CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback", "callback"}
+_COLLECTIVE_PRIMS = {
+    "psum", "pmax", "pmin", "ppermute", "pbroadcast", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "axis_index",
+}
+
+
+class JaxRule:
+    id = "JXC000"
+    name = "abstract"
+    summary = ""
+
+    def check(self, spec, traced: list[TracedBucket]) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, spec, message: str, arg: str | None = None) -> Finding:
+        # path is rewritten root-relative by the driver; per-argument
+        # findings anchor at the argument's signature line when known
+        return Finding(
+            rule=self.id, path=spec.path,
+            line=spec.arg_lines.get(arg, spec.line) if arg else spec.line, col=0,
+            message=message, context=f"jaxcheck:{spec.name}",
+        )
+
+
+# ------------------------------------------------------------------ JXC001
+class UndonatedMutatedInput(JaxRule):
+    id = "JXC001"
+    name = "undonated-mutated-input"
+    summary = "large input whose shape reappears in the output is not donated (a fresh copy every step)"
+
+    def check(self, spec, traced):
+        flagged: set[str] = set()
+        for tb in traced:
+            out_pool: Counter = Counter((tuple(a.shape), str(a.dtype)) for a in tb.out_avals)
+            # donated inputs claim their output buffers first: a donated
+            # cache consumes the new cache, leaving only genuinely
+            # unclaimed outputs to implicate undonated inputs
+            for leaf in tb.in_leaves:
+                if leaf.donated:
+                    key = (tuple(leaf.aval.shape), str(leaf.aval.dtype))
+                    if out_pool[key] > 0:
+                        out_pool[key] -= 1
+            for leaf in tb.in_leaves:
+                if leaf.donated or leaf.path in flagged:
+                    continue
+                if aval_bytes(leaf.aval) < spec.donate_bytes:
+                    continue
+                key = (tuple(leaf.aval.shape), str(leaf.aval.dtype))
+                if out_pool[key] > 0:
+                    out_pool[key] -= 1
+                    flagged.add(leaf.path)
+                    yield self.finding(spec, (
+                        f"input '{leaf.path}' matches an output buffer's shape/dtype but is "
+                        "not donated — the step allocates a second copy every call; add it to "
+                        "donate_argnums (or disable with a rationale if the host still reads it)"
+                    ), arg=leaf.arg)
+
+
+# ------------------------------------------------------------------ JXC002
+class HostRoundTrip(JaxRule):
+    id = "JXC002"
+    name = "host-round-trip"
+    summary = "host callback primitive inside a traced step (device pipeline stalls every call)"
+
+    def check(self, spec, traced):
+        seen: set[str] = set()
+        for tb in traced:
+            for eqn in iter_eqns(tb.jaxpr):
+                pname = eqn.primitive.name
+                if pname in _CALLBACK_PRIMS and pname not in seen:
+                    seen.add(pname)
+                    cb = eqn.params.get("callback", None)
+                    what = getattr(cb, "__name__", None) or str(cb or pname)
+                    yield self.finding(spec, (
+                        f"traced program contains host callback primitive '{pname}' ({what}) — "
+                        "every step round-trips to the host and stalls the device pipeline; "
+                        "move it out of the hot path or batch it behind the step"
+                    ))
+
+
+# ------------------------------------------------------------------ JXC003
+def _dot_flops(eqn) -> float:
+    out = eqn.outvars[0].aval
+    (lhs_c, _), _ = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval
+    k = math.prod(lhs.shape[d] for d in lhs_c) or 1
+    return 2.0 * math.prod(out.shape) * k
+
+
+class SilentUpcastDominantOp(JaxRule):
+    id = "JXC003"
+    name = "silent-upcast-dominant-op"
+    summary = "flops-dominant matmul computes in f32 on operands upcast from bf16 (2x bandwidth, slower MXU path)"
+
+    def check(self, spec, traced):
+        seen: set[str] = set()
+        for tb in traced:
+            dots: list[tuple] = []  # (eqn, producers) per sub-jaxpr scope
+            total = 0.0
+            for jx in iter_jaxprs(tb.jaxpr):
+                producers = {}
+                for eqn in jx.eqns:
+                    for ov in eqn.outvars:
+                        producers[ov] = eqn
+                for eqn in jx.eqns:
+                    if eqn.primitive.name == "dot_general":
+                        fl = _dot_flops(eqn)
+                        total += fl
+                        dots.append((eqn, producers, fl))
+            for eqn, producers, fl in dots:
+                if total <= 0 or fl < spec.flops_frac * total:
+                    continue
+                for iv in eqn.invars:
+                    aval = getattr(iv, "aval", None)
+                    if aval is None or str(aval.dtype) != "float32":
+                        continue
+                    prod_eqn = producers.get(iv)
+                    if (
+                        prod_eqn is not None
+                        and prod_eqn.primitive.name == "convert_element_type"
+                        and str(prod_eqn.invars[0].aval.dtype) == "bfloat16"
+                    ):
+                        key = fmt_aval(aval)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        yield self.finding(spec, (
+                            f"flops-dominant dot_general consumes {key} upcast from bf16 — "
+                            "the matmul runs off-MXU-fast-path at double the HBM traffic; keep "
+                            "operands bf16 and set preferred_element_type=float32 for the accumulate"
+                        ))
+
+
+# ------------------------------------------------------------------ JXC004
+class RecompilationDriver(JaxRule):
+    id = "JXC004"
+    name = "recompilation-driver"
+    summary = "per-request Python scalar is baked into the traced program (a recompile for every distinct value)"
+
+    def check(self, spec, traced):
+        if not spec.varying:
+            return
+        for pname, probe in spec.varying.items():
+            v1, v2 = probe
+            bucket = next((tb.bucket for tb in traced if pname in tb.statics), None)
+            if bucket is None:
+                continue
+            j1 = canonical(trace_bucket(spec, bucket, overrides={pname: v1}).jaxpr)
+            j2 = canonical(trace_bucket(spec, bucket, overrides={pname: v2}).jaxpr)
+            if j1 != j2:
+                yield self.finding(spec, (
+                    f"Python scalar '{pname}' is baked into the traced program (jaxprs differ "
+                    f"between probe values {v1!r} and {v2!r}) — every distinct runtime value "
+                    "forces a recompile; pass it as a traced 0-d array or quantize it into "
+                    "registered shape buckets"
+                ))
+
+
+# ------------------------------------------------------------------ JXC005
+def _eqn_axis_names(eqn) -> tuple[str, ...]:
+    axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if isinstance(axes, (str, int)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
+
+
+def _collective_axes(jaxpr_like) -> set[str]:
+    out: set[str] = set()
+    stack = [jaxpr_like]
+    while stack:
+        jx = stack.pop()
+        for eqn in jx.eqns:
+            if eqn.primitive.name in _COLLECTIVE_PRIMS:
+                out.update(_eqn_axis_names(eqn))
+            stack.extend(_sub_jaxprs(eqn.params))
+    return out
+
+
+class CollectiveAxisMismatch(JaxRule):
+    id = "JXC005"
+    name = "collective-axis-mismatch"
+    summary = "collective over an axis name outside the declared mesh, or differing across cond branches"
+
+    def check(self, spec, traced):
+        declared = set(spec.mesh_axes)
+        seen: set[str] = set()
+        for tb in traced:
+            for eqn in iter_eqns(tb.jaxpr):
+                pname = eqn.primitive.name
+                if pname in _COLLECTIVE_PRIMS:
+                    for ax in _eqn_axis_names(eqn):
+                        if ax not in declared and ax not in seen:
+                            seen.add(ax)
+                            yield self.finding(spec, (
+                                f"collective '{pname}' runs over axis '{ax}' which is not in the "
+                                f"entry's declared mesh axes {tuple(sorted(declared))} — the program "
+                                "cannot lower on the production mesh (axis-name drift)"
+                            ))
+                elif pname == "cond":
+                    branches = eqn.params.get("branches", ())
+                    axis_sets = [_collective_axes(b.jaxpr if hasattr(b, "jaxpr") else b) for b in branches]
+                    if axis_sets and any(s != axis_sets[0] for s in axis_sets[1:]):
+                        key = "cond:" + "/".join(sorted(",".join(sorted(s)) for s in axis_sets))
+                        if key not in seen:
+                            seen.add(key)
+                            yield self.finding(spec, (
+                                "cond branches perform collectives over differing axis sets "
+                                f"({' vs '.join(repr(sorted(s)) for s in axis_sets)}) — under "
+                                "shard_map a divergent predicate deadlocks the mesh mid-collective; "
+                                "hoist the collective out of the branch"
+                            ))
+
+
+# ------------------------------------------------------------------ JXC006
+def _tile_waste(aval) -> float:
+    shape = aval.shape
+    if len(shape) < 2:
+        return 0.0
+    sub, lane = _TILE
+    d2, d1 = shape[-2], shape[-1]
+    if d2 == 0 or d1 == 0:
+        return 0.0
+    padded = math.ceil(d2 / sub) * sub * math.ceil(d1 / lane) * lane
+    return 1.0 - (d2 * d1) / padded
+
+
+class PaddingWaste(JaxRule):
+    id = "JXC006"
+    name = "padding-waste"
+    summary = "trailing dims far off the (8,128) tile: HBM and MXU cycles spent on padding"
+
+    def check(self, spec, traced):
+        flagged: set[str] = set()
+        for tb in traced:
+            for leaf in tb.in_leaves:
+                if leaf.path in flagged or aval_bytes(leaf.aval) < spec.pad_min_bytes:
+                    continue
+                waste = _tile_waste(leaf.aval)
+                if waste > spec.pad_waste:
+                    flagged.add(leaf.path)
+                    yield self.finding(spec, (
+                        f"input '{leaf.path}' trailing dims waste {waste:.0%} of their (8,128) "
+                        "tiles — the buffer pads to the tile grid in HBM and the MXU streams the "
+                        "padding; fold/reorder dims so the last two approach tile multiples"
+                    ))
+
+
+_JAX_RULES = (
+    UndonatedMutatedInput,
+    HostRoundTrip,
+    SilentUpcastDominantOp,
+    RecompilationDriver,
+    CollectiveAxisMismatch,
+    PaddingWaste,
+)
+
+
+def all_jax_rules(select: set[str] | None = None) -> list[JaxRule]:
+    rules = [cls() for cls in _JAX_RULES]
+    if select:
+        rules = [r for r in rules if r.id in select or r.name in select]
+    return rules
+
+
+def jax_rule_ids() -> set[str]:
+    return {cls.id for cls in _JAX_RULES}
+
+
+def jax_rule_catalog() -> list[tuple[str, str, str]]:
+    return [(cls.id, cls.name, cls.summary) for cls in _JAX_RULES]

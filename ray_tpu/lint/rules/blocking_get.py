@@ -6,6 +6,13 @@ actor (or on a cycle of actors) deadlocks with no timeout to save it; in
 an ``async def`` the call parks the whole event loop, starving every
 other coroutine sharing it (the serve proxy, async actor method queues).
 The head path can't see either: the caller looks merely "busy".
+
+Interprocedural, one level: an actor method (or coroutine) that calls a
+module-level sync helper whose body does an unbounded get is the same
+hazard hoisted behind a function call — the call site is flagged, naming
+the helper. Module-level helpers are exactly the defs the lexical rule
+is silent on (methods of the actor class are already visited in actor
+context), so the two passes never double-report one hazard.
 """
 
 from __future__ import annotations
@@ -13,10 +20,8 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from ray_tpu.lint.engine import FileContext, Finding, Rule, ScopedVisitor, call_keyword, dotted, has_decorator
-
-_BLOCKING = {"get", "wait"}
-_MODULES = {"ray", "ray_tpu", "rt"}
+from ray_tpu.lint.callgraph import CallGraph, blocking_ray_call
+from ray_tpu.lint.engine import FileContext, Finding, Rule, ScopedVisitor, has_decorator
 
 
 class _Visitor(ScopedVisitor):
@@ -24,6 +29,7 @@ class _Visitor(ScopedVisitor):
         super().__init__()
         self.rule = rule
         self.ctx = ctx
+        self.graph = CallGraph(ctx.tree)
         self.out: list[Finding] = []
         self._actor_depth = 0  # inside a @remote class body
         self._fn_kind: list[str] = []  # "sync" | "async" per enclosing function
@@ -41,30 +47,52 @@ class _Visitor(ScopedVisitor):
             self._fn_kind.pop()
 
     def visit_Call(self, node: ast.Call):
-        name = dotted(node.func)
-        if name is not None:
-            parts = name.split(".")
-            if len(parts) == 2 and parts[0] in _MODULES and parts[1] in _BLOCKING:
-                in_async = bool(self._fn_kind) and self._fn_kind[-1] == "async"
-                in_actor_method = self._actor_depth > 0 and bool(self._fn_kind)
-                bounded = call_keyword(node, "timeout") is not None
-                if bounded and not in_async:
-                    pass  # a deadlined get inside an actor surfaces instead of deadlocking
-                elif in_async:
-                    self.out.append(self.rule.finding(
-                        self.ctx, node,
-                        f"blocking {name}() inside an async coroutine parks the event loop; "
-                        "await an async variant or hand off to a thread",
-                        context=self.qualname,
-                    ))
-                elif in_actor_method:
-                    self.out.append(self.rule.finding(
-                        self.ctx, node,
-                        f"blocking {name}() inside an actor method risks actor deadlock "
-                        "(self-call or actor-cycle waits forever); restructure or pass a timeout",
-                        context=self.qualname,
-                    ))
+        hit = blocking_ray_call(node)
+        in_async = bool(self._fn_kind) and self._fn_kind[-1] == "async"
+        in_actor_method = self._actor_depth > 0 and bool(self._fn_kind)
+        if hit is not None:
+            name, bounded = hit
+            if bounded and not in_async:
+                pass  # a deadlined get inside an actor surfaces instead of deadlocking
+            elif in_async:
+                self.out.append(self.rule.finding(
+                    self.ctx, node,
+                    f"blocking {name}() inside an async coroutine parks the event loop; "
+                    "await an async variant or hand off to a thread",
+                    context=self.qualname,
+                ))
+            elif in_actor_method:
+                self.out.append(self.rule.finding(
+                    self.ctx, node,
+                    f"blocking {name}() inside an actor method risks actor deadlock "
+                    "(self-call or actor-cycle waits forever); restructure or pass a timeout",
+                    context=self.qualname,
+                ))
+        if in_async or in_actor_method:
+            self._check_callee(node, in_async)
         self.generic_visit(node)
+
+    def _check_callee(self, node: ast.Call, in_async: bool):
+        """One-level interprocedural step: a bare call to a module-level
+        SYNC helper whose body blocks. Mirrors the lexical gate exactly:
+        a timeout bound clears the actor-deadlock case but NOT the async
+        case (a bounded get still parks the event loop for its duration).
+        (An async callee is flagged on its own body by the lexical pass.)"""
+        callee = self.graph.resolve(node)
+        if callee is None or isinstance(callee, ast.AsyncFunctionDef):
+            return
+        for _, blocking_name, bounded in self.graph.blocking_calls(callee):
+            if bounded and not in_async:
+                continue  # deadlined get inside an actor-called helper is fine
+            where = "parks the event loop" if in_async else "risks actor deadlock"
+            self.out.append(self.rule.finding(
+                self.ctx, node,
+                f"call to local helper {callee.name}() which does a blocking "
+                f"{blocking_name}() — {where} one call deeper; "
+                "bound the get by the remaining deadline or restructure the helper",
+                context=self.qualname,
+            ))
+            return
 
 
 class BlockingGetInActor(Rule):

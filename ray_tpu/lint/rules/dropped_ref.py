@@ -5,6 +5,11 @@ task's result: if the task raises, the error completes an ObjectRef
 nobody will ever ``get``, so the failure is silent (and under
 ref-counting the return may be freed before the task even finishes).
 Bind the ref — even to ``_last =`` for ordering-only calls — or get it.
+
+Interprocedural, one level: ``kick(x)`` as a bare statement, where
+``kick`` is a module-level helper whose ``return`` hands back a
+``.remote()`` ref, drops that ref at the CALL site — the helper itself
+is clean, so only the caller can be flagged.
 """
 
 from __future__ import annotations
@@ -12,6 +17,7 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
+from ray_tpu.lint.callgraph import CallGraph
 from ray_tpu.lint.engine import FileContext, Finding, Rule, ScopedVisitor
 
 
@@ -29,6 +35,7 @@ class _Visitor(ScopedVisitor):
         super().__init__()
         self.rule = rule
         self.ctx = ctx
+        self.graph = CallGraph(ctx.tree)
         self.out: list[Finding] = []
 
     def visit_Expr(self, node: ast.Expr):
@@ -41,6 +48,16 @@ class _Visitor(ScopedVisitor):
                 "(bind the ref or ray.get it)",
                 context=self.qualname,
             ))
+        elif isinstance(node.value, ast.Call):
+            callee = self.graph.resolve(node.value)
+            if callee is not None and self.graph.returns_object_ref(callee):
+                self.out.append(self.rule.finding(
+                    self.ctx, node,
+                    f"result of {callee.name}() is dropped but the helper returns an "
+                    "ObjectRef from .remote(); task errors vanish silently "
+                    "(bind the ref or ray.get it)",
+                    context=self.qualname,
+                ))
         self.generic_visit(node)
 
 

@@ -35,24 +35,37 @@ def _is_jitted(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
     return any(d.split(".")[-1] in _JIT_SUFFIXES for d in decorator_names(fn))
 
 
+def _unwrap_partial(expr: ast.AST) -> ast.AST:
+    """``partial(f, ...)``/``functools.partial(f, ...)`` -> ``f``."""
+    if isinstance(expr, ast.Call) and (dotted(expr.func) or "").split(".")[-1] == "partial" and expr.args:
+        return expr.args[0]
+    return expr
+
+
 def _call_form_jitted_names(tree: ast.Module) -> set[str]:
     """Function names wrapped by the CALL form: ``jax.jit(f)``,
     ``jit(partial(f, ...))`` — the dominant idiom in this codebase
-    (model_runner builds prefill_fn/decode_fn this way)."""
-    out: set[str] = set()
+    (model_runner builds prefill_fn/decode_fn this way) — and the
+    variable-bound form ``step = partial(f, cfg=cfg); jax.jit(step)``
+    (or a plain alias ``step = f``), resolved through one assignment.
+    Binding collection is scope-insensitive by design: a false link only
+    widens where purity is enforced. One walk collects both sides;
+    bindings resolve afterwards, so assignment/jit ordering is free."""
+    bindings: dict[str, str] = {}
+    targets: set[str] = set()
     for n in ast.walk(tree):
-        if not isinstance(n, ast.Call):
-            continue
-        fname = dotted(n.func)
-        if fname is None or fname.split(".")[-1] not in _JIT_SUFFIXES or not n.args:
-            continue
-        target = n.args[0]
-        if isinstance(target, ast.Call) and (dotted(target.func) or "").split(".")[-1] == "partial" and target.args:
-            target = target.args[0]
-        tname = dotted(target)
-        if tname is not None:
-            out.add(tname.split(".")[-1])
-    return out
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(n.targets[0], ast.Name):
+            tname = dotted(_unwrap_partial(n.value))
+            if tname is not None:
+                bindings[n.targets[0].id] = tname.split(".")[-1]
+        elif isinstance(n, ast.Call):
+            fname = dotted(n.func)
+            if fname is None or fname.split(".")[-1] not in _JIT_SUFFIXES or not n.args:
+                continue
+            tname = dotted(_unwrap_partial(n.args[0]))
+            if tname is not None:
+                targets.add(tname.split(".")[-1])
+    return targets | {bindings[t] for t in targets if t in bindings}
 
 
 def _impure_name(call: ast.Call) -> str | None:

@@ -28,16 +28,16 @@ def _own_nodes(fn: ast.AST):
     """ast.walk restricted to ``fn``'s own scope: everything inside a
     nested def/class is excluded — a helper's local ``timeout`` must not
     make the OUTER function 'own' a deadline (and a helper's settimeout
-    must not vouch for the outer body's socket ops)."""
-    skip: set[int] = set()
-    for d in ast.walk(fn):
-        if d is not fn and isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-            for x in ast.walk(d):
-                if x is not d:
-                    skip.add(id(x))
-    for n in ast.walk(fn):
-        if id(n) not in skip:
-            yield n
+    must not vouch for the outer body's socket ops). Single pruned pass
+    (an every-function skip-set rebuild made this rule dominate the
+    full-tree wall clock)."""
+    stack: list[ast.AST] = [fn]
+    while stack:
+        n = stack.pop()
+        if n is not fn and isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
 
 
 def _owns_deadline(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:

@@ -922,8 +922,11 @@ class LLMEngine:
         active = [s for s in self._slots if s is not None]
         if not active:
             return
+        # the fused programs donate the sampling lanes and hand them back
+        # as passthrough outputs (zero-copy aliases); rebind the handles
         if self.kv_layout == "paged":
-            (toks, logps, self._dkeys, k_new, v_new, wp, wo, self._dlengths) = self._fused_attn(
+            (toks, logps, self._dkeys, k_new, v_new, wp, wo, self._dlengths,
+             self._dtemps, self._dtopk, self._dtopp) = self._fused_attn(
                 self.params,
                 self.pool,
                 self._dtables,
@@ -938,7 +941,8 @@ class LLMEngine:
             for st in active:
                 self._lengths[st.slot] += 1  # host shadow, no upload
         else:
-            self.cache, toks, logps, self._dkeys = self._fused_step(
+            (self.cache, toks, logps, self._dkeys,
+             self._dtemps, self._dtopk, self._dtopp) = self._fused_step(
                 self.params,
                 self.cache,
                 self._dtokens,

@@ -19,9 +19,88 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.lint import jaxcheck
 from ray_tpu.models.llama import LlamaConfig
 from ray_tpu.ops.flash_attention import flash_attention
 from ray_tpu.ops.layers import apply_rope, rms_norm, rotary_embedding
+
+
+# ---------------------------------------------------------------------------
+# jaxcheck shape buckets: production-realistic abstract shapes (tile-true
+# head_dim/hidden so JXC006's (8,128) math is meaningful; ShapeDtypeStructs
+# only — nothing here allocates). B is the slot count, S the KV horizon.
+# ---------------------------------------------------------------------------
+def _trace_cfg() -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=32256, hidden_size=1024, intermediate_size=2816,
+        num_layers=4, num_heads=8, num_kv_heads=8, head_dim=128,
+        max_seq_len=512, remat=False,
+    )
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _sds_params(cfg: LlamaConfig):
+    from ray_tpu.models.llama import init_params
+
+    return jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))
+
+
+def _sds_cache(cfg: LlamaConfig, B: int, S: int):
+    dt = jnp.dtype(cfg.dtype)
+    kv = _sds((cfg.num_layers, B, S, cfg.num_kv_heads, cfg.hd), dt)
+    return {"k": kv, "v": kv, "length": _sds((B,), jnp.int32)}
+
+
+def _sds_pool(cfg: LlamaConfig, pages: int, page: int):
+    dt = jnp.dtype(cfg.dtype)
+    kv = _sds((cfg.num_layers, pages, page, cfg.num_kv_heads, cfg.hd), dt)
+    return {"k": kv, "v": kv}
+
+
+def _sds_lanes(B: int):
+    """(tokens, keys, temps, top_k, top_p) slot lanes."""
+    return (
+        _sds((B,), jnp.int32), _sds((B, 2), jnp.uint32), _sds((B,), jnp.float32),
+        _sds((B,), jnp.int32), _sds((B,), jnp.float32),
+    )
+
+
+def _bucket_prefill(B=8, T=128):
+    cfg = _trace_cfg()
+    return (_sds_params(cfg), _sds((B, T), jnp.int32), _sds((B,), jnp.int32), cfg), {}
+
+
+def _bucket_decode(B=8, S=256):
+    cfg = _trace_cfg()
+    return (_sds_params(cfg), _sds_cache(cfg, B, S), _sds((B,), jnp.int32), cfg), {}
+
+
+def _bucket_fused(B=8, S=256):
+    cfg = _trace_cfg()
+    return (_sds_params(cfg), _sds_cache(cfg, B, S)) + _sds_lanes(B) + (cfg,), {}
+
+
+def _bucket_paged_fused(B=8, pages=64, page=16):
+    cfg = _trace_cfg()
+    tables = _sds((B, pages // B * 2), jnp.int32)
+    lengths = _sds((B,), jnp.int32)
+    tokens, keys, temps, top_k, top_p = _sds_lanes(B)
+    return (
+        _sds_params(cfg), _sds_pool(cfg, pages, page), tables, lengths,
+        tokens, keys, temps, top_k, top_p, cfg,
+    ), {}
+
+
+def _bucket_set_lane(B=8):
+    tokens, keys, temps, top_k, top_p = _sds_lanes(B)
+    scalars = (
+        _sds((), jnp.int32), _sds((), jnp.int32), _sds((2,), jnp.uint32),
+        _sds((), jnp.float32), _sds((), jnp.int32), _sds((), jnp.float32),
+    )
+    return (tokens, keys, temps, top_k, top_p) + scalars, {}
 
 
 def _qkv(xn, layer, cfg: LlamaConfig):
@@ -40,6 +119,10 @@ def _mlp(x, layer, cfg: LlamaConfig):
     return x + jnp.dot(jax.nn.silu(g) * u, layer["w_down"])
 
 
+@jaxcheck.entry(
+    name="llm.prefill",
+    shapes={"b8_t128": _bucket_prefill, "b8_t256": lambda: _bucket_prefill(T=256)},
+)
 def prefill(params, tokens, length, cfg: LlamaConfig):
     """Run the prompt through the model, returning last-token logits + K/V.
 
@@ -77,6 +160,11 @@ def prefill(params, tokens, length, cfg: LlamaConfig):
     return logits, ks, vs
 
 
+@jaxcheck.entry(
+    name="llm.decode_step",
+    shapes={"b8_s256": _bucket_decode},
+    donate=("cache",),
+)
 def decode_step(params, cache, tokens, cfg: LlamaConfig):
     """Advance every slot one token.
 
@@ -321,45 +409,108 @@ def extend_paged(params, pool, table_row, start, tokens, length, cfg: LlamaConfi
     return logits, pool
 
 
-def make_fused_fns(cfg: LlamaConfig):
-    """ONE jitted program for the slot layout's whole decode hot path:
-    decode -> sample -> append-KV -> advance lengths, cache and PRNG keys
-    donated. Nothing in it touches the host; the engine reads tokens back
-    asynchronously one step behind the dispatch (device-resident loop).
+@jaxcheck.entry(
+    name="llm.fused_step",
+    shapes={"b8_s256": _bucket_fused},
+    donate=("cache", "keys", "temps", "top_k", "top_p"),
+    donate_bytes=0,  # the whole hot loop is audited: every lane buffer counts
+)
+def fused_step(
+    params,
+    cache,
+    tokens,  # tpulint: disable=JXC001 — the previous step's sampled-token output; the engine still holds it for the delayed host readback, so donating it would poison the in-flight transfer
+    keys,
+    temps,
+    top_k,
+    top_p,
+    cfg: LlamaConfig,
+):
+    """ONE program for the slot layout's whole decode hot path: decode ->
+    sample -> append-KV -> advance lengths. Nothing in it touches the
+    host; the engine reads tokens back asynchronously one step behind the
+    dispatch (device-resident loop).
 
-    tokens is deliberately NOT donated: its buffer is the previous step's
-    sampled-token output, which the engine still holds for the delayed
-    host readback when this program is dispatched.
+    The sampling lanes (keys, temps, top_k, top_p) are donated and handed
+    back as passthrough outputs — XLA aliases them in place (zero copies)
+    and the engine rebinds its handles each step, so every buffer the
+    loop touches stays device-resident with exactly one live copy.
+    tokens is deliberately NOT donated (see inline disable above).
     """
     from ray_tpu.llm.sampling import sample
 
-    def fused(params, cache, tokens, keys, temps, top_k, top_p):
-        logits, cache = decode_step(params, cache, tokens, cfg)
-        toks, logps, new_keys = sample(logits, keys, temps, top_k, top_p)
-        return cache, toks, logps, new_keys
+    logits, cache = decode_step(params, cache, tokens, cfg)
+    toks, logps, new_keys = sample(logits, keys, temps, top_k, top_p)
+    return cache, toks, logps, new_keys, temps, top_k, top_p
 
-    return jax.jit(fused, donate_argnums=(1, 3))
+
+def make_fused_fns(cfg: LlamaConfig):
+    """Jit of fused_step with the production donation set."""
+    return jax.jit(partial(fused_step, cfg=cfg), donate_argnums=(1, 3, 4, 5, 6))
+
+
+@jaxcheck.entry(
+    name="llm.paged_fused_step",
+    shapes={"b8_p64": _bucket_paged_fused},
+    donate=("lengths", "keys", "temps", "top_k", "top_p"),
+    donate_bytes=0,
+)
+def paged_fused_step(
+    params,
+    pool,  # read-only by design (the gather/scatter aliasing hazard); donated by the append program instead
+    tables,
+    lengths,
+    tokens,  # tpulint: disable=JXC001 — feeds the delayed host readback (same rationale as fused_step)
+    keys,
+    temps,
+    top_k,
+    top_p,
+    cfg: LlamaConfig,
+):
+    """READ-ONLY half of the paged device-resident step: attention +
+    sample + write-target math; the scatter-append into the pool is a
+    SEPARATE program (append_paged) — see decode_attn_paged for the
+    gather/scatter aliasing hazard that forbids fusing them. Sampling
+    lanes are donated-and-passed-through exactly as in fused_step."""
+    from ray_tpu.llm.sampling import sample
+
+    write_page, write_off = decode_write_targets(tables, lengths, pool["k"].shape[2])
+    logits, k_new, v_new = decode_attn_paged(params, pool, tables, lengths, tokens, cfg)
+    toks, logps, new_keys = sample(logits, keys, temps, top_k, top_p)
+    return toks, logps, new_keys, k_new, v_new, write_page, write_off, lengths + 1, temps, top_k, top_p
 
 
 def make_fused_paged_fns(cfg: LlamaConfig):
     """Device-resident decode step for the paged layout: TWO programs
-    (attention+sample, then scatter-append) because a single program that
-    both gathers from and scatters into the pool buffer is the aliasing
-    hazard documented on decode_attn_paged — but neither program ever
-    syncs with the host. lengths and keys are donated; tokens is not
-    (same delayed-readback rationale as make_fused_fns); tables is read
-    every step and mutated only by scheduler deltas."""
-    from ray_tpu.llm.sampling import sample
-
-    def attn_sample(params, pool, tables, lengths, tokens, keys, temps, top_k, top_p):
-        write_page, write_off = decode_write_targets(tables, lengths, pool["k"].shape[2])
-        logits, k_new, v_new = decode_attn_paged(params, pool, tables, lengths, tokens, cfg)
-        toks, logps, new_keys = sample(logits, keys, temps, top_k, top_p)
-        return toks, logps, new_keys, k_new, v_new, write_page, write_off, lengths + 1
-
-    attn_fn = jax.jit(attn_sample, donate_argnums=(3, 5))
+    (attention+sample, then scatter-append), neither of which ever syncs
+    with the host. tables is read every step and mutated only by
+    scheduler deltas."""
+    attn_fn = jax.jit(partial(paged_fused_step, cfg=cfg), donate_argnums=(3, 5, 6, 7, 8))
     append_fn = jax.jit(append_paged, donate_argnums=(0,))
     return attn_fn, append_fn
+
+
+@jaxcheck.entry(
+    name="llm.delta_set_lane",
+    shapes={"b8": _bucket_set_lane},
+    donate_bytes=0,
+)
+def set_lane(tokens, keys, temps, top_k, top_p, slot, token, key, temp, tk, tp):  # tpulint: disable=JXC001 — delta fns deliberately donate nothing: the engine may still hold every one of these buffers for an in-flight step's delayed readback when a scheduler delta lands
+    """O(1) jitted scatter for admission: write one slot's lane state."""
+    return (
+        tokens.at[slot].set(token),
+        keys.at[slot].set(key),
+        temps.at[slot].set(temp),
+        top_k.at[slot].set(tk),
+        top_p.at[slot].set(tp),
+    )
+
+
+def set_table(tables, lengths, slot, row, length):
+    return tables.at[slot].set(row), lengths.at[slot].set(length)
+
+
+def set_table_cell(tables, slot, pg_ix, page):
+    return tables.at[slot, pg_ix].set(page)
 
 
 def make_delta_fns():
@@ -367,24 +518,7 @@ def make_delta_fns():
     decode state (admission / eviction / page growth). Each compiles once
     (slot/index are traced scalars) and touches O(1) elements — the
     replacement for re-uploading whole host arrays every step. Nothing is
-    donated: the engine may still hold the previous buffers for an
-    in-flight step's delayed readback."""
-
-    def set_lane(tokens, keys, temps, top_k, top_p, slot, token, key, temp, tk, tp):
-        return (
-            tokens.at[slot].set(token),
-            keys.at[slot].set(key),
-            temps.at[slot].set(temp),
-            top_k.at[slot].set(tk),
-            top_p.at[slot].set(tp),
-        )
-
-    def set_table(tables, lengths, slot, row, length):
-        return tables.at[slot].set(row), lengths.at[slot].set(length)
-
-    def set_table_cell(tables, slot, pg_ix, page):
-        return tables.at[slot, pg_ix].set(page)
-
+    donated (see set_lane's inline rationale)."""
     return jax.jit(set_lane), jax.jit(set_table), jax.jit(set_table_cell)
 
 

@@ -49,6 +49,28 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ray_tpu.lint import jaxcheck
+
+
+def _shard_map(f, mesh: Mesh, in_specs, out_specs, axis_names: set[str]):
+    """``jax.shard_map(..., axis_names=...)`` where available; on older
+    JAX (0.4.x) fall back to jax.experimental.shard_map with the
+    complement of ``axis_names`` as ``auto`` axes."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, axis_names=axis_names
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    auto = frozenset(mesh.axis_names) - set(axis_names)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False, auto=auto)
+
+
+def _pvary(t, axis_names: tuple[str, ...]):
+    # lax.pvary is a no-op value-wise; it only exists on newer JAX to mark
+    # varying-manual-axes metadata. Identity is correct where it's absent.
+    return lax.pvary(t, axis_names) if hasattr(lax, "pvary") else t
+
 
 def to_stage_stacked(layer_params, n_stages: int, virtual_stages: int = 1):
     """[L, ...]-stacked layer params -> [n_stages, v, L/(n*v), ...].
@@ -182,7 +204,7 @@ def pipeline_apply(
             return (state, outputs), None
 
         init = jax.tree.map(
-            lambda t: lax.pvary(t, (axis_name,)),
+            lambda t: _pvary(t, (axis_name,)),
             (jnp.zeros_like(xs[0]), jnp.zeros_like(xs)),
         )
         (_, outputs), _ = lax.scan(tick, init, jnp.arange(M * v + n - 1))
@@ -202,7 +224,7 @@ def pipeline_apply(
         x_spec = P(None, None, sp_axis)
         seq_specs = tuple(P(sp_axis) for _ in seq_inputs)
         manual = {axis_name, sp_axis}
-    fn = jax.shard_map(
+    fn = _shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis_name), x_spec) + seq_specs,
@@ -267,6 +289,27 @@ def _sp_local_layer_fn(x, layer, cos_l, sin_l, *, config):
     return x + jnp.dot(jax.nn.silu(g) * u, layer["w_down"])
 
 
+def _bucket_pp_forward(B=8, T=128, n_stages=2):
+    """Tile-true abstract shapes on a pp-only mesh (fully manual shard_map,
+    so the trace works on any >=2-device backend)."""
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.parallel.mesh import create_mesh
+
+    cfg = LlamaConfig(
+        vocab_size=32256, hidden_size=1024, intermediate_size=2816,
+        num_layers=4, num_heads=8, num_kv_heads=8, head_dim=128, remat=False,
+    )
+    mesh = create_mesh(pp=n_stages)
+    params = jax.eval_shape(lambda: pp_init_params(cfg, jax.random.PRNGKey(0), n_stages))
+    tokens = jax.ShapeDtypeStruct((B, T), jnp.int32)
+    return (params, tokens, cfg, mesh, 4), {}
+
+
+@jaxcheck.entry(
+    name="parallel.pipeline_forward",
+    shapes={"pp2_b8_t128": _bucket_pp_forward},
+    mesh_axes=("pp", "sp"),
+)
 def pp_forward(params, tokens, config, mesh: Mesh, num_microbatches: int, virtual_stages: int = 1):
     """Pipelined llama forward: embed -> pp pipeline over layers -> unembed.
     When the mesh also has an `sp` axis, the pipeline region goes manual

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu.lint import jaxcheck
 from ray_tpu.parallel.mesh import DEFAULT_RULES, ShardingRules, shard_batch_spec
 
 
@@ -36,6 +37,43 @@ jax.tree_util.register_pytree_node(
     lambda s: ((s.step, s.params, s.opt_state), None),
     lambda _, c: TrainState(*c),
 )
+
+
+def _bucket_train_step(B=32, D=1024):
+    """Linear-regression probe state: the donation/dtype/collective
+    contracts under test are model-independent."""
+    tx = optax.adam(1e-3)
+    w = jax.ShapeDtypeStruct((D, D), jnp.float32)
+    params = {"w": w}
+    opt_state = jax.eval_shape(tx.init, params)
+    state = TrainState(step=jax.ShapeDtypeStruct((), jnp.int32), params=params, opt_state=opt_state)
+    batch = {
+        "x": jax.ShapeDtypeStruct((B, D), jnp.float32),
+        "y": jax.ShapeDtypeStruct((B, D), jnp.float32),
+    }
+
+    def loss_fn(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+    return (state, batch), {"loss_fn": loss_fn, "tx": tx}
+
+
+@jaxcheck.entry(
+    name="parallel.train_step",
+    shapes={"b32_d1024": _bucket_train_step},
+    donate=("state",),
+)
+def train_step(state: TrainState, batch, *, loss_fn: Callable, tx: optax.GradientTransformation):
+    """One optimizer step — the body every make_train_step program jits
+    (state donated; XLA shards it per the caller's in_shardings)."""
+    loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+    updates, new_opt = tx.update(grads, state.opt_state, state.params)
+    new_params = optax.apply_updates(state.params, updates)
+    gnorm = optax.global_norm(grads)
+    return (
+        TrainState(step=state.step + 1, params=new_params, opt_state=new_opt),
+        {"loss": loss, "grad_norm": gnorm, "step": state.step + 1},
+    )
 
 
 def make_train_step(
@@ -86,14 +124,7 @@ def make_train_step(
         return init_jit(rng), state_shardings
 
     def _step(state: TrainState, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        gnorm = optax.global_norm(grads)
-        return (
-            TrainState(step=state.step + 1, params=new_params, opt_state=new_opt),
-            {"loss": loss, "grad_norm": gnorm, "step": state.step + 1},
-        )
+        return train_step(state, batch, loss_fn=loss_fn, tx=tx)
 
     def compile_step(state_shardings):
         return jax.jit(

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""CI lint gate: run tpulint (AST + jaxcheck) over the files a change
+touches and fail on NEW findings.
+
+    python scripts/lint_gate.py                  # diff vs origin/main (or main, or HEAD~1)
+    python scripts/lint_gate.py --base REF       # explicit merge base
+    python scripts/lint_gate.py --all            # whole tree (what tier-1 runs)
+
+Semantics match the tier-1 self-check exactly — same baseline, same
+fingerprints — so the gate can never pass a change tier-1 would fail:
+
+- changed ``.py`` files under ray_tpu/ get the AST rules;
+- the jaxpr pass (``--jax``) runs whenever a changed file is a
+  registered entry module (or any file under ray_tpu/, since an edited
+  helper can change a traced program) — it is cheap (abstract tracing,
+  no compiles);
+- deleting a finding's file surfaces as a STALE baseline entry, which
+  also fails: run ``python -m ray_tpu.lint ray_tpu --update-baseline``
+  and commit the shrunk baseline.
+
+Wire it as a pre-push hook or CI step from the repo root:
+
+    ln -s ../../scripts/lint_gate.py .git/hooks/pre-push
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _changed_files(base: str | None) -> list[str]:
+    candidates = [base] if base else ["origin/main", "main", "HEAD~1"]
+    for ref in candidates:
+        try:
+            mb = subprocess.run(
+                ["git", "merge-base", "HEAD", ref],
+                cwd=ROOT, capture_output=True, text=True, timeout=30,
+            )
+            if mb.returncode != 0:
+                continue
+            diff = subprocess.run(
+                ["git", "diff", "--name-only", "--diff-filter=d", mb.stdout.strip(), "HEAD"],
+                cwd=ROOT, capture_output=True, text=True, timeout=30,
+            )
+            if diff.returncode == 0:
+                # uncommitted work counts too: the gate runs pre-push
+                wt = subprocess.run(
+                    ["git", "diff", "--name-only", "--diff-filter=d", "HEAD"],
+                    cwd=ROOT, capture_output=True, text=True, timeout=30,
+                )
+                names = set(diff.stdout.split()) | set(wt.stdout.split())
+                return sorted(names)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return []
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--base", default=None, help="git ref to diff against (default: origin/main, main, HEAD~1)")
+    p.add_argument("--all", action="store_true", help="lint the whole ray_tpu tree")
+    # git invokes pre-push hooks as `hook <remote-name> <url>`: accept and
+    # ignore those positionals so the documented symlink install works
+    p.add_argument("git_hook_args", nargs="*", help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.all:
+        targets = ["ray_tpu"]
+    else:
+        changed = _changed_files(args.base)
+        targets = [
+            f for f in changed
+            if f.endswith(".py") and f.startswith("ray_tpu/") and os.path.exists(os.path.join(ROOT, f))
+        ]
+        if not targets:
+            print("lint_gate: no changed ray_tpu/*.py files — nothing to check")
+            return 0
+
+    cmd = [sys.executable, "-m", "ray_tpu.lint", *targets, "--root", ROOT, "--jax"]
+    print("lint_gate:", " ".join(cmd), flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    rc = subprocess.run(cmd, cwd=ROOT, env=env).returncode
+    if rc:
+        print(
+            "lint_gate: NEW static hazards (or stale baseline entries). Fix them, "
+            "suppress inline with a rationale, or accept deliberate debt via "
+            "`python -m ray_tpu.lint ray_tpu --jax --update-baseline`.",
+            file=sys.stderr,
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

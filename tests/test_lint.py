@@ -10,6 +10,7 @@ anchored at the repo root so fingerprints match the baseline.
 
 import json
 import os
+import re
 import subprocess
 import sys
 
@@ -44,24 +45,31 @@ def test_self_check_baseline_not_stale():
     )
 
 
+CORE = os.path.join(PKG, "core")  # the CLI-behavior tests scope to one
+# subtree (where the checked-in baseline's entries live): their contracts
+# are path-independent and a full-tree walk per assertion is tier-1 time
+# the self-check tests above already spend once
+
+
 def test_cli_exit_codes(tmp_path):
-    # clean tree against the real baseline -> 0
-    assert lint_main([PKG, "--root", ROOT]) == 0
+    # clean tree against the real baseline -> 0 (subset coverage: entries
+    # outside ray_tpu/core are simply not consulted)
+    assert lint_main([CORE, "--root", ROOT]) == 0
     # same tree with an empty baseline -> 1 iff any findings exist at all
     empty = tmp_path / "empty.json"
     empty.write_text('{"version": 1, "tool": "tpulint", "entries": {}}')
-    findings = lint_paths([PKG], root=ROOT)
+    findings = lint_paths([CORE], root=ROOT)
     expected = 1 if findings else 0
-    assert lint_main([PKG, "--root", ROOT, "--baseline", str(empty)]) == expected
+    assert lint_main([CORE, "--root", ROOT, "--baseline", str(empty)]) == expected
 
 
 def test_cli_update_baseline_roundtrip(tmp_path):
     out = tmp_path / "bl.json"
-    assert lint_main([PKG, "--root", ROOT, "--baseline", str(out), "--update-baseline"]) == 0
+    assert lint_main([CORE, "--root", ROOT, "--baseline", str(out), "--update-baseline"]) == 0
     doc = json.loads(out.read_text())
     assert doc["tool"] == "tpulint" and isinstance(doc["entries"], dict)
     # a freshly-written baseline always yields a clean run
-    assert lint_main([PKG, "--root", ROOT, "--baseline", str(out)]) == 0
+    assert lint_main([CORE, "--root", ROOT, "--baseline", str(out)]) == 0
 
 
 def test_cli_select_restricts_rules():
@@ -91,29 +99,30 @@ def test_cli_subset_runs_have_no_phantom_staleness(tmp_path):
     # the real baseline's node_agent TPL006 entries are OUTSIDE ray_tpu/ops
     # (and outside --select TPL001): neither run may call them stale
     assert lint_main([os.path.join(PKG, "ops"), "--root", ROOT]) == 0
-    assert lint_main([PKG, "--root", ROOT, "--select", "TPL001"]) == 0
+    assert lint_main([CORE, "--root", ROOT, "--select", "TPL001"]) == 0
 
 
 def test_cli_update_baseline_merges_outside_coverage(tmp_path):
     out = tmp_path / "bl.json"
-    # full-tree accept first
-    assert lint_main([PKG, "--root", ROOT, "--baseline", str(out), "--update-baseline"]) == 0
+    # two-subtree accept first (core holds the baseline's entries)
+    assert lint_main([CORE, os.path.join(PKG, "ops"), "--root", ROOT, "--baseline", str(out), "--update-baseline"]) == 0
     before = json.loads(out.read_text())["entries"]
+    assert before, "fixture needs accepted entries outside ray_tpu/ops"
     # subset re-accept must keep entries for files outside ray_tpu/ops
     assert lint_main([os.path.join(PKG, "ops"), "--root", ROOT, "--baseline", str(out), "--update-baseline"]) == 0
     after = json.loads(out.read_text())["entries"]
     assert after == before, "subset --update-baseline dropped out-of-coverage entries"
-    # and the merged file still yields a clean full run
-    assert lint_main([PKG, "--root", ROOT, "--baseline", str(out)]) == 0
+    # and the merged file still yields a clean run over both subtrees
+    assert lint_main([CORE, os.path.join(PKG, "ops"), "--root", ROOT, "--baseline", str(out)]) == 0
 
 
 def test_cli_overlapping_paths_lint_each_file_once():
     # a tree plus a file inside it must not double-lint the file: the
     # duplicates would overflow the baseline's accepted counts
-    overlap = [PKG, os.path.join(PKG, "core", "node_agent.py")]
+    overlap = [CORE, os.path.join(PKG, "core", "node_agent.py")]
     assert lint_main(overlap + ["--root", ROOT]) == 0
     findings = lint_paths(overlap, root=ROOT)
-    assert findings == lint_paths([PKG], root=ROOT)
+    assert findings == lint_paths([CORE], root=ROOT)
 
 
 def test_cli_nonexistent_path_is_a_usage_error(tmp_path):
@@ -135,3 +144,163 @@ def test_module_entrypoint_and_rt_wiring():
         capture_output=True, text=True, cwd=ROOT, env=env, timeout=300,
     )
     assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+# ============================================================ jaxcheck gate
+def test_jaxcheck_self_check_runs_clean():
+    """The jaxpr-level pass over every registered entry point must be
+    clean: every deliberate exception is an inline per-arg disable with a
+    rationale (see model_runner.fused_step's tokens lane) or a baseline
+    entry. Any new JXC finding fails tier-1 until fixed or accepted."""
+    from ray_tpu.lint.jaxcheck import run_jaxcheck
+
+    findings = run_jaxcheck(root=ROOT)
+    d = bl.diff(findings, bl.load(bl.default_baseline_path()))
+    assert d.new == [], (
+        "jaxcheck found NEW jaxpr-level hazards:\n" + "\n".join(f.render() for f in d.new)
+    )
+
+
+def test_jaxcheck_traces_at_least_five_entries():
+    from ray_tpu.lint.jaxcheck import import_entry_modules, registry
+
+    import_entry_modules()
+    entries = registry.all_entries()
+    assert len(entries) >= 5, [e.name for e in entries]
+    # the registry spans all four target subsystems
+    subsystems = {e.name.split(".")[0] for e in entries}
+    assert {"llm", "parallel", "collective"} <= subsystems
+
+
+def test_cli_jax_flag_and_rt_wiring():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "lint", "ray_tpu", "--root", ROOT, "--jax"],
+        capture_output=True, text=True, cwd=ROOT, env=env, timeout=600,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    m = re.search(r"jaxcheck traced (\d+) entry point", r.stderr)
+    assert m and int(m.group(1)) >= 5, r.stderr
+
+
+def test_cli_list_rules_includes_jax_catalog(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("TPL001", "TPL007", "JXC001", "JXC006"):
+        assert rid in out
+
+
+def test_lint_gate_script_noop_without_changes(tmp_path):
+    # the CI gate must not die on a repo with no diff (e.g. a fresh clone)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "lint_gate.py"), "--base", "HEAD"],
+        capture_output=True, text=True, cwd=ROOT, env=env, timeout=600,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ------------------------------------------------------------- json format
+def test_cli_format_json_is_one_finding_per_line(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import ray_tpu\n\n"
+        "async def h(ref):\n"
+        "    return ray_tpu.get(ref)\n\n"
+        "def drop(f):\n"
+        "    f.remote()\n"
+    )
+    assert lint_main([str(bad), "--root", str(tmp_path), "--no-baseline", "--format=json"]) == 1
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(lines) == 2
+    rules = set()
+    for ln in lines:
+        doc = json.loads(ln)  # every line parses on its own
+        assert {"rule", "path", "line", "fingerprint", "message"} <= set(doc)
+        assert doc["path"] == "bad.py" and len(doc["fingerprint"]) == 16
+        rules.add(doc["rule"])
+    assert rules == {"TPL001", "TPL002"}
+
+
+def test_cli_format_json_reports_stale_entries(tmp_path, capsys):
+    stale = tmp_path / "stale.json"
+    stale.write_text(json.dumps({
+        "version": 1, "tool": "tpulint",
+        "entries": {"feedfacefeedface": {
+            "rule": "TPL006", "path": "ray_tpu/ops/layers.py",
+            "context": "nope", "message": "never existed", "count": 1,
+        }},
+    }))
+    assert lint_main([os.path.join(PKG, "ops"), "--root", ROOT,
+                      "--baseline", str(stale), "--format=json"]) == 1
+    docs = [json.loads(ln) for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert any(d.get("stale") and d.get("fingerprint") == "feedfacefeedface" for d in docs)
+
+
+# ------------------------------------------ baseline merge semantics (PR 2)
+def _entries(path):
+    return json.loads(path.read_text())["entries"]
+
+
+def test_update_baseline_with_select_keeps_out_of_coverage_verbatim(tmp_path):
+    """--update-baseline restricted by --select must keep every entry for
+    deselected rules byte-for-byte, even in the same files. (Scoped to
+    ray_tpu/core — where the checked-in baseline's entries live — to keep
+    the tier-1 wall-clock down; coverage semantics are path-independent.)"""
+    core = os.path.join(PKG, "core")
+    out = tmp_path / "bl.json"
+    assert lint_main([core, "--root", ROOT, "--baseline", str(out), "--update-baseline"]) == 0
+    before = _entries(out)
+    assert any(e["rule"] != "TPL001" for e in before.values()), "fixture needs non-TPL001 entries"
+    # TPL001-only accept: every non-TPL001 entry is outside coverage
+    assert lint_main([core, "--root", ROOT, "--baseline", str(out),
+                      "--select", "TPL001", "--update-baseline"]) == 0
+    after = _entries(out)
+    assert {fp: e for fp, e in after.items() if e["rule"] != "TPL001"} == \
+           {fp: e for fp, e in before.items() if e["rule"] != "TPL001"}
+    # and the full run against the merged file is still clean
+    assert lint_main([core, "--root", ROOT, "--baseline", str(out)]) == 0
+
+
+def test_update_baseline_drops_stale_only_inside_coverage(tmp_path):
+    """A stale entry is dropped by an update that COVERS it and kept
+    verbatim (never resurrected, never duplicated) by one that doesn't."""
+    core = os.path.join(PKG, "core")
+    out = tmp_path / "bl.json"
+    assert lint_main([core, "--root", ROOT, "--baseline", str(out), "--update-baseline"]) == 0
+    doc = json.loads(out.read_text())
+    ghost = {"rule": "TPL006", "path": "ray_tpu/core/node_agent.py",
+             "context": "ghost", "message": "no longer reproduces", "count": 1}
+    doc["entries"]["feedfacefeedface"] = ghost
+    out.write_text(json.dumps(doc))
+    # TPL001-only update: the TPL006 ghost is out of coverage -> kept verbatim
+    assert lint_main([core, "--root", ROOT, "--baseline", str(out),
+                      "--select", "TPL001", "--update-baseline"]) == 0
+    assert _entries(out).get("feedfacefeedface") == ghost
+    # TPL006-covering update over its tree: ghost is stale -> dropped
+    assert lint_main([core, "--root", ROOT, "--baseline", str(out),
+                      "--select", "TPL006", "--update-baseline"]) == 0
+    assert "feedfacefeedface" not in _entries(out)
+    # ...and a later out-of-coverage update must NOT resurrect it
+    assert lint_main([core, "--root", ROOT, "--baseline", str(out),
+                      "--select", "TPL001", "--update-baseline"]) == 0
+    assert "feedfacefeedface" not in _entries(out)
+    assert lint_main([core, "--root", ROOT, "--baseline", str(out)]) == 0
+
+
+def test_lint_gate_tolerates_git_hook_args(tmp_path):
+    # git invokes pre-push hooks as `hook <remote> <url>`; the documented
+    # symlink install must not argparse-error on those positionals
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "lint_gate.py"),
+         "--base", "HEAD", "origin", "ssh://example/repo.git"],
+        capture_output=True, text=True, cwd=ROOT, env=env, timeout=600,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_jax_only_select_skips_ast_but_validates_paths(tmp_path):
+    # a jax-only --select must not die on "no rules match", and a typo'd
+    # path is still a usage error even though the AST pass is skipped
+    assert lint_main([str(tmp_path / "nope"), "--root", ROOT, "--jax", "--select", "JXC001"]) == 2

@@ -480,3 +480,417 @@ def test_baseline_counts_cap_accepted_duplicates(tmp_path):
 def test_syntax_error_reported_not_raised():
     out = lint_source("def broken(:\n", path="bad.py")
     assert len(out) == 1 and out[0].rule == "TPLERR"
+
+
+# ---------------------------------------------------- TPL001 interprocedural
+def test_tpl001_follows_call_into_module_helper():
+    out = run("""
+        import ray_tpu
+
+        def _collect(refs):
+            return ray_tpu.get(refs)
+
+        @ray_tpu.remote
+        class Pump:
+            def step(self, refs):
+                return _collect(refs)
+    """, "TPL001")
+    assert len(out) == 1
+    assert out[0].context == "Pump.step" and "_collect" in out[0].message
+
+
+def test_tpl001_follows_call_from_async_def():
+    out = run("""
+        import ray_tpu
+
+        def _collect(refs):
+            return ray_tpu.get(refs)
+
+        async def handler(refs):
+            return _collect(refs)
+    """, "TPL001")
+    assert len(out) == 1 and "event loop" in out[0].message
+
+
+def test_tpl001_interprocedural_silent_cases():
+    # bounded helper, async helper (flagged on its own body instead),
+    # call from a plain function: all silent at the call site
+    assert run("""
+        import ray_tpu
+
+        def _bounded(refs):
+            return ray_tpu.get(refs, timeout=5.0)
+
+        @ray_tpu.remote
+        class Pump:
+            def step(self, refs):
+                return _bounded(refs)
+    """, "TPL001") == []
+    assert run("""
+        import ray_tpu
+
+        def _collect(refs):
+            return ray_tpu.get(refs)
+
+        def plain(refs):
+            return _collect(refs)
+    """, "TPL001") == []
+    # async helper: exactly ONE finding (on the helper body), not two
+    out = run("""
+        import ray_tpu
+
+        async def _acollect(refs):
+            return ray_tpu.get(refs)
+
+        @ray_tpu.remote
+        class Pump:
+            async def step(self, refs):
+                return await _acollect(refs)
+    """, "TPL001")
+    assert len(out) == 1 and out[0].context == "_acollect"
+
+
+def test_tpl001_helper_nested_def_does_not_leak():
+    # a closure DEFINED in the helper doesn't run when the helper runs
+    assert run("""
+        import ray_tpu
+
+        def _factory():
+            def inner(refs):
+                return ray_tpu.get(refs)
+            return inner
+
+        @ray_tpu.remote
+        class Pump:
+            def step(self, refs):
+                return _factory()
+    """, "TPL001") == []
+
+
+# ---------------------------------------------------- TPL002 interprocedural
+def test_tpl002_flags_dropped_helper_returned_ref():
+    out = run("""
+        def kick(f, x):
+            return f.remote(x)
+
+        def driver(f):
+            kick(f, 1)
+    """, "TPL002")
+    assert len(out) == 1
+    assert out[0].context == "driver" and "kick" in out[0].message
+
+
+def test_tpl002_interprocedural_silent_when_bound_or_not_a_ref():
+    assert run("""
+        def kick(f, x):
+            return f.remote(x)
+
+        def driver(f):
+            ref = kick(f, 1)
+            return ref
+    """, "TPL002") == []
+    assert run("""
+        def log(x):
+            return str(x)
+
+        def driver(f):
+            log(1)
+    """, "TPL002") == []
+
+
+# ------------------------------------------------------ TPL005 partial forms
+def test_tpl005_flags_variable_bound_partial_target():
+    out = run("""
+        import jax, time, functools
+
+        def decode_step(params, cfg):
+            time.time()
+            return params
+
+        step = functools.partial(decode_step, cfg=1)
+        fn = jax.jit(step, donate_argnums=(1,))
+    """, "TPL005")
+    assert len(out) == 1 and out[0].context == "decode_step"
+
+
+def test_tpl005_flags_plain_alias_and_inline_partial():
+    out = run("""
+        import jax, time
+        from functools import partial
+
+        def decode_step(params, cfg):
+            time.time()
+            return params
+
+        fn = jax.jit(partial(decode_step, cfg=1))
+    """, "TPL005")
+    assert len(out) == 1
+    out2 = run("""
+        import jax, time
+
+        def decode_step(params):
+            time.time()
+            return params
+
+        alias = decode_step
+        fn = jax.jit(alias)
+    """, "TPL005")
+    assert len(out2) == 1
+
+
+def test_tpl005_silent_on_unjitted_partial():
+    assert run("""
+        import time, functools
+
+        def decode_step(params, cfg):
+            time.time()
+            return params
+
+        step = functools.partial(decode_step, cfg=1)
+    """, "TPL005") == []
+
+
+# =========================================================== jaxcheck (JXC)
+# Synthetic entries traced through the real driver: every rule gets one
+# fixture that MUST fire and one that MUST stay silent. Specs are built
+# directly (not via the decorator) so the global registry stays untouched.
+import os
+
+import numpy as np
+
+from ray_tpu.lint.jaxcheck.registry import EntrySpec
+from ray_tpu.lint.jaxcheck.driver import run_jaxcheck
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spec(fn, shapes, **kw):
+    return EntrySpec(
+        name=f"fixture.{fn.__name__}", fn=fn, shapes=shapes,
+        path=fn.__code__.co_filename, line=fn.__code__.co_firstlineno, **kw,
+    )
+
+
+def _findings(spec, rule_id):
+    return [f for f in run_jaxcheck(root=_ROOT, entries=[spec]) if f.rule == rule_id]
+
+
+def _f32(*shape):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _bf16(*shape):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, jnp.bfloat16)
+
+
+# ------------------------------------------------------------------ JXC001
+def _jx_state_step(cache, delta):
+    return cache + delta, delta.sum()
+
+
+def test_jxc001_flags_undonated_state_and_silent_when_donated():
+    shapes = {"b": lambda: ((_f32(512, 512), _f32(512, 512)), {})}
+    out = _findings(_spec(_jx_state_step, shapes), "JXC001")
+    # cache's shape reappears in the output; neither input donated -> one
+    # flag (the second matching input has no unclaimed output left)
+    assert len(out) == 1 and "'cache'" in out[0].message
+    assert _findings(_spec(_jx_state_step, shapes, donate=("cache",)), "JXC001") == []
+
+
+def test_jxc001_threshold_spares_small_buffers():
+    shapes = {"b": lambda: ((_f32(8), _f32(8)), {})}
+    assert _findings(_spec(_jx_state_step, shapes), "JXC001") == []  # default 1 MiB floor
+    assert len(_findings(_spec(_jx_state_step, shapes, donate_bytes=0), "JXC001")) == 1
+
+
+# ------------------------------------------------------------------ JXC002
+def _np_identity(v):
+    return np.asarray(v)
+
+
+def _jx_with_callback(x):
+    import jax
+
+    return jax.pure_callback(_np_identity, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+
+def _jx_pure(x):
+    return x * 2.0
+
+
+def test_jxc002_flags_host_callback_and_silent_on_pure():
+    out = _findings(_spec(_jx_with_callback, {"b": lambda: ((_f32(64, 64),), {})}), "JXC002")
+    assert len(out) == 1 and "pure_callback" in out[0].message
+    assert _findings(_spec(_jx_pure, {"b": lambda: ((_f32(64, 64),), {})}), "JXC002") == []
+
+
+def test_jxcerr_on_host_coercion_that_breaks_the_trace():
+    def _jx_concretizes(x):
+        return _np_identity(x).sum()
+
+    spec = _spec(_jx_concretizes, {"b": lambda: ((_f32(8, 8),), {})})
+    out = [f for f in run_jaxcheck(root=_ROOT, entries=[spec]) if f.rule == "JXCERR"]
+    assert len(out) == 1 and "failed to trace" in out[0].message
+
+
+# ------------------------------------------------------------------ JXC003
+def _jx_upcast_dot(a, b):
+    import jax.numpy as jnp
+
+    return a.astype(jnp.float32) @ b.astype(jnp.float32)
+
+
+def _jx_mxu_dot(a, b):
+    import jax.numpy as jnp
+
+    return jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+
+def test_jxc003_flags_bf16_upcast_matmul_and_silent_on_preferred_accumulate():
+    shapes = {"b": lambda: ((_bf16(512, 512), _bf16(512, 512)), {})}
+    out = _findings(_spec(_jx_upcast_dot, shapes), "JXC003")
+    assert out and "bf16" in out[0].message
+    assert _findings(_spec(_jx_mxu_dot, shapes), "JXC003") == []
+
+
+# ------------------------------------------------------------------ JXC004
+def _jx_scaled(x, n):
+    return x * n
+
+
+def test_jxc004_flags_baked_python_scalar_and_silent_when_traced():
+    baked = {"b": lambda: ((_f32(128, 128), 2), {})}  # n static-bound, like partial(fn, n=2)
+    out = _findings(_spec(_jx_scaled, baked, varying={"n": (2, 3)}), "JXC004")
+    assert len(out) == 1 and "'n'" in out[0].message and "recompile" in out[0].message
+    # production passes n as a traced 0-d array -> nothing static to probe
+    import jax
+    import jax.numpy as jnp
+
+    traced = {"b": lambda: ((_f32(128, 128), jax.ShapeDtypeStruct((), jnp.float32)), {})}
+    assert _findings(_spec(_jx_scaled, traced, varying={"n": (2, 3)}), "JXC004") == []
+
+
+def test_jxc004_silent_without_probe():
+    assert _findings(_spec(_jx_scaled, {"b": lambda: ((_f32(8, 8), 2), {})}), "JXC004") == []
+
+
+# ------------------------------------------------------------------ JXC005
+def _mesh2():
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    return Mesh(_np.asarray(jax.devices("cpu")[:2]), ("dp",))
+
+
+def _jx_psum_dp(x):
+    import jax
+
+    return jax.lax.psum(x, "dp")
+
+
+def _jx_collective_entry(x):
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    return shard_map(_jx_psum_dp, mesh=_mesh2(), in_specs=P("dp"), out_specs=P(), check_rep=False)(x)
+
+
+def test_jxc005_flags_axis_outside_declared_mesh_and_silent_when_declared():
+    shapes = {"b": lambda: ((_f32(8, 64),), {})}
+    out = _findings(_spec(_jx_collective_entry, shapes, mesh_axes=("tp",)), "JXC005")
+    assert len(out) == 1 and "'dp'" in out[0].message
+    assert _findings(_spec(_jx_collective_entry, shapes, mesh_axes=("dp",)), "JXC005") == []
+
+
+def _jx_branchy_psum(x):
+    import jax
+
+    def local(v):
+        return jax.lax.cond(v.sum() > 0, lambda u: jax.lax.psum(u, "dp"), lambda u: u * 2.0, v)
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    return shard_map(local, mesh=_mesh2(), in_specs=P("dp"), out_specs=P("dp"), check_rep=False)(x)
+
+
+def test_jxc005_flags_collective_diverging_across_cond_branches():
+    out = _findings(_spec(_jx_branchy_psum, {"b": lambda: ((_f32(8, 64),), {})}, mesh_axes=("dp",)), "JXC005")
+    assert len(out) == 1 and "branches" in out[0].message
+
+
+# ------------------------------------------------------------------ JXC006
+def test_jxc006_flags_tile_hostile_trailing_dims_and_silent_on_aligned():
+    hostile = {"b": lambda: ((_f32(4096, 130),), {})}  # 130 -> 256 lanes: 49% waste
+    out = _findings(_spec(_jx_pure, hostile), "JXC006")
+    assert len(out) == 1 and "(8,128)" in out[0].message
+    aligned = {"b": lambda: ((_f32(4096, 128),), {})}
+    assert _findings(_spec(_jx_pure, aligned), "JXC006") == []
+    small = {"b": lambda: ((_f32(8, 130),), {})}  # under the bytes floor
+    assert _findings(_spec(_jx_pure, small), "JXC006") == []
+
+
+# ------------------------------------------- jaxcheck on the real entries
+def test_fused_step_sampling_lane_donation_regression():
+    """The slots fused step donates its sampling lanes (keys/temps/top_k/
+    top_p) and passes them through; reverting to the pre-fix donation set
+    must resurface the JXC001 findings — while the tokens lane stays
+    suppressed by its inline per-arg disable."""
+    from dataclasses import replace
+
+    from ray_tpu.lint.jaxcheck import import_entry_modules, registry
+
+    import_entry_modules()
+    spec = registry.get_entry("llm.fused_step")
+    assert spec is not None
+    assert _findings(spec, "JXC001") == []  # fixed state is clean
+    old = replace(spec, donate=("cache", "keys"))
+    msgs = [f.message for f in _findings(old, "JXC001")]
+    assert len(msgs) == 3 and all(any(f"'{a}'" in m for m in msgs) for a in ("temps", "top_k", "top_p"))
+    assert not any("'tokens'" in m for m in msgs)  # inline disable still scopes to its own line
+
+
+def test_paged_fused_step_lane_donation_regression():
+    from dataclasses import replace
+
+    from ray_tpu.lint.jaxcheck import import_entry_modules, registry
+
+    import_entry_modules()
+    spec = registry.get_entry("llm.paged_fused_step")
+    assert spec is not None
+    assert _findings(spec, "JXC001") == []
+    old = replace(spec, donate=("lengths", "keys"))
+    assert len(_findings(old, "JXC001")) == 3
+
+
+def test_tpl001_bounded_helper_from_async_still_flags():
+    # mirrors the lexical gate exactly: a timeout bound clears the
+    # actor-deadlock case but a bounded get still parks an event loop
+    out = run("""
+        import ray_tpu
+
+        def _bounded(refs):
+            return ray_tpu.get(refs, timeout=30.0)
+
+        async def handler(refs):
+            return _bounded(refs)
+    """, "TPL001")
+    assert len(out) == 1 and "event loop" in out[0].message
+
+
+def test_jxcerr_on_rule_crash_instead_of_lint_crash():
+    # a JXC004 probe value whose re-trace raises must degrade to a
+    # finding, not take down the whole run
+    def _jx_div(x, n):
+        return x.reshape(x.shape[0] // n, -1)
+
+    spec = _spec(_jx_div, {"b": lambda: ((_f32(8, 8), 2), {})}, varying={"n": (2, 0)})
+    fs = run_jaxcheck(root=_ROOT, entries=[spec])
+    assert any(f.rule == "JXCERR" and "JXC004" in f.message for f in fs), fs
