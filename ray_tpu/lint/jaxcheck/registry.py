@@ -34,6 +34,8 @@ from typing import Callable
 # not in CLI code — so tests and the CI gate agree on coverage.
 ENTRY_MODULES = (
     "ray_tpu.llm.model_runner",
+    "ray_tpu.llm.spec.drafter",
+    "ray_tpu.llm.spec.verify",
     "ray_tpu.parallel.train_step",
     "ray_tpu.parallel.pipeline",
     "ray_tpu.collective.ici",

@@ -13,5 +13,6 @@ _rlu("llm")
 
 from ray_tpu.llm.engine import LLMEngine, RequestOutput
 from ray_tpu.llm.sampling import SamplingParams
+from ray_tpu.llm.spec import SpecConfig
 
-__all__ = ["LLMEngine", "RequestOutput", "SamplingParams"]
+__all__ = ["LLMEngine", "RequestOutput", "SamplingParams", "SpecConfig"]

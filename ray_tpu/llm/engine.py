@@ -17,7 +17,12 @@ python/ray/llm/_internal/serve/engines/vllm/vllm_engine.py):
 - a host-side scheduler does admission (waiting queue -> free slot),
   completion (eos / max_tokens / stop ids), and slot recycling between
   device steps against numpy shadow state. The device never sees dynamic
-  shapes, and nothing syncs the host per decode step.
+  shapes, and nothing syncs the host per decode step;
+- optional speculative decoding (speculative=SpecConfig(...), llm/spec/):
+  a drafter proposes up to k tokens per lane and one fused verify step
+  accepts/extends them — multiple tokens per tick, greedy output
+  token-identical to the plain path (which stays untouched as the
+  subsystem's equivalence oracle).
 
 `device_resident=False` (RT_LLM_DEVICE_RESIDENT=0) keeps the old
 synchronous host-driven loop as the equivalence oracle. Engine steps are
@@ -212,6 +217,7 @@ class LLMEngine:
         page_size: int = 64,
         device_resident: bool | None = None,
         batch_prefill: bool | None = None,
+        speculative=None,
     ):
         """kv_layout: "slots" (static per-sequence rows; llm/kv_cache.py)
         or "paged" (block-table page pool; llm/paged_kv.py — concurrency
@@ -228,7 +234,13 @@ class LLMEngine:
         host-driven loop (re-uploads + blocking readback per step), kept
         as the equivalence oracle. batch_prefill (default:
         RT_LLM_BATCH_PREFILL, on): same-bucket prompt prefills at
-        admission run as one batched forward."""
+        admission run as one batched forward.
+
+        speculative (llm.spec.SpecConfig | None): speculative decoding on
+        the device-resident loop — a drafter proposes up to k tokens per
+        lane and one fused verify step accepts/extends them (llm/spec/).
+        Greedy output stays token-identical to speculative=None, which is
+        the subsystem's equivalence oracle (tests/test_llm_spec.py)."""
         import jax
         import jax.numpy as jnp
 
@@ -375,6 +387,84 @@ class LLMEngine:
             if kv_layout == "paged":
                 self._dtables = _put(self._tables)
                 self._dlengths = _put(self._lengths)
+        self._spec_cfg = None
+        if speculative is not None:
+            if not self._device_resident:
+                raise ValueError(
+                    "speculative decoding runs on the device-resident loop only "
+                    "(the plain loop is kept untouched as its equivalence oracle)"
+                )
+            if mesh is not None:
+                raise ValueError("speculative decoding does not support tp meshes yet")
+            self._init_spec(speculative, _put)
+
+    def _init_spec(self, spec_cfg, _put):
+        """Speculative decoding state: drafter, adaptive-k controller,
+        per-lane device history/effective-k lanes, and the fused verify
+        program for this KV layout (llm/spec/)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.llm.spec import verify as specv
+        from ray_tpu.llm.spec.controller import AdaptiveKController, SpecConfig
+        from ray_tpu.llm.spec.drafter import ModelDrafter, NGramDrafter
+
+        if not isinstance(spec_cfg, SpecConfig):
+            raise TypeError(f"speculative must be a llm.spec.SpecConfig, got {type(spec_cfg).__name__}")
+        self._spec_cfg = spec_cfg
+        B, k = self.max_num_seqs, spec_cfg.k
+        if spec_cfg.drafter == "model":
+            dcfg = spec_cfg.draft_config
+            if dcfg is None:
+                raise ValueError("drafter='model' needs SpecConfig.draft_config (a smaller LlamaConfig)")
+            if dcfg.vocab_size != self.config.vocab_size:
+                raise ValueError(
+                    f"draft vocab ({dcfg.vocab_size}) must match the target's ({self.config.vocab_size})"
+                )
+            self._drafter = ModelDrafter(dcfg, params=spec_cfg.draft_params, k=k, seed=spec_cfg.draft_seed)
+        else:
+            self._drafter = NGramDrafter(k=k, n=spec_cfg.ngram)
+        self._drafter.init_slots(B, self.max_seq_len, self.prefill_buckets)
+        self._controller = AdaptiveKController(spec_cfg)
+        # token-history lanes: prompt + everything emitted on device, one
+        # round AHEAD of host emission (the drafter's matching corpus);
+        # +k+1 headroom so trailing-round writes never wrap
+        self._spec_hist_width = self.max_seq_len + k + 1
+        self._dhist = _put(jnp.zeros((B, self._spec_hist_width), jnp.int32))
+        self._dhist_len = _put(jnp.zeros((B,), jnp.int32))
+        self._dspec_k = _put(jnp.full((B,), k, jnp.int32))
+        self._lane_k = np.full((B,), k, np.int32)  # host mirror, updated with the device lane
+        if self.kv_layout == "paged":
+            self._verify_attn, self._verify_append = specv.make_spec_verify_paged(self.config, k)
+        else:
+            self._verify_step = specv.make_spec_verify_slots(self.config, k)
+        self._set_hist = jax.jit(specv.set_hist_row)
+        self._set_slot_scalar = jax.jit(specv.set_slot_scalar)
+        self._spec_rounds = self._spec_lane_rounds = 0
+        self._spec_proposed = self._spec_accepted = self._spec_emitted = 0
+
+    def spec_stats(self) -> dict:
+        """Speculation counters (empty when speculative decoding is off):
+        verify rounds, proposed/accepted totals, acceptance-rate and
+        tokens-per-round means, and each live request's effective k."""
+        with self._lock:
+            if self._spec_cfg is None:
+                return {}
+            return {
+                "drafter": self._drafter.kind,
+                "k": self._spec_cfg.k,
+                "rounds": self._spec_rounds,
+                "lane_rounds": self._spec_lane_rounds,
+                "proposed": self._spec_proposed,
+                "accepted": self._spec_accepted,
+                "emitted": self._spec_emitted,
+                "acceptance_rate": self._spec_accepted / max(self._spec_proposed, 1),
+                # per LANE per round: the per-sequence tokens/step multiplier
+                "mean_tokens_per_round": self._spec_emitted / max(self._spec_lane_rounds, 1),
+                "k_per_request": {
+                    rid: kk for rid, kk in self._controller.current().items() if rid in self._requests
+                },
+            }
 
     def _mesh_shardings(self, mesh):
         """Tensor-parallel serving (reference capability: the vLLM engine's
@@ -530,6 +620,8 @@ class LLMEngine:
     def _finish(self, st: RequestState, reason: str):
         st.finished = True
         st.finish_reason = reason
+        if self._spec_cfg is not None:
+            self._controller.forget(st.request_id)
         if st.slot >= 0:
             if self.kv_layout == "paged":
                 self._release_slot_pages(st.slot)
@@ -582,21 +674,25 @@ class LLMEngine:
         return True
 
     def _paged_grow(self):
-        """Before a decode step: any sequence whose next append crosses
-        into an unallocated page gets one (preempting the youngest OTHER
-        sequence when the pool is dry; a sequence that cannot grow at all
-        preempts itself back to waiting)."""
+        """Before a decode step: any sequence whose upcoming appends
+        cross into unallocated pages gets them (preempting the youngest
+        OTHER sequence when the pool is dry; a sequence that cannot grow
+        at all preempts itself back to waiting). Plain decode looks ahead
+        one token; a speculative lane needs up to k+1 appends for the
+        still-pending round plus k+1 for the round about to dispatch,
+        capped at the request's own prompt+max_tokens budget (KV past it
+        is never attended, so those writes may land in the trash page)."""
         page = self._pcfg.page_size
-        pending_lanes = (
-            {id(s) for s, _ in self._pending[2]}
-            if self._device_resident and self._pending is not None
-            else ()
-        )
+        spec = self._spec_cfg is not None
+        pending_k: dict = {}
+        if self._device_resident and self._pending is not None:
+            for entry in self._pending[-1]:  # lanes: (st, slot[, k_eff])
+                pending_k[id(entry[0])] = entry[2] if len(entry) > 2 else 0
         for st in [s for s in self._slots if s is not None]:
             if st.slot < 0 or self._slots[st.slot] is not st:
                 continue  # preempted by an earlier iteration's _preempt_for
-            if id(st) in pending_lanes and len(st.token_ids) + 1 >= st.params.max_tokens:
-                # the not-yet-drained token finishes this sequence at
+            if id(st) in pending_k and len(st.token_ids) + 1 >= st.params.max_tokens:
+                # the not-yet-drained round finishes this sequence at
                 # max_tokens: this call's step is its discarded trailing
                 # step — never grow (let alone PREEMPT a live sequence)
                 # for it; the unallocated-page write lands in the trash
@@ -604,30 +700,42 @@ class LLMEngine:
                 # already have freed the slot.
                 continue
             slot = st.slot
-            pg_ix = int(self._lengths[slot]) // page
-            if pg_ix < len(self._slot_pages[slot]):
+            l = int(self._lengths[slot])
+            if spec:
+                look = int(self._lane_k[slot]) + 1
+                if id(st) in pending_k:
+                    look += pending_k[id(st)] + 1
+                budget = len(st.prompt_token_ids) + st.params.max_tokens
+                horizon = min(l + look, max(budget, l))
+            else:
+                horizon = l + 1
+            if horizon <= l:
                 continue
-            if pg_ix >= self._pcfg.max_pages_per_seq:
+            target_pg = (horizon - 1) // page + 1
+            if not spec and target_pg > self._pcfg.max_pages_per_seq:
                 self._finish(st, "length")  # cache row exhausted
                 continue
-            got = self._page_alloc.alloc(1)
-            if got is None and self._preempt_for(1, exclude=st):
+            target_pg = min(target_pg, self._pcfg.max_pages_per_seq)
+            while len(self._slot_pages[slot]) < target_pg:
                 got = self._page_alloc.alloc(1)
-            if got is None:
-                # nothing left to preempt: this sequence itself re-queues
-                st.preemptions += 1
-                self.preemption_count += 1
-                self._release_slot_pages(slot)
-                self._slots[slot] = None
-                st.slot = -1
-                self._waiting.appendleft(st)
-                continue
-            self._slot_pages[slot].extend(got)
-            self._tables[slot, pg_ix] = got[0]
-            if self._device_resident:
-                self._dtables = self._set_table_cell(
-                    self._dtables, np.int32(slot), np.int32(pg_ix), np.int32(got[0])
-                )
+                if got is None and self._preempt_for(1, exclude=st):
+                    got = self._page_alloc.alloc(1)
+                if got is None:
+                    # nothing left to preempt: this sequence itself re-queues
+                    st.preemptions += 1
+                    self.preemption_count += 1
+                    self._release_slot_pages(slot)
+                    self._slots[slot] = None
+                    st.slot = -1
+                    self._waiting.appendleft(st)
+                    break
+                pg_ix = len(self._slot_pages[slot])
+                self._slot_pages[slot].extend(got)
+                self._tables[slot, pg_ix] = got[0]
+                if self._device_resident:
+                    self._dtables = self._set_table_cell(
+                        self._dtables, np.int32(slot), np.int32(pg_ix), np.int32(got[0])
+                    )
 
     def _pages_needed(self, st: RequestState, pref, prompt) -> int | None:
         """Pages a request needs to admit (prompt bucket + one decode
@@ -877,7 +985,33 @@ class LLMEngine:
                 np.int32(p.top_k),
                 np.float32(p.top_p),
             )
+        spec_hist = (st.prompt_token_ids + st.token_ids + [token]) if self._spec_cfg is not None else None
         self._emit(st, token, float(logp[0]))
+        if spec_hist is not None:
+            self._spec_admit(st, slot, spec_hist)
+
+    def _spec_admit(self, st: RequestState, slot: int, hist_tokens: list):
+        """Spec lane state for a freshly admitted sequence: the token
+        history row (prompt + recompute-folded generation + the first
+        sampled token), the controller's sticky effective k, and the
+        drafter's own prefill. A request that finished at admission
+        (stop/max_tokens on the first token) never drafts."""
+        import jax.numpy as jnp
+
+        if st.finished or st.slot != slot:
+            return
+        n = len(hist_tokens)
+        row = np.zeros((self._spec_hist_width,), np.int32)
+        row[:n] = hist_tokens
+        k0 = self._controller.admit(st.request_id)
+        self._lane_k[slot] = k0
+        self._dhist, self._dhist_len, self._dspec_k = self._set_hist(
+            self._dhist, self._dhist_len, self._dspec_k,
+            np.int32(slot), jnp.asarray(row), np.int32(n), np.int32(k0),
+        )
+        # the drafter caches everything the target has cached: the full
+        # admitted prompt, NOT the fresh token (the first chain input)
+        self._drafter.admit(slot, hist_tokens[:-1])
 
     def _emit(self, st: RequestState, token: int, logp: float):
         st.token_ids.append(token)
@@ -900,7 +1034,12 @@ class LLMEngine:
         step N's host transfer overlaps step N+1's device compute —
         emission (streaming tokens, finish detection, slot recycling)
         trails the device by exactly one step, and each sequence runs up
-        to one speculative trailing step whose token is discarded.
+        to one discarded trailing step. Under speculation that trailing
+        step would cost a whole drafter round (up to k verifications), so
+        wasted work is capped: a round whose every lane is guaranteed to
+        finish from the still-pending round is skipped outright, and a
+        finished lane never enters another round — at most ONE drafter
+        round ever runs past a request's finish detection.
         """
         with self._lock:
             admitted = self._admission_wave()
@@ -909,8 +1048,12 @@ class LLMEngine:
             if self._device_resident:
                 prev = self._pending
                 self._pending = None
-                self._dispatch_fused()
-                emitted = self._drain(prev)
+                if self._spec_cfg is not None:
+                    self._dispatch_spec(prev)
+                    emitted = self._drain_spec(prev)
+                else:
+                    self._dispatch_fused()
+                    emitted = self._drain(prev)
                 reported = admitted + emitted
             else:
                 reported = self._sync_decode()
@@ -967,6 +1110,117 @@ class LLMEngine:
             if st.finished:
                 continue  # aborted (or finished) between dispatch and drain
             self._emit(st, int(toks[slot]), float(logps[slot]))
+            emitted.append(st)
+        return emitted
+
+    def _dispatch_spec(self, prev):
+        """Launch one speculative round (draft -> fused verify) for the
+        current occupancy; never blocks on results. The drafter reads the
+        device history/length lanes the PREVIOUS verify step wrote, so
+        draft chains on verify without any host round trip."""
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            return
+        if prev is not None:
+            # wasted-work cap: the pending round emits >= 1 token per
+            # lane, so a lane within one token of max_tokens is finished
+            # no matter what drains — if EVERY active lane is, this round
+            # could only produce discarded tokens; skip it entirely
+            pend = {id(entry[0]) for entry in prev[3]}
+            if all(
+                id(s) in pend and len(s.token_ids) + 1 >= s.params.max_tokens for s in active
+            ):
+                return
+        lengths_lane = self._dlengths if self.kv_layout == "paged" else self.cache["length"]
+        props = self._drafter.propose(self._dhist, self._dhist_len, lengths_lane)
+        if self.kv_layout == "paged":
+            (emit, logps, acc, toks, self._dkeys, k_blk, v_blk, wp, wo, self._dlengths,
+             self._dtemps, self._dtopk, self._dtopp, self._dspec_k,
+             self._dhist, self._dhist_len) = self._verify_attn(
+                self.params,
+                self.pool,
+                self._dtables,
+                self._dlengths,
+                props,
+                self._dtokens,
+                self._dkeys,
+                self._dtemps,
+                self._dtopk,
+                self._dtopp,
+                self._dspec_k,
+                self._dhist,
+                self._dhist_len,
+            )
+            self.pool = self._verify_append(self.pool, wp, wo, k_blk, v_blk)
+        else:
+            (self.cache, emit, logps, acc, toks, self._dkeys,
+             self._dtemps, self._dtopk, self._dtopp, self._dspec_k,
+             self._dhist, self._dhist_len) = self._verify_step(
+                self.params,
+                self.cache,
+                props,
+                self._dtokens,
+                self._dkeys,
+                self._dtemps,
+                self._dtopk,
+                self._dtopp,
+                self._dspec_k,
+                self._dhist,
+                self._dhist_len,
+            )
+        self._dtokens = toks
+        self._spec_rounds += 1
+        lanes = [(st, st.slot, int(self._lane_k[st.slot])) for st in active]
+        self._pending = (emit, logps, acc, lanes)
+
+    def _drain_spec(self, pending) -> list:
+        """Read back and emit the PREVIOUS speculative round: up to
+        accepted+1 tokens per lane, stopping at finish (stop ids /
+        max_tokens mid-round) and, for the paged layout, at the cache
+        row's capacity — the same point the plain path's page growth
+        finishes a row-exhausted sequence with reason 'length'."""
+        if pending is None:
+            return []
+        emit_d, logps_d, acc_d, lanes = pending
+        emit = np.asarray(emit_d)
+        logps = np.asarray(logps_d)
+        acc = np.asarray(acc_d)
+        row_cap = (
+            self._pcfg.max_pages_per_seq * self._pcfg.page_size if self.kv_layout == "paged" else None
+        )
+        emitted = []
+        for st, slot, k_eff in lanes:
+            if st.finished:
+                continue  # aborted (or finished) between dispatch and drain
+            a = int(acc[slot])
+            n_new = a + 1
+            cap = n_new
+            if row_cap is not None:
+                owns = self._slots[slot] is st
+                if owns:
+                    # a recompute-preempted lane's shadow was already
+                    # reset; only a live occupant mirrors the device's
+                    # length advance
+                    cap = max(row_cap - int(self._lengths[slot]), 0)
+                    self._lengths[slot] += n_new
+            self._spec_proposed += k_eff
+            self._spec_accepted += a
+            self._spec_lane_rounds += 1
+            for i in range(min(n_new, cap)):
+                self._emit(st, int(emit[slot, i]), float(logps[slot, i]))
+                self._spec_emitted += 1
+                if st.finished:
+                    break
+            if not st.finished and cap < n_new:
+                # accepted tokens past the row edge had their KV dropped
+                # to the trash page; the plain path would have finished
+                # this row at the same token
+                self._finish(st, "length")
+            if not st.finished:
+                new_k = self._controller.observe(st.request_id, k_eff, a)
+                if st.slot == slot and new_k != self._lane_k[slot]:
+                    self._lane_k[slot] = new_k
+                    self._dspec_k = self._set_slot_scalar(self._dspec_k, np.int32(slot), np.int32(new_k))
             emitted.append(st)
         return emitted
 

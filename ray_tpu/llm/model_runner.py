@@ -29,6 +29,8 @@ from ray_tpu.ops.layers import apply_rope, rms_norm, rotary_embedding
 # jaxcheck shape buckets: production-realistic abstract shapes (tile-true
 # head_dim/hidden so JXC006's (8,128) math is meaningful; ShapeDtypeStructs
 # only — nothing here allocates). B is the slot count, S the KV horizon.
+# The _sds*/_trace_cfg helpers double as the bucket toolkit for the
+# speculative entries in llm/spec/ (drafter.py / verify.py).
 # ---------------------------------------------------------------------------
 def _trace_cfg() -> LlamaConfig:
     return LlamaConfig(
@@ -172,6 +174,12 @@ def decode_step(params, cache, tokens, cfg: LlamaConfig):
     slots); cache: kv_cache pytree. Returns (logits [slots, vocab] f32,
     new cache). The new token is written at position cache.length[b] and
     attends to positions 0..length[b] inclusive.
+
+    CONTRACT: the speculative draft scan (llm/spec/drafter.py
+    draft_steps) chains this k+1 times inside one program with an
+    overridden length lane — masking must stay a pure function of the
+    carried cache (no cross-call state), so chained and single-step use
+    trace identically.
     """
     B = tokens.shape[0]
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd

@@ -171,6 +171,11 @@ def _paged_attn_seq(qg, pool_k_l, pool_v_l, table_row, start, k_chunk, v_chunk, 
     k_chunk/v_chunk: [T, kv, hd]. Query t (absolute position start+t)
     attends prefix fully and chunk positions 0..t. Returns
     [nkv, rep, T, hd] float32.
+
+    CONTRACT: this function is also vmapped over lanes by the
+    speculative verify step (llm/spec/verify.py spec_verify_paged, with
+    T = k+1) — keep it free of lane-global logic so per-sequence and
+    batched uses stay the same program.
     """
     nkv, rep, T, hd = qg.shape
     page = pool_k_l.shape[1]

@@ -59,6 +59,20 @@ def _apply_top_p(logits, top_p):
     return jnp.where(logits >= thresh, logits, -jnp.inf)
 
 
+def filter_logits(logits, temperature, top_k, top_p):
+    """Temperature-scale then top-k/top-p filter logits.
+
+    logits: [..., V]; temperature/top_p: [...] f32; top_k: [...] i32
+    (0 disables). The distribution surgery shared by sample() and the
+    speculative verify step (llm/spec/verify.py) — spec acceptance must
+    judge proposals against exactly the distribution plain sampling
+    draws from, or rejection sampling would drift off-policy.
+    """
+    scaled = logits / jnp.maximum(temperature, 1e-6)[..., None]
+    scaled = _apply_top_k(scaled, top_k)
+    return _apply_top_p(scaled, top_p)
+
+
 def sample(logits, key, temperature, top_k, top_p):
     """Sample one token per row.
 
@@ -71,9 +85,7 @@ def sample(logits, key, temperature, top_k, top_p):
 
     def _one(lg, k, temp, tk, tp):
         k1, k2 = jax.random.split(jax.random.wrap_key_data(k, impl="threefry2x32"))
-        scaled = lg / jnp.maximum(temp, 1e-6)
-        scaled = _apply_top_k(scaled[None], tk[None])[0]
-        scaled = _apply_top_p(scaled[None], tp[None])[0]
+        scaled = filter_logits(lg[None], temp[None], tk[None], tp[None])[0]
         tok = jax.random.categorical(k1, scaled)
         return tok, jax.random.key_data(k2)
 

@@ -1,0 +1,26 @@
+"""ray_tpu.llm.spec — speculative decoding for the device-resident loop.
+
+A cheap drafter proposes up to k continuation tokens per lane; ONE fused
+jitted verify step runs the target model over all k+1 positions at once
+(padded to a fixed k so shapes never vary), accepts the longest prefix the
+target agrees with (greedy exact-match, or one-hot rejection sampling for
+temperature > 0 — same output distribution, never the same compute), and
+rolls back rejected KV in O(1) by length decrement. Greedy output is
+token-identical to the non-speculative path, which stays untouched as the
+equivalence oracle (tests/test_llm_spec.py).
+
+Modules:
+- controller.py: `SpecConfig` (user-facing) + per-request adaptive-k EMA.
+- drafter.py: `Drafter` protocol; `NGramDrafter` (prompt-lookup, zero
+  extra weights, jittable) and `ModelDrafter` (small llama with its own
+  KV cache and fused draft scan).
+- verify.py: the fused verify step per KV layout, plus the O(1) lane
+  deltas the engine scatters at admission.
+
+Only the config layer imports here (no jax): the engine pulls drafter and
+verify modules lazily, exactly like the rest of `llm/`.
+"""
+
+from ray_tpu.llm.spec.controller import AdaptiveKController, SpecConfig
+
+__all__ = ["AdaptiveKController", "SpecConfig"]

@@ -1,0 +1,353 @@
+"""The fused speculative verify step: one jitted program per engine tick.
+
+Per lane the program takes the current input token t0 plus k proposals
+d1..dk (padded to the STATIC width k so shapes never vary), runs the
+target model over all k+1 positions in one wide forward, and:
+
+- accepts the longest proposal prefix the target agrees with — greedy
+  exact-match for temperature==0 lanes, one-hot rejection sampling
+  (accept d with prob p(d), resample a rejection from p-with-d-masked)
+  for temperature>0, where p is the target distribution AFTER the same
+  temperature/top-k/top-p surgery `sampling.sample` applies;
+- emits the accepted tokens plus one token from the target at the first
+  disagreement (the bonus/replacement), so every round emits >= 1;
+- appends the whole block's K/V (computed anyway) and rolls back
+  rejections in O(1) by setting length = l + accepted + 1 — positions
+  past the new length are dead until overwritten, exactly like the
+  garbage tail of a padded prefill;
+- advances the lane's token-history buffer (the drafter's input) on
+  device, so draft -> verify chains without any host sync.
+
+Layouts: the slot layout is ONE program (cache donated, functional
+update inside); the paged layout splits attention+accept from the pool
+scatter-append — a same-program gather+scatter on the pool buffer is
+the aliasing hazard documented on `decode_attn_paged`, and speculation
+does not change it. Writes past a slot row / page table land in dropped
+scatters / the trash page: they can only occur in rounds whose tokens
+the host has already discarded.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.lint import jaxcheck
+from ray_tpu.llm.model_runner import (
+    _mlp,
+    _qkv,
+    _sds,
+    _sds_cache,
+    _sds_lanes,
+    _sds_params,
+    _sds_pool,
+    _trace_cfg,
+)
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.ops.layers import apply_rope, rms_norm, rotary_embedding
+
+
+def _wrap(kd):
+    return jax.random.wrap_key_data(kd, impl="threefry2x32")
+
+
+# ---------------------------------------------------------------------------
+# acceptance + sampling (layout-independent)
+# ---------------------------------------------------------------------------
+def _accept_and_sample(logits, proposals, spec_k, keys, temps, top_k, top_p):
+    """logits: [B, k+1, V] target logits over (t0, d1..dk); proposals:
+    [B, k]. Returns (emit [B, k+1] i32, logps [B, k+1] f32, acc [B] i32,
+    final [B] i32, new_keys [B, 2] u32) where emit[:, :acc] are accepted
+    proposals, emit[:, acc] the bonus/replacement, and the rest garbage
+    the host never reads."""
+    from ray_tpu.llm.sampling import filter_logits
+
+    B, T, V = logits.shape
+    k = T - 1
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, T]
+    logp_full = jax.nn.log_softmax(logits, axis=-1)
+    # the SAME distribution surgery sample() applies, broadcast over T
+    filt = filter_logits(logits, temps[:, None], top_k[:, None], top_p[:, None])
+    probs = jax.nn.softmax(filt, axis=-1)  # [B, T, V]
+
+    # per-lane randomness: k accept draws + 1 replacement draw + next key
+    def _split(kd):
+        return jax.random.key_data(jax.random.split(_wrap(kd), k + 2))
+
+    subkeys = jax.vmap(_split)(keys)  # [B, k+2, 2]
+    u = jax.vmap(jax.vmap(lambda kd: jax.random.uniform(_wrap(kd), ())))(subkeys[:, :k])  # [B, k]
+
+    p_prop = jnp.take_along_axis(probs[:, :k], proposals[..., None], axis=-1)[..., 0]  # [B, k]
+    accept_greedy = proposals == greedy[:, :k]
+    accept_stoch = u < p_prop  # one-hot q: accept prob = p(d)
+    accept = jnp.where(temps[:, None] == 0.0, accept_greedy, accept_stoch)
+    accept = accept & (jnp.arange(k, dtype=jnp.int32)[None, :] < spec_k[:, None])
+    acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1).astype(jnp.int32)  # [B]
+
+    # final token from the first-disagreement position's target logits
+    lg_a = jnp.take_along_axis(logits, acc[:, None, None], axis=1)[:, 0]  # [B, V]
+    filt_a = jnp.take_along_axis(filt, acc[:, None, None], axis=1)[:, 0]
+    rejected = acc < jnp.minimum(spec_k, k)  # a proposal was examined and refused
+    d_rej = jnp.take_along_axis(proposals, jnp.minimum(acc, k - 1)[:, None], axis=1)[:, 0]
+    # one-hot-q residual max(p - q, 0): p with the refused token masked out
+    mask_rej = jax.nn.one_hot(d_rej, V, dtype=jnp.bool_) & rejected[:, None]
+    stoch_tok = jax.vmap(lambda kd, lg: jax.random.categorical(_wrap(kd), lg))(
+        subkeys[:, k], jnp.where(mask_rej, -jnp.inf, filt_a)
+    ).astype(jnp.int32)
+    greedy_tok = jnp.argmax(lg_a, axis=-1).astype(jnp.int32)
+    final = jnp.where(temps == 0.0, greedy_tok, stoch_tok)
+    new_keys = subkeys[:, k + 1]
+
+    cols = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    props_pad = jnp.pad(proposals, ((0, 0), (0, 1)))
+    emit = jnp.where(cols < acc[:, None], props_pad, 0)
+    emit = jnp.where(cols == acc[:, None], final[:, None], emit).astype(jnp.int32)
+    # logprobs from the UNfiltered distribution, as sample() reports them
+    lp_pad = jnp.pad(jnp.take_along_axis(logp_full[:, :k], proposals[..., None], axis=-1)[..., 0], ((0, 0), (0, 1)))
+    lp_a = jnp.take_along_axis(logp_full, acc[:, None, None], axis=1)[:, 0]
+    lp_fin = jnp.take_along_axis(lp_a, final[:, None], axis=1)[:, 0]
+    logps = jnp.where(cols < acc[:, None], lp_pad, 0.0)
+    logps = jnp.where(cols == acc[:, None], lp_fin[:, None], logps)
+    return emit, logps, acc, final, new_keys
+
+
+def _update_hist(hist, hist_len, emit, acc):
+    """Append the round's emitted tokens to the history lanes. All k+1
+    slots are written (past-acceptance garbage sits beyond the new valid
+    length and is overwritten by the next round before it could be read);
+    writes past the buffer edge are dropped — they only occur in rounds
+    whose tokens the host discards anyway."""
+    B, Tp1 = emit.shape
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    hpos = hist_len[:, None] + jnp.arange(Tp1, dtype=jnp.int32)[None, :]
+    return hist.at[rows, hpos].set(emit, mode="drop"), hist_len + acc + 1
+
+
+# ---------------------------------------------------------------------------
+# slot layout
+# ---------------------------------------------------------------------------
+def _forward_block_slots(params, cache, toks_blk, cfg: LlamaConfig):
+    """Target forward over T=k+1 tokens per slot at positions
+    length..length+T-1. Block K/V is written into the cache rows first
+    (per-position scatter, OOB dropped) and attention reads the updated
+    row with mask j <= position — the functional-update idiom
+    decode_step/fused_step already rely on (no pool-style aliasing
+    hazard in the slot layout). Returns (logits [B, T, V] f32, ks, vs)."""
+    B, T = toks_blk.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    rep = nh // nkv
+    S = cache["k"].shape[2]
+    lengths = cache["length"]
+    positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
+    cos, sin = rotary_embedding(positions, cfg.hd, cfg.rope_theta)  # [B, T, hd/2]
+    x = jnp.take(params["embed"], toks_blk, axis=0)  # [B, T, H]
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    # query i sits at position length+i and may attend cache 0..length+i
+    attn_ok = (jnp.arange(S, dtype=jnp.int32)[None, None, :] <= positions[:, :, None])[:, None, None]  # [B,1,1,T,S]
+
+    def layer_fn(x, xs):
+        layer, k_cache, v_cache = xs  # [B, S, kv, hd]
+        xn = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q, k_t, v_t = _qkv(xn, layer, cfg)  # [B, T, nh/nkv, hd]
+        qh = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)  # [B, nh, T, hd]
+        kh = apply_rope(k_t.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)  # [B, T, nkv, hd]
+        k_cache = k_cache.at[rows, positions].set(kh.astype(k_cache.dtype), mode="drop")
+        v_cache = v_cache.at[rows, positions].set(v_t.astype(v_cache.dtype), mode="drop")
+        qg = qh.reshape(B, nkv, rep, T, hd)
+        kc = k_cache.transpose(0, 2, 1, 3)  # [B, nkv, S, hd]
+        vc = v_cache.transpose(0, 2, 1, 3)
+        scores = jnp.einsum("bgrth,bgsh->bgrts", qg, kc, preferred_element_type=jnp.float32) / jnp.sqrt(hd)
+        scores = jnp.where(attn_ok, scores, -jnp.inf)
+        o = jnp.einsum("bgrts,bgsh->bgrth", jax.nn.softmax(scores, axis=-1), vc.astype(jnp.float32))
+        o = o.transpose(0, 3, 1, 2, 4).reshape(B, T, nh * hd).astype(x.dtype)
+        x = x + jnp.dot(o, layer["wo"])
+        x = _mlp(x, layer, cfg)
+        return x, (k_cache, v_cache)
+
+    x, (ks, vs) = jax.lax.scan(layer_fn, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
+    logits = jnp.einsum("bth,hv->btv", x, unembed, preferred_element_type=jnp.float32)
+    return logits, ks, vs
+
+
+def _bucket_spec_verify(B=8, S=256, k=4, H=517):
+    cfg = _trace_cfg()
+    tokens, keys, temps, top_k, top_p = _sds_lanes(B)
+    return (
+        _sds_params(cfg), _sds_cache(cfg, B, S), _sds((B, k), jnp.int32),
+        tokens, keys, temps, top_k, top_p, _sds((B,), jnp.int32),
+        _sds((B, H), jnp.int32), _sds((B,), jnp.int32), cfg,
+    ), {}
+
+
+@jaxcheck.entry(
+    name="llm.spec_verify",
+    shapes={"b8_s256": _bucket_spec_verify},
+    donate=("cache", "tokens", "keys", "temps", "top_k", "top_p", "spec_k", "hist", "hist_len"),
+    donate_bytes=0,  # the spec hot loop is audited like fused_step's
+)
+def spec_verify_slots(
+    params,
+    cache,
+    proposals,  # fresh drafter output, never re-read by the host: no buffer to save by donating
+    tokens,
+    keys,
+    temps,
+    top_k,
+    top_p,
+    spec_k,
+    hist,
+    hist_len,
+    cfg: LlamaConfig,
+):
+    """ONE program for the slot layout's speculative tick: wide target
+    forward over (t0, d1..dk) -> accept/sample -> append block KV ->
+    length rollback -> history append. Unlike fused_step, the sampled
+    TOKEN lane is also donated: the host reads the round's results from
+    the dedicated emit/logps/acc outputs, never from the token lane."""
+    toks_blk = jnp.concatenate([tokens[:, None], proposals], axis=1)
+    logits, ks, vs = _forward_block_slots(params, cache, toks_blk, cfg)
+    emit, logps, acc, final, new_keys = _accept_and_sample(
+        logits, proposals, spec_k, keys, temps, top_k, top_p
+    )
+    hist, hist_len = _update_hist(hist, hist_len, emit, acc)
+    new_cache = {"k": ks, "v": vs, "length": cache["length"] + acc + 1}
+    return new_cache, emit, logps, acc, final, new_keys, temps, top_k, top_p, spec_k, hist, hist_len
+
+
+def make_spec_verify_slots(cfg: LlamaConfig, k: int):
+    """Jit of spec_verify_slots with the production donation set (the
+    static width k is baked into the proposals shape by the caller)."""
+    del k  # shapes carry it; one compile per configured width
+    return jax.jit(partial(spec_verify_slots, cfg=cfg), donate_argnums=(1, 3, 4, 5, 6, 7, 8, 9, 10))
+
+
+# ---------------------------------------------------------------------------
+# paged layout
+# ---------------------------------------------------------------------------
+def _bucket_spec_verify_paged(B=8, pages=64, page=16, k=4, H=517):
+    cfg = _trace_cfg()
+    tokens, keys, temps, top_k, top_p = _sds_lanes(B)
+    return (
+        _sds_params(cfg), _sds_pool(cfg, pages, page), _sds((B, pages // B * 2), jnp.int32),
+        _sds((B,), jnp.int32), _sds((B, k), jnp.int32),
+        tokens, keys, temps, top_k, top_p, _sds((B,), jnp.int32),
+        _sds((B, H), jnp.int32), _sds((B,), jnp.int32), cfg,
+    ), {}
+
+
+@jaxcheck.entry(
+    name="llm.spec_verify_paged",
+    shapes={"b8_p64": _bucket_spec_verify_paged},
+    donate=("lengths", "tokens", "keys", "temps", "top_k", "top_p", "spec_k", "hist", "hist_len"),
+    donate_bytes=0,
+)
+def spec_verify_paged(
+    params,
+    pool,  # read-only by design (the gather/scatter aliasing hazard); donated by the append program instead
+    tables,
+    lengths,
+    proposals,  # fresh drafter output (see spec_verify_slots)
+    tokens,
+    keys,
+    temps,
+    top_k,
+    top_p,
+    spec_k,
+    hist,
+    hist_len,
+    cfg: LlamaConfig,
+):
+    """READ-ONLY half of the paged speculative tick: block attention over
+    the cached pages (prefix from the pool, the block itself in
+    registers via `_paged_attn_seq`, vmapped over lanes) + accept/sample
+    + write-target math; the pool scatter is spec_append_paged. Rows past
+    a lane's table edge redirect to the trash page — those positions only
+    arise in rounds whose tokens the host already discarded."""
+    from ray_tpu.llm.paged_kv import _paged_attn_seq
+
+    B, k = proposals.shape
+    T = k + 1
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    rep = nh // nkv
+    page = pool["k"].shape[2]
+    max_pg = tables.shape[1]
+    toks_blk = jnp.concatenate([tokens[:, None], proposals], axis=1)
+    positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
+    cos, sin = rotary_embedding(positions, cfg.hd, cfg.rope_theta)
+    x = jnp.take(params["embed"], toks_blk, axis=0)  # [B, T, H]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+
+    def layer_fn(x, xs):
+        layer, k_pool_l, v_pool_l = xs
+        xn = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q, k_t, v_t = _qkv(xn, layer, cfg)  # [B, T, nh/nkv, hd]
+        qh = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)  # [B, nh, T, hd]
+        kh = apply_rope(k_t.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)  # [B, T, nkv, hd]
+        qg = qh.reshape(B, nkv, rep, T, hd)
+        o = jax.vmap(_paged_attn_seq, in_axes=(0, None, None, 0, 0, 0, 0, None))(
+            qg, k_pool_l, v_pool_l, tables, lengths, kh, v_t, scale
+        )  # [B, nkv, rep, T, hd]
+        o = o.transpose(0, 3, 1, 2, 4).reshape(B, T, nh * hd).astype(x.dtype)
+        x = x + jnp.dot(o, layer["wo"])
+        x = _mlp(x, layer, cfg)
+        return x, (kh, v_t)
+
+    x, (k_blk, v_blk) = jax.lax.scan(layer_fn, x, (params["layers"], pool["k"], pool["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
+    logits = jnp.einsum("bth,hv->btv", x, unembed, preferred_element_type=jnp.float32)
+    emit, logps, acc, final, new_keys = _accept_and_sample(
+        logits, proposals, spec_k, keys, temps, top_k, top_p
+    )
+    hist, hist_len = _update_hist(hist, hist_len, emit, acc)
+    pg_ix = positions // page
+    wp = jnp.where(
+        pg_ix < max_pg,
+        tables[jnp.arange(B, dtype=jnp.int32)[:, None], jnp.minimum(pg_ix, max_pg - 1)],
+        0,
+    )
+    wo = positions % page
+    return (
+        emit, logps, acc, final, new_keys, k_blk, v_blk, wp, wo,
+        lengths + acc + 1, temps, top_k, top_p, spec_k, hist, hist_len,
+    )
+
+
+def spec_append_paged(pool, wp, wo, k_blk, v_blk):
+    """Scatter-only half of the paged speculative tick: write the whole
+    block's K/V ([L, B, T, kv, hd]) at (wp, wo) [B, T] for every layer.
+    Rejected positions land in the lane's own dead tail (or the trash
+    page) and are overwritten before the length rollback could expose
+    them."""
+    return {
+        "k": pool["k"].at[:, wp, wo].set(k_blk.astype(pool["k"].dtype)),
+        "v": pool["v"].at[:, wp, wo].set(v_blk.astype(pool["v"].dtype)),
+    }
+
+
+def make_spec_verify_paged(cfg: LlamaConfig, k: int):
+    """(attention+accept program, scatter-append program) for the paged
+    layout — two dispatches, never fused (see decode_attn_paged)."""
+    del k
+    attn_fn = jax.jit(partial(spec_verify_paged, cfg=cfg), donate_argnums=(3, 5, 6, 7, 8, 9, 10, 11, 12))
+    append_fn = jax.jit(spec_append_paged, donate_argnums=(0,))
+    return attn_fn, append_fn
+
+
+# ---------------------------------------------------------------------------
+# O(1) scheduler deltas for the spec lanes
+# ---------------------------------------------------------------------------
+def set_hist_row(hist, hist_len, spec_k, slot, row, n, k0):  # deltas donate nothing, as make_delta_fns documents
+    """Admission delta: one lane's token history, valid count and
+    effective k (the row upload is one [H] int32 — tiny)."""
+    return hist.at[slot].set(row), hist_len.at[slot].set(n), spec_k.at[slot].set(k0)
+
+
+def set_slot_scalar(arr, slot, val):
+    """O(1) jitted scatter: the controller's per-lane effective-k moves."""
+    return arr.at[slot].set(val)

@@ -46,6 +46,9 @@ class LLMConfig:
     # capability: vllm_models.py:215-228 tensor_parallel_size). Also sets
     # the replica's TPU resource request when num_tpus_per_replica is 0.
     tensor_parallel_size: int = 1
+    # speculative decoding (llm.spec.SpecConfig): forwarded to the engine
+    # unless engine_kwargs already carries its own "speculative"
+    speculative: object = None
 
 
 class LLMServer:
@@ -60,6 +63,8 @@ class LLMServer:
 
             cfg = LlamaConfig.tiny(dtype="float32")
         engine_kwargs = dict(llm_config.engine_kwargs)
+        if llm_config.speculative is not None:
+            engine_kwargs.setdefault("speculative", llm_config.speculative)
         tp = int(llm_config.tensor_parallel_size or 1)
         if tp > 1 and "mesh" not in engine_kwargs:
             import jax
@@ -169,6 +174,12 @@ class LLMServer:
 
     def prefix_cache_stats(self) -> dict:
         return self.engine.prefix_cache_stats()
+
+    def spec_stats(self) -> dict:
+        """Speculative decoding counters (empty when speculation is off):
+        acceptance rate, proposed/accepted totals, mean tokens per verify
+        round, per-request effective k."""
+        return self.engine.spec_stats()
 
     def __call__(self, request):
         """HTTP entry: POST {"prompt_token_ids": [...], "sampling_params": {...}}."""
