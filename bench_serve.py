@@ -288,6 +288,193 @@ def bench_spec(cfg, prompt_len: int, gen_len: int, max_num_seqs: int = 8, k: int
     return rec
 
 
+def _pct(xs, q: float):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))] * 1e3, 2)
+
+
+def _dist(ttfts, itls) -> dict:
+    return {
+        "ttft_ms_p50": _pct(ttfts, 0.50),
+        "ttft_ms_p99": _pct(ttfts, 0.99),
+        "itl_ms_p50": _pct(itls, 0.50),
+        "itl_ms_p99": _pct(itls, 0.99),
+        "tokens": len(itls) + len(ttfts),
+    }
+
+
+def bench_disagg(cfg, prompt_len: int, gen_len: int, max_num_seqs: int = 4, n_long: int = 6) -> dict:
+    """Disaggregated prefill/decode A/B on a MIXED workload: latency-
+    sensitive decode streams with long-prompt prefills arriving mid-
+    flight. Records time-to-first-token and inter-token latency as
+    SEPARATE distributions (p50/p99) for both modes:
+
+    - single engine: one engine interleaves everything — a long prefill
+      admission stalls every in-flight decode lane for a whole prefill
+      forward (the committed bench's ~44 ms vs ~7 ms gap);
+    - disagg split: a prefill engine on its own thread feeds a decode
+      engine through the full handoff path (extract -> codec round-trip
+      -> fused scatter-in), so decode admissions cost one scatter
+      instead of a prefill forward.
+
+    ITL is measured over the decode-heavy streams only (the lanes the
+    split protects); TTFT over every request. The same arrival cadence
+    (in decode steps) drives both modes."""
+    import queue as _queue
+    import threading as _threading
+
+    import numpy as np
+
+    from ray_tpu.llm.disagg import decode_handoff, encode_handoff
+    from ray_tpu.llm.engine import LLMEngine
+    from ray_tpu.llm.sampling import SamplingParams
+
+    short_len = max(16, prompt_len // 8)
+    # the stall source must actually be LONG: near the model's context
+    # limit, several prefill buckets above the decode streams' prompts
+    long_len = min(cfg.max_seq_len - 16, max(4 * prompt_len, 256))
+    short_sp = SamplingParams(temperature=0.0, max_tokens=gen_len)
+    long_sp = SamplingParams(temperature=0.0, max_tokens=4)
+    rng = np.random.default_rng(0)
+    shorts = [list(int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=short_len)) for _ in range(max_num_seqs - 1)]
+    longs = [list(int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=long_len)) for _ in range(n_long)]
+    inject_every = max(4, gen_len // (n_long + 1))  # decode steps between long arrivals
+
+    def _engine():
+        return LLMEngine(cfg, max_num_seqs=max_num_seqs, max_seq_len=cfg.max_seq_len, enable_prefix_caching=False)
+
+    def _warm(eng):
+        # compile BOTH buckets + the fused decode outside the timed region
+        eng.generate(shorts[0], SamplingParams(temperature=0.0, max_tokens=3))
+        eng.generate(longs[0], SamplingParams(temperature=0.0, max_tokens=3))
+
+    def _record(outs, now, submit, last_tok, short_ids, ttfts, itls):
+        for o in outs:
+            rid = o.request_id
+            if rid not in submit or not o.new_token_ids:
+                continue
+            if rid not in last_tok:
+                ttfts.append(now - submit[rid])
+            elif rid in short_ids:
+                itls.append(now - last_tok[rid])
+            last_tok[rid] = now
+
+    def run_single():
+        eng = _engine()
+        _warm(eng)
+        ttfts, itls, submit, last_tok, short_ids = [], [], {}, {}, set()
+        for p in shorts:
+            rid = eng.add_request(p, short_sp)
+            submit[rid] = time.perf_counter()
+            short_ids.add(rid)
+        li = steps = 0
+        while eng.has_unfinished() or li < len(longs):
+            if li < len(longs) and steps >= (li + 1) * inject_every:
+                rid = eng.add_request(longs[li], long_sp)
+                submit[rid] = time.perf_counter()
+                li += 1
+            outs = eng.step()
+            _record(outs, time.perf_counter(), submit, last_tok, short_ids, ttfts, itls)
+            steps += 1
+        return ttfts, itls
+
+    def run_disagg():
+        pre, dec = _engine(), _engine()
+        _warm(pre)
+        _warm(dec)
+        # warm the handoff path itself (extract + codec + scatter-in
+        # programs for both buckets)
+        for p in (shorts[0], longs[0]):
+            dec.add_prefilled(decode_handoff(encode_handoff(pre.prefill_handoff(p))), SamplingParams(temperature=0.0, max_tokens=2))
+        while dec.has_unfinished():
+            dec.step()
+        in_q: _queue.Queue = _queue.Queue()
+        ready: _queue.Queue = _queue.Queue()
+
+        def prefill_loop():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is None:
+                        return
+                    kind, prompt = item
+                    kv = decode_handoff(encode_handoff(pre.prefill_handoff(prompt)))
+                    ready.put((kind, kv))
+            except BaseException as e:  # noqa: BLE001
+                # surface through the ready queue: the decode loop must
+                # fail loudly, never spin forever waiting for handoffs
+                ready.put(("error", e))
+
+        th = _threading.Thread(target=prefill_loop, daemon=True, name="bench-prefill")
+        th.start()
+        from collections import deque as _deque
+
+        ttfts, itls, submit, last_tok, short_ids = [], [], {}, {}, set()
+        # the prefill thread preserves arrival order per kind: FIFO submit
+        # times pair back up at decode admission
+        pending_t = {"short": _deque(), "long": _deque()}
+        for p in shorts:
+            pending_t["short"].append(time.perf_counter())
+            in_q.put(("short", p))
+        li = steps = done = 0
+        n_total = len(shorts) + len(longs)
+        while done < n_total or li < len(longs):
+            # cadence in decode steps; an idle decode engine (shorts done
+            # early) flushes the remaining arrivals immediately
+            if li < len(longs) and (steps >= (li + 1) * inject_every or not dec.has_unfinished()):
+                pending_t["long"].append(time.perf_counter())
+                in_q.put(("long", longs[li]))
+                li += 1
+            try:
+                kind, kv = ready.get_nowait()
+                if kind == "error":
+                    raise RuntimeError("disagg bench prefill thread died") from kv
+                rid = dec.add_prefilled(kv, short_sp if kind == "short" else long_sp)
+                submit[rid] = pending_t[kind].popleft()
+                if kind == "short":
+                    short_ids.add(rid)
+            except _queue.Empty:
+                pass
+            if not dec.has_unfinished():
+                time.sleep(0.0005)  # idle: let the prefill thread run
+                continue
+            outs = dec.step()
+            now = time.perf_counter()
+            _record(outs, now, submit, last_tok, short_ids, ttfts, itls)
+            done += sum(1 for o in outs if o.finished and o.request_id in submit)
+            steps += 1
+        in_q.put(None)
+        th.join(timeout=10)
+        return ttfts, itls
+
+    s_ttft, s_itl = run_single()
+    d_ttft, d_itl = run_disagg()
+    single, split = _dist(s_ttft, s_itl), _dist(d_ttft, d_itl)
+    ratio = (single["itl_ms_p99"] / split["itl_ms_p99"]) if split["itl_ms_p99"] else None
+    rec = {
+        "metric": "engine_disagg_ab",
+        **_device_info(),
+        "disagg": True,  # provenance: this record came from the split-path A/B
+        "workload": (
+            f"{len(shorts)} decode streams (prompt {short_len}, gen {gen_len}) + "
+            f"{n_long} long-prefill arrivals (prompt {long_len}) every {inject_every} decode steps"
+        ),
+        "single_engine": single,
+        "disagg_split": split,
+        "decode_itl_p99_speedup": round(ratio, 2) if ratio else None,
+        "batch": max_num_seqs,
+    }
+    print(
+        f"  single ITL p50/p99 {single['itl_ms_p50']}/{single['itl_ms_p99']} ms, "
+        f"disagg ITL p50/p99 {split['itl_ms_p50']}/{split['itl_ms_p99']} ms "
+        f"({rec['decode_itl_p99_speedup']}x p99), TTFT p50 {single['ttft_ms_p50']} -> {split['ttft_ms_p50']} ms",
+        flush=True,
+    )
+    return rec
+
+
 def bench_full_stack(cfg, prompt_len: int, gen_len: int, concurrency: int, tiny: bool) -> dict:
     """proxy -> router -> replica -> engine with N concurrent callers."""
     import numpy as np
@@ -403,6 +590,7 @@ def main(argv=None):
         ]
     if args.speculative:
         benches.append(("engine_spec_ngram", lambda: bench_spec(cfg, prompt_len, gen_len, k=args.spec_k, repeats=args.repeats)))
+    benches.append(("engine_disagg_ab", lambda: bench_disagg(cfg, prompt_len, gen_len)))
     benches.append(("full_stack", lambda: bench_full_stack(cfg, prompt_len, gen_len, args.concurrency, args.tiny or args.small)))
     for name, fn in benches:
         if args.only and args.only not in name:

@@ -545,10 +545,13 @@ class PeerConn:
         self.send({"op": "reg_func", "func_id": func_id, "blob": blob})
         self._sent_funcs.add(func_id)
 
-    def request(self, op: str, timeout: float | None = None, **fields) -> dict:
+    def request(self, op: str, timeout: float | None = None, _fields: dict | None = None, **fields) -> dict:
         """Blocking request/response (GET etc.). ``timeout`` bounds the
-        local wait; ``fields`` ride the frame (including any wire-side
-        timeout the server should honor)."""
+        local wait; ``fields`` ride the frame. A frame field whose name
+        collides with a parameter here (the server-side "timeout" a
+        bounded GET carries) goes through ``_fields`` instead."""
+        if _fields:
+            fields.update(_fields)
         cid = self._next_cid()
         slot = [threading.Event(), None]
         with self._lock:
@@ -572,7 +575,7 @@ class PeerConn:
             "get",
             timeout=None if timeout is None else timeout + 5.0,
             id=k,
-            **({} if timeout is None else {"timeout": timeout}),
+            _fields=None if timeout is None else {"timeout": timeout},
         )
 
     def _read_loop(self):
@@ -1539,12 +1542,44 @@ def try_put(value):
     return ObjectRef(oid, owner_hint=st.self_owner), None
 
 
+def put_owned(value) -> "ObjectRef":
+    """Owner-local put with NO size cap: the large-buffer publish path.
+
+    Regular ``put()`` keeps anything above the inline threshold
+    head-owned (try_put rejects shm payloads) so bulk data survives its
+    producer. This is the deliberate opposite for transient multi-MB
+    state whose lifetime IS its producer's — the disaggregated KV handoff
+    (llm/disagg/handoff.py): the bytes land in a shared-memory segment,
+    the descriptor-bearing payload stays in THIS process's OwnedStore,
+    and borrowers on the same host attach the segment without the bytes
+    ever crossing a socket. Freed on last borrow-release (leak backstop:
+    RT_OWNED_OBJECT_LEAK_BACKSTOP_S for borrowers that died before
+    registering). The object dies with its owner — callers must treat
+    ObjectLostError as \"re-produce or fail\", which is exactly the
+    disagg router's retry contract."""
+    st = _state
+    if st is None or st.server is None:
+        raise RuntimeError("put_owned needs the direct plane (call ray_tpu.init first)")
+    from ray_tpu.core.payloads import encode_serialized
+    from ray_tpu.core.serialization import serialize
+
+    s = serialize(value)
+    payload = encode_serialized(s)
+    oid = ObjectID.from_put()
+    st.owned.put_ready(oid.binary(), payload, contained=list(s.contained_refs))
+    from ray_tpu.core.object_ref import ObjectRef
+
+    return ObjectRef(oid, owner_hint=st.self_owner)
+
+
 # ---------------------------------------------------------------------------
 # get/wait/free interception
 # ---------------------------------------------------------------------------
-def maybe_get_owned(obj_id: ObjectID, timeout: float | None = None):
+def maybe_get_owned(obj_id: ObjectID, timeout: float | None = None, zero_copy: bool = False):
     """(handled, value) for owned / remote-owned objects; handled=False
-    falls through to the caller's head path."""
+    falls through to the caller's head path. ``zero_copy`` decodes
+    shm-backed payloads as read-only views into the mapped segment (see
+    get_owned_view)."""
     st = _state
     k = obj_id.binary()
     if st is not None:
@@ -1562,7 +1597,7 @@ def maybe_get_owned(obj_id: ObjectID, timeout: float | None = None):
             if e.state == VALUE:
                 return True, e.value
             if e.state == READY:
-                return True, _decode(e.payload)
+                return True, _decode(e.payload, zero_copy=zero_copy)
             return False, None  # REDIRECT: head owns it now
     owner = get_hint(k)
     if owner is not None and st is not None:
@@ -1582,17 +1617,37 @@ def maybe_get_owned(obj_id: ObjectID, timeout: float | None = None):
             return False, None  # promoted to head meanwhile
         if resp.get("error") is not None:
             raise resp["error"]
-        return True, _decode(resp["payload"])
+        return True, _decode(resp["payload"], zero_copy=zero_copy)
     return False, None
 
 
-def _decode(payload: Payload):
+def _decode(payload: Payload, zero_copy: bool = False):
     from ray_tpu.core.payloads import decode_payload
 
-    v, _seg = decode_payload(payload, zero_copy=False)
+    v, _seg = decode_payload(payload, zero_copy=zero_copy)
     if isinstance(v, BaseException):
         raise v
     return v
+
+
+def get_owned_view(obj_id: ObjectID, timeout: float | None = None):
+    """Zero-copy get of an owned/borrowed object: shm-backed payloads
+    decode as READ-ONLY views into the GC-managed segment mapping — the
+    borrow path never copies the bytes (the frame carries only the shm
+    descriptor; same-host borrowers attach the producer's segment). The
+    mapping outlives a later owner-side unlink (POSIX shm semantics), so
+    a view held past the borrow-release stays valid.
+
+    The large-buffer read half of put_owned (disagg KV handoff fetch).
+    Raises ObjectLostError for ids whose owner is gone, GetTimeoutError
+    on a bounded wait; falls back to the ordinary (copying) get for ids
+    this plane does not own or hint."""
+    handled, value = maybe_get_owned(obj_id, timeout=timeout, zero_copy=True)
+    if handled:
+        return value
+    from ray_tpu.core import context as _context
+
+    return _context.get_client().get_object(obj_id, timeout=timeout)
 
 
 def is_owned_or_hinted(k: bytes) -> bool:

@@ -603,9 +603,15 @@ class WorkerClient:
             else:
                 fn = self._direct_fn(msg["func_id"], conn_funcs)
             if "argv" in msg:
-                # fast path: args arrived as plain values with the frame
-                args = msg["argv"]
-                kwargs = msg.get("kwargv") or {}
+                # fast path: args arrived as plain values with the frame.
+                # POP them out of msg: the server conn loop keeps msg
+                # alive until the NEXT frame arrives, and a materialized
+                # ObjectRef arg retained there would hold its borrow open
+                # indefinitely on an idle connection — the owner could
+                # never free (the handoff-block leak the disagg tests
+                # guard against)
+                args = msg.pop("argv")
+                kwargs = msg.pop("kwargv", None) or {}
             else:
                 args, kwargs, segs = self._decode_args(msg["args"], msg.get("kwargs"))
             try:

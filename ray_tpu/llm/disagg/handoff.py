@@ -1,0 +1,143 @@
+"""KV handoff codec: the wire format between prefill and decode replicas.
+
+A handoff is ONE request's prefilled state: the contiguous KV block the
+prefill engine extracted (llm/disagg/scatter.py), the first-token logits,
+and enough layout metadata for the decode side to validate and scatter it
+into its own cache — shapes, dtype, real length, producer bucket width.
+
+The payload rides the runtime's own object plane: ``publish`` stores it
+as an OWNED object in the prefill replica's process (core/direct.py
+put_owned — multi-MB arrays land in shared memory, the descriptor rides
+the direct-transport frame, and same-host borrowers attach the segment
+without copying the bytes over a socket). The prefill replica stays the
+owner for the block's whole life: the router and the decode replica are
+borrowers, and the owner frees the segment after the last borrow-release
+(dead borrowers are covered by the RT_OWNED_OBJECT_LEAK_BACKSTOP_S
+backstop — a crashed decode replica can never leak the block forever).
+
+``fetch`` is the decode side: a bounded-retry borrow-get that decodes
+zero-copy (read-only views into the mapped segment) and validates the
+block against its metadata. A handoff that was evicted/freed before
+scatter-in surfaces as ``HandoffLostError`` after the retry budget — the
+router's signal to re-prefill or fail the request, never to hang.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+HANDOFF_VERSION = 1
+
+
+class HandoffError(ValueError):
+    """Malformed or inconsistent handoff payload (codec-level)."""
+
+
+class HandoffLostError(RuntimeError):
+    """The handoff object vanished (owner died / evicted / freed) before
+    the decode side could scatter it in. Bounded-retry callers raise this
+    after exhausting their budget; the router reacts by re-prefilling."""
+
+
+def encode(kv: dict) -> dict:
+    """Engine handoff payload -> self-describing wire dict.
+
+    ``kv`` is the engine's prefill-extract product: k/v [L, T_pad, kv_h,
+    hd] numpy, logits [vocab] f32, n real tokens, prompt_token_ids."""
+    k, v = np.asarray(kv["k"]), np.asarray(kv["v"])
+    logits = np.asarray(kv["logits"], np.float32)
+    n = int(kv["n"])
+    if k.ndim != 4 or k.shape != v.shape:
+        raise HandoffError(f"KV block must be [L, T_pad, kv, hd] twins, got k{k.shape} v{v.shape}")
+    if not 0 < n <= k.shape[1]:
+        raise HandoffError(f"real length {n} outside block width {k.shape[1]}")
+    return {
+        "version": HANDOFF_VERSION,
+        "kind": "kv_handoff",
+        "n": n,
+        "t_pad": int(k.shape[1]),
+        "shape": tuple(int(d) for d in k.shape),
+        "dtype": str(k.dtype),
+        "prompt_token_ids": [int(t) for t in kv["prompt_token_ids"]],
+        "k": k,
+        "v": v,
+        "logits": logits,
+    }
+
+
+def decode(payload: dict) -> dict:
+    """Wire dict -> validated engine admission payload (add_prefilled
+    format). Raises HandoffError on anything inconsistent — a truncated
+    or foreign object must never scatter garbage into a live pool."""
+    if not isinstance(payload, dict) or payload.get("kind") != "kv_handoff":
+        raise HandoffError(f"not a kv_handoff payload: {type(payload).__name__}")
+    if payload.get("version") != HANDOFF_VERSION:
+        raise HandoffError(f"handoff version {payload.get('version')} != {HANDOFF_VERSION}")
+    k, v = payload["k"], payload["v"]
+    shape = tuple(payload["shape"])
+    if tuple(k.shape) != shape or tuple(v.shape) != shape:
+        raise HandoffError(f"block shape mismatch: meta {shape}, k {tuple(k.shape)}, v {tuple(v.shape)}")
+    if str(k.dtype) != payload["dtype"]:
+        raise HandoffError(f"block dtype mismatch: meta {payload['dtype']}, got {k.dtype}")
+    n = int(payload["n"])
+    prompt = payload["prompt_token_ids"]
+    if not 0 < n <= shape[1] or n != len(prompt):
+        raise HandoffError(f"length {n} inconsistent with block width {shape[1]} / prompt {len(prompt)}")
+    return {"k": k, "v": v, "n": n, "logits": payload["logits"], "prompt_token_ids": list(prompt)}
+
+
+def meta_of(payload: dict) -> dict:
+    """Small router-facing summary (no arrays): what travels with the ref."""
+    return {
+        "n": payload["n"],
+        "t_pad": payload["t_pad"],
+        "shape": tuple(payload["shape"]),
+        "dtype": payload["dtype"],
+        "nbytes": int(payload["k"].nbytes + payload["v"].nbytes + payload["logits"].nbytes),
+    }
+
+
+def publish(kv: dict):
+    """Encode and store a handoff as an owned object in THIS process.
+
+    Returns (meta, ref): the multi-MB payload stays owner-local (shm for
+    anything over the inline threshold) and only the tiny (meta, ref)
+    pair travels back to the router."""
+    from ray_tpu.core import direct as _direct
+
+    payload = encode(kv)
+    ref = _direct.put_owned(payload)
+    return meta_of(payload), ref
+
+
+def fetch(ref, meta: dict | None = None, *, timeout_s: float = 30.0, retries: int = 2, retry_wait_s: float = 0.2) -> dict:
+    """Borrow-get a published handoff with a bounded retry budget.
+
+    The get decodes zero-copy (arrays are read-only views into the mapped
+    segment — no byte copy on the borrow path; the device upload at
+    scatter-in is the only copy the decode side pays). ``retries`` extra
+    attempts absorb transient owner-side races; a handoff that is GONE
+    (owner freed/evicted it, owner process died) raises HandoffLostError
+    immediately on the loss signal after the final attempt — callers must
+    never hang on a dead handoff."""
+    from ray_tpu.core import direct as _direct
+    from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
+
+    last: BaseException | None = None
+    for attempt in range(retries + 1):
+        try:
+            value = _direct.get_owned_view(ref.id, timeout=timeout_s)
+            payload = decode(value)
+            if meta is not None and tuple(meta.get("shape", payload["k"].shape)) != tuple(payload["k"].shape):
+                raise HandoffError(f"fetched block {payload['k'].shape} does not match routed meta {meta['shape']}")
+            return payload
+        except (ObjectLostError, GetTimeoutError, ConnectionError, FileNotFoundError) as e:
+            last = e
+            if attempt < retries:
+                time.sleep(retry_wait_s)
+    raise HandoffLostError(
+        f"handoff object {ref.id.hex()[:16]} lost before scatter-in "
+        f"({retries + 1} attempts): {last}"
+    ) from last

@@ -1,0 +1,132 @@
+"""Disaggregated serving router: admit to prefill, bind to a decode lane.
+
+The router is the control plane of the prefill/decode split (the data
+plane is the handoff object, llm/disagg/handoff.py — the router never
+touches the KV bytes). Per request it:
+
+1. admits the prompt to the prefill pool and receives (meta, ref) — a
+   tiny summary plus a borrowed reference to the owned KV block;
+2. binds the handoff to a decode lane (a decode submit callable; under
+   Serve this is the decode deployment handle, whose pow-2 router picks
+   the replica) and waits for generation;
+3. tracks every in-flight handoff ref so the block stays alive from
+   publish to scatter-in, and releases it the moment the request settles
+   (the owner then frees on borrow-release).
+
+Failure policy — bounded, never hanging:
+
+- decode lane dies after the handoff (replica crash mid-request): the
+  request is retried on another lane, REUSING the same handoff if the
+  block is still alive, re-prefilling if it is not; after
+  ``max_attempts`` total attempts the error surfaces to the client. The
+  orphaned block is not leaked: the router drops its borrow and the
+  owner's backstop covers the dead replica's unregistered one.
+- handoff evicted/freed before scatter-in: the decode side's bounded
+  fetch raises HandoffLostError; the router re-prefills (a fresh block)
+  up to the same attempt budget, then fails the request client-visibly.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ray_tpu.llm.disagg.handoff import HandoffLostError
+
+
+class DisaggRequestError(RuntimeError):
+    """Client-visible terminal failure after the router's retry budget."""
+
+
+def _handoff_lost(e: BaseException | None) -> bool:
+    """True when ``e`` is (or wraps) a HandoffLostError. Under Serve the
+    decode replica's exception crosses the wire inside TaskError: follow
+    the ``.cause`` chain, and fall back to the remote traceback string
+    for causes that didn't survive pickling."""
+    for _ in range(8):
+        if e is None:
+            return False
+        if isinstance(e, HandoffLostError):
+            return True
+        if "HandoffLostError" in getattr(e, "tb_str", ""):
+            return True
+        e = getattr(e, "cause", None)
+    return False
+
+
+class DisaggRouter:
+    """Serve-agnostic core. ``prefill(prompt_token_ids) -> (meta, ref)``
+    and ``decode(meta, ref, prompt_token_ids, sampling_params) -> dict``
+    are injected (under Serve: deployment-handle calls; in tests: engine
+    closures), so the policy is testable without a cluster."""
+
+    def __init__(self, prefill, decode, *, max_attempts: int = 3):
+        self._prefill = prefill
+        self._decode = decode
+        self.max_attempts = max(1, int(max_attempts))
+        self._lock = threading.Lock()
+        self._inflight: dict[str, object] = {}  # request key -> handoff ref
+        self.stats_counts = {
+            "requests": 0, "prefills": 0, "decode_retries": 0,
+            "handoffs_lost": 0, "failed": 0, "handoff_bytes": 0,
+        }
+        self._seq = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {**self.stats_counts, "inflight": len(self._inflight)}
+
+    def _bump(self, key: str, by: int = 1):
+        with self._lock:
+            self.stats_counts[key] += by
+
+    def generate(self, prompt_token_ids, sampling_params: dict | None = None) -> dict:
+        """One request end to end. Raises DisaggRequestError after the
+        attempt budget; any success path returns the decode result."""
+        with self._lock:
+            self.stats_counts["requests"] += 1
+            self._seq += 1
+            key = f"dreq-{self._seq}"
+        meta = ref = None
+        last: BaseException | None = None
+        try:
+            for attempt in range(self.max_attempts):
+                if ref is None:
+                    try:
+                        meta, ref = self._prefill(list(prompt_token_ids))
+                    except BaseException as e:  # noqa: BLE001
+                        last = e
+                        continue
+                    self._bump("prefills")
+                    self._bump("handoff_bytes", int(meta.get("nbytes", 0)))
+                    with self._lock:
+                        self._inflight[key] = ref
+                try:
+                    return self._decode(meta, ref, list(prompt_token_ids), sampling_params or {})
+                except BaseException as e:  # noqa: BLE001
+                    last = e
+                    if _handoff_lost(e):
+                        # block gone before scatter-in (possibly wrapped
+                        # in the task layer's TaskError): this ref is
+                        # dead weight — drop it and re-prefill
+                        self._bump("handoffs_lost")
+                        self._drop(key)
+                        meta = ref = None
+                    else:
+                        # decode lane failure (replica death, transport
+                        # cut): keep the handoff — the block lives in the
+                        # PREFILL replica, so a surviving owner lets the
+                        # retry skip the re-prefill entirely
+                        self._bump("decode_retries")
+            self._bump("failed")
+            raise DisaggRequestError(
+                f"request failed after {self.max_attempts} attempts "
+                f"(last: {type(last).__name__}: {last})"
+            ) from last
+        finally:
+            self._drop(key)
+
+    def _drop(self, key: str):
+        """Release the router's borrow of the request's handoff (the owner
+        frees the block once the decode side's borrow releases too)."""
+        with self._lock:
+            self._inflight.pop(key, None)

@@ -1,0 +1,169 @@
+"""Fused KV handoff programs: extract on the prefill side, scatter-in on
+the decode side.
+
+Four jitted entry points move a request's KV block between an engine's
+cache and the contiguous handoff buffer that rides the object plane
+(llm/disagg/handoff.py), one pair per KV layout:
+
+- extract: read the block OUT of the prefill engine's cache/pool into a
+  contiguous [L, T_pad, kv, hd] device buffer (slots: dynamic row slice;
+  paged: page gather). Read-only over the cache — never fused with a
+  scatter (the documented pool aliasing hazard, see
+  paged_kv._paged_attn_batch).
+- scatter-in: write a received block INTO the decode engine's cache/pool
+  AND update the device-resident scheduler lanes in the same program —
+  for the paged layout this fuses what was previously three dispatches
+  (insert_pages + table push + length push) into ONE, so a handoff
+  admission costs a single program launch on the decode hot path.
+
+T_pad is the producer's prefill bucket (static: one compiled program per
+bucket, mirroring prefill's own bucketing). Positions n..T_pad are
+garbage the consumer masks by length and overwrites with appends — the
+same contract as prefill's padded tail.
+
+All four are registered as jaxcheck entries (the decode-side scatter is
+on the admission hot path of every disaggregated request).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.lint import jaxcheck
+from ray_tpu.llm.model_runner import _sds, _sds_cache, _sds_pool, _trace_cfg
+
+
+# ---------------------------------------------------------------------------
+# jaxcheck shape buckets (ShapeDtypeStructs only — nothing allocates)
+# ---------------------------------------------------------------------------
+def _bucket_extract_slots(B=8, S=256, T=128):
+    cfg = _trace_cfg()
+    return (_sds_cache(cfg, B, S), _sds((), jnp.int32)), {"T": T}
+
+
+def _bucket_extract_paged(pages=64, page=16, npg=8):
+    cfg = _trace_cfg()
+    return (_sds_pool(cfg, pages, page), _sds((npg,), jnp.int32)), {}
+
+
+def _bucket_scatter_slots(B=8, S=256, T=128):
+    cfg = _trace_cfg()
+    dt = jnp.dtype(cfg.dtype)
+    blk = _sds((cfg.num_layers, T, cfg.num_kv_heads, cfg.hd), dt)
+    return (_sds_cache(cfg, B, S), _sds((), jnp.int32), blk, blk, _sds((), jnp.int32)), {}
+
+
+def _bucket_scatter_paged(B=8, pages=64, page=16, npg=8):
+    cfg = _trace_cfg()
+    dt = jnp.dtype(cfg.dtype)
+    max_pg = pages // B * 2
+    blk = _sds((cfg.num_layers, npg * page, cfg.num_kv_heads, cfg.hd), dt)
+    return (
+        _sds_pool(cfg, pages, page), _sds((B, max_pg), jnp.int32), _sds((B,), jnp.int32),
+        _sds((), jnp.int32), _sds((max_pg,), jnp.int32), blk, blk, _sds((), jnp.int32),
+    ), {}
+
+
+# ---------------------------------------------------------------------------
+# extract (prefill side)
+# ---------------------------------------------------------------------------
+@jaxcheck.entry(
+    name="llm.disagg_extract_slots",
+    shapes={"b8_t128": _bucket_extract_slots},
+    donate_bytes=0,  # read-only over the cache: nothing to donate
+)
+def kv_extract_slots(cache, slot, T: int):
+    """Extract one slot's first T positions as a contiguous block.
+
+    Returns (k [L, T, kv, hd], v same); T static (per prefill bucket),
+    slot traced. Garbage past the real length is masked downstream."""
+    from ray_tpu.llm.kv_cache import extract_sequence
+
+    return extract_sequence(cache, slot, T)
+
+
+@jaxcheck.entry(
+    name="llm.disagg_extract_paged",
+    shapes={"p64_npg8": _bucket_extract_paged},
+    donate_bytes=0,  # read-only over the pool: nothing to donate
+)
+def kv_extract_paged(pool, page_ids):
+    """Gather a sequence's pages into a contiguous block.
+
+    page_ids [n_pg] int32 (static length = T_pad / page_size; padding
+    cells point at the trash page). Returns (k [L, n_pg*page, kv, hd],
+    v same)."""
+    from ray_tpu.llm.paged_kv import gather_pages
+
+    return gather_pages(pool, page_ids)
+
+
+# ---------------------------------------------------------------------------
+# scatter-in (decode side)
+# ---------------------------------------------------------------------------
+@jaxcheck.entry(
+    name="llm.disagg_scatter_slots",
+    shapes={"b8_t128": _bucket_scatter_slots},
+    donate=("cache",),
+    donate_bytes=0,  # admission hot path: every buffer it touches counts
+)
+def kv_scatter_in_slots(cache, slot, k_blk, v_blk, n):
+    """Write a handoff block into `slot` at offset 0 and set its length —
+    the slot-layout scatter-in, one program per bucket width.
+
+    k_blk/v_blk: [L, T_pad, kv, hd] (padded tail is garbage, masked by
+    n); slot/n: traced scalars."""
+    zero = jnp.zeros((), dtype=jnp.int32)
+    start = (zero, jnp.asarray(slot, jnp.int32), zero, zero, zero)
+    k = jax.lax.dynamic_update_slice(cache["k"], k_blk[:, None].astype(cache["k"].dtype), start)
+    v = jax.lax.dynamic_update_slice(cache["v"], v_blk[:, None].astype(cache["v"].dtype), start)
+    lens = cache["length"].at[slot].set(jnp.asarray(n, jnp.int32))
+    return {"k": k, "v": v, "length": lens}
+
+
+@jaxcheck.entry(
+    name="llm.disagg_scatter_paged",
+    shapes={"b8_p64": _bucket_scatter_paged},
+    donate=("pool", "tables", "lengths"),
+    donate_bytes=0,
+)
+def kv_scatter_in_paged(pool, tables, lengths, slot, table_row, k_blk, v_blk, n):
+    """Write a handoff block into its allocated pages AND refresh the
+    device-resident scheduler lanes in ONE program: pool pages get the
+    block (reshaped to whole pages), tables[slot] gets the row, and
+    lengths[slot] gets the real token count — replacing the three-launch
+    insert + table-push + length-push admission sequence.
+
+    table_row: [max_pg] int32 (allocated pages first, 0 = trash beyond);
+    k_blk/v_blk: [L, T_pad, kv, hd] with T_pad a page multiple. Scatter
+    only — the block is never read back in this program (aliasing
+    hazard)."""
+    L, T, kvh, hd = k_blk.shape
+    page = pool["k"].shape[2]
+    npg = T // page
+    page_ids = table_row[:npg]
+    kr = k_blk.reshape(L, npg, page, kvh, hd).astype(pool["k"].dtype)
+    vr = v_blk.reshape(L, npg, page, kvh, hd).astype(pool["v"].dtype)
+    new_pool = {
+        "k": pool["k"].at[:, page_ids].set(kr),
+        "v": pool["v"].at[:, page_ids].set(vr),
+    }
+    return (
+        new_pool,
+        tables.at[slot].set(table_row),
+        lengths.at[slot].set(jnp.asarray(n, jnp.int32)),
+    )
+
+
+def make_handoff_fns():
+    """Jitted (extract_slots, extract_paged, scatter_slots, scatter_paged)
+    closures for an engine. Extracts compile once per bucket width (T /
+    page_ids length is static); scatters donate the cache/pool and the
+    device lanes so admission aliases everything in place."""
+    return (
+        jax.jit(kv_extract_slots, static_argnums=(2,)),
+        jax.jit(kv_extract_paged),
+        jax.jit(kv_scatter_in_slots, donate_argnums=(0,)),
+        jax.jit(kv_scatter_in_paged, donate_argnums=(0, 1, 2)),
+    )

@@ -18,6 +18,13 @@ python/ray/llm/_internal/serve/engines/vllm/vllm_engine.py):
   completion (eos / max_tokens / stop ids), and slot recycling between
   device steps against numpy shadow state. The device never sees dynamic
   shapes, and nothing syncs the host per decode step;
+- every step() is three explicit STAGES — admission (plan: queue ->
+  slot/page reservation), prefill (execute: batched forwards +
+  transferred-KV / prefix-hit scatter-ins), decode (dispatch + drain).
+  The stage split is what disaggregated serving (llm/disagg/) rides: a
+  prefill replica runs only the first two stages (prefill-only requests
+  finish with their KV extracted into a handoff block), a decode replica
+  admits handoff blocks through a fused scatter-in and runs the third;
 - optional speculative decoding (speculative=SpecConfig(...), llm/spec/):
   a drafter proposes up to k tokens per lane and one fused verify step
   accepts/extends them — multiple tokens per tick, greedy output
@@ -56,6 +63,9 @@ class RequestState:
     out_queue: "queue.SimpleQueue | None" = None
     # KV computed by a remote prefill engine (disaggregation)
     prefilled: dict | None = None
+    # prefill-only: run admission+prefill stages, extract the KV block
+    # into a handoff (pop_handoff) and finish — never enters decode
+    prefill_only: bool = False
     # paged layout: admission order (preemption picks the youngest) and
     # preemption count (observability)
     admit_seq: int = -1
@@ -309,6 +319,15 @@ class LLMEngine:
                 dtype=cache_dtype or config.dtype,
             )
         )
+        # disaggregation plumbing: fused extract (prefill side) and
+        # scatter-in (decode side) programs for both layouts, plus the
+        # completed-handoff stash pop_handoff() serves (llm/disagg/)
+        from ray_tpu.llm.disagg.scatter import make_handoff_fns
+
+        (self._extract_slots, self._extract_paged,
+         self._scatter_slots, self._scatter_paged) = make_handoff_fns()
+        self._handoffs: dict[str, dict] = {}
+
         if mesh is None:
             self.params = params if params is not None else init_params(config, jax.random.PRNGKey(seed))
             if kv_layout == "paged":
@@ -546,6 +565,56 @@ class LLMEngine:
 
     # ------------------------------------------- prefill/decode disaggregation
 
+    def add_prefill_request(self, prompt_token_ids, request_id: str | None = None) -> str:
+        """PREFILL-ONLY admission (disaggregated serving, llm/disagg/).
+
+        The request rides the normal admission + prefill stages — batching
+        into the same bucketed forwards as everything else admitted that
+        step, prefix-cache reuse included — then finishes with reason
+        "handoff": its KV block is extracted into a contiguous buffer
+        (fused extract program) and stashed for ``pop_handoff``, and the
+        slot/pages recycle immediately. It never enters the decode stage."""
+        with self._lock:
+            if request_id is None:
+                request_id = f"req-{self._auto_id}"
+                self._auto_id += 1
+            n = len(prompt_token_ids)
+            if not 0 < n <= self.prefill_buckets[-1]:
+                raise ValueError(f"prompt length {n} outside prefill buckets (max {self.prefill_buckets[-1]})")
+            if self.kv_layout == "paged":
+                T = _bucket(n, self.prefill_buckets)
+                need = min(T // self._pcfg.page_size + 1, self._pcfg.max_pages_per_seq)
+                if need > self._pcfg.num_pages - 1:
+                    raise ValueError(
+                        f"prompt needs {need} pages but the pool has "
+                        f"{self._pcfg.num_pages - 1}; raise num_pages"
+                    )
+            st = RequestState(request_id, list(prompt_token_ids), SamplingParams(max_tokens=1), prefill_only=True)
+            self._requests[request_id] = st
+            self._waiting.append(st)
+            return request_id
+
+    def pop_handoff(self, request_id: str) -> dict | None:
+        """Claim a finished prefill-only request's handoff payload
+        (None until the prefill stage has run it). Payload format is
+        ``add_prefilled``'s input: k/v [L, T_pad, kv, hd] host arrays,
+        n, first-token logits, prompt_token_ids."""
+        with self._lock:
+            return self._handoffs.pop(request_id, None)
+
+    def prefill_handoff(self, prompt_token_ids) -> dict:
+        """Blocking convenience (single-threaded drivers: tests, bench):
+        admit a prefill-only request and step until its handoff is ready."""
+        rid = self.add_prefill_request(prompt_token_ids)
+        while True:
+            outs = self.step()
+            kv = self.pop_handoff(rid)
+            if kv is not None:
+                return kv
+            for o in outs:
+                if o.request_id == rid and o.finished:
+                    raise RuntimeError(f"prefill-only request failed: {o.finish_reason}")
+
     def prefill_remote(self, prompt_token_ids) -> dict:
         """Prefill-only: compute the prompt's KV and first-token logits and
         return them as HOST arrays for a decode engine to admit
@@ -620,6 +689,10 @@ class LLMEngine:
     def _finish(self, st: RequestState, reason: str):
         st.finished = True
         st.finish_reason = reason
+        if st.prefill_only and reason != "handoff":
+            # aborted/errored prefill-only request: drop any stashed block
+            # (nobody will ever pop it)
+            self._handoffs.pop(st.request_id, None)
         if self._spec_cfg is not None:
             self._controller.forget(st.request_id)
         if st.slot >= 0:
@@ -764,13 +837,14 @@ class LLMEngine:
             return None
         return need
 
-    def _admission_wave(self) -> list:
-        """Admit every waiting request that fits right now (FIFO; a
-        head-of-line request that cannot get pages blocks the wave —
-        vLLM semantics: waiting requests wait for free blocks, ADMISSION
-        never preempts running sequences). Plain prefills sharing a
-        bucket run as ONE batched forward instead of B=1 dispatches."""
-        admitted: list[RequestState] = []
+    def _stage_admission(self) -> list:
+        """ADMISSION stage (planning only, no forwards): admit every
+        waiting request that fits right now (FIFO; a head-of-line request
+        that cannot get pages blocks the wave — vLLM semantics: waiting
+        requests wait for free blocks, ADMISSION never preempts running
+        sequences). Reserves slots/pages and resolves prefix-cache hits;
+        returns the wave of (st, slot, pref, pages, prompt) plans the
+        prefill stage executes."""
         wave: list[tuple] = []  # (st, slot, pref, pages, prompt)
         while self._waiting and None in self._slots:
             st = self._waiting[0]
@@ -805,6 +879,16 @@ class LLMEngine:
             self._waiting.popleft()
             self._slots[slot] = st  # reserve; _bind_slot fills the rest
             wave.append((st, slot, pref, pages, prompt))
+        return wave
+
+    def _stage_prefill(self, wave: list) -> list:
+        """PREFILL stage (execution): run the admission wave's forwards.
+        Plain prefills sharing a bucket run as ONE batched forward instead
+        of B=1 dispatches; transferred-KV and prefix-hit requests scatter
+        in without re-attending cached tokens; prefill-only requests
+        complete into handoff blocks inside _bind_slot. Returns the
+        admitted RequestStates."""
+        admitted: list[RequestState] = []
         if not wave:
             return admitted
         plains: list[tuple] = []
@@ -887,6 +971,17 @@ class LLMEngine:
             v_pad = np.zeros_like(k_pad)
             k_pad[:, : kn.shape[1]] = kn
             v_pad[:, : vn.shape[1]] = vn
+            if self._device_resident:
+                # ONE fused scatter-in (llm/disagg/scatter.py): pool pages
+                # + device table row + device length lane in a single
+                # program — the handoff admission hot path
+                self.pool, self._dtables, self._dlengths = self._scatter_paged(
+                    self.pool, self._dtables, self._dlengths, np.int32(slot),
+                    table_row, jnp.asarray(k_pad), jnp.asarray(v_pad), np.int32(n_real),
+                )
+                self._lengths[slot] = n_real
+                self._bind_slot(st, slot, jnp.asarray(kv["logits"])[None])
+                return
             self.pool = self._insert(self.pool, table_row[: T_pad // page], jnp.asarray(k_pad), jnp.asarray(v_pad))
             logits = jnp.asarray(kv["logits"])[None]
             self._lengths[slot] = n_real
@@ -918,12 +1013,19 @@ class LLMEngine:
 
         n = len(prompt)
         if st.prefilled is not None:
-            # disaggregated admission: KV arrived from a prefill engine
+            # disaggregated admission: KV arrived from a prefill engine.
+            # Device-resident mode scatters through the audited disagg
+            # program; the sync oracle keeps the legacy insert.
             kv = st.prefilled
             st.prefilled = None
-            self.cache = self._insert(
-                self.cache, slot, jnp.asarray(kv["k"]), jnp.asarray(kv["v"]), int(kv["n"])
-            )
+            if self._device_resident:
+                self.cache = self._scatter_slots(
+                    self.cache, np.int32(slot), jnp.asarray(kv["k"]), jnp.asarray(kv["v"]), np.int32(int(kv["n"]))
+                )
+            else:
+                self.cache = self._insert(
+                    self.cache, slot, jnp.asarray(kv["k"]), jnp.asarray(kv["v"]), int(kv["n"])
+                )
             logits = jnp.asarray(kv["logits"])[None]
         else:
             # reuse the cached prefix KV; re-attend only the suffix
@@ -947,6 +1049,11 @@ class LLMEngine:
         st.slot = slot
         st.admit_seq = self._admit_counter = getattr(self, "_admit_counter", 0) + 1
         self._slots[slot] = st
+        if st.prefill_only:
+            # prefill replica path: the block leaves, the slot recycles,
+            # decode never sees this request
+            self._complete_handoff(st, slot, logits)
+            return
         p = st.params
         self._temps[slot] = p.temperature
         self._top_k[slot] = p.top_k
@@ -989,6 +1096,34 @@ class LLMEngine:
         self._emit(st, token, float(logp[0]))
         if spec_hist is not None:
             self._spec_admit(st, slot, spec_hist)
+
+    def _complete_handoff(self, st: RequestState, slot: int, logits):
+        """Finish a prefill-only request: extract its KV block into a
+        contiguous buffer with the fused extract program for this layout
+        (llm/disagg/scatter.py — slots: dynamic row slice; paged: page
+        gather), stash the handoff payload, free the slot/pages. The
+        block ships at the prompt's prefill-bucket width; the tail past
+        the real length is garbage the decode side masks by length (the
+        same contract as prefill's own padding)."""
+        import jax.numpy as jnp
+
+        prompt = st.prompt_token_ids
+        n = len(prompt)
+        T = _bucket(n, self.prefill_buckets)
+        if self.kv_layout == "paged":
+            page = self._pcfg.page_size
+            row = np.asarray(self._tables[slot][: T // page], np.int32)
+            k_blk, v_blk = self._extract_paged(self.pool, jnp.asarray(row))
+        else:
+            k_blk, v_blk = self._extract_slots(self.cache, np.int32(slot), T)
+        self._handoffs[st.request_id] = {
+            "k": np.asarray(k_blk),
+            "v": np.asarray(v_blk),
+            "n": n,
+            "logits": np.asarray(logits[0], np.float32),
+            "prompt_token_ids": list(prompt),
+        }
+        self._finish(st, "handoff")
 
     def _spec_admit(self, st: RequestState, slot: int, hist_tokens: list):
         """Spec lane state for a freshly admitted sequence: the token
@@ -1042,22 +1177,30 @@ class LLMEngine:
         round ever runs past a request's finish detection.
         """
         with self._lock:
-            admitted = self._admission_wave()
+            wave = self._stage_admission()
+            admitted = self._stage_prefill(wave)
             if self.kv_layout == "paged":
                 self._paged_grow()
-            if self._device_resident:
-                prev = self._pending
-                self._pending = None
-                if self._spec_cfg is not None:
-                    self._dispatch_spec(prev)
-                    emitted = self._drain_spec(prev)
-                else:
-                    self._dispatch_fused()
-                    emitted = self._drain(prev)
-                reported = admitted + emitted
-            else:
-                reported = self._sync_decode()
+            reported = self._stage_decode(admitted)
             return self._build_outputs(reported)
+
+    def _stage_decode(self, admitted: list) -> list:
+        """DECODE stage: advance every occupied slot one tick. Device-
+        resident mode dispatches the fused (or speculative) step and
+        drains the PREVIOUS one; sync mode is the blocking oracle loop.
+        Prefill-only requests never reach here — they finished (and freed
+        their slot) inside the prefill stage."""
+        if self._device_resident:
+            prev = self._pending
+            self._pending = None
+            if self._spec_cfg is not None:
+                self._dispatch_spec(prev)
+                emitted = self._drain_spec(prev)
+            else:
+                self._dispatch_fused()
+                emitted = self._drain(prev)
+            return admitted + emitted
+        return self._sync_decode()
 
     def _dispatch_fused(self):
         """Launch the fused device step for the current occupancy; never

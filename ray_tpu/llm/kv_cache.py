@@ -88,6 +88,23 @@ def append_token_layer(k_layer, v_layer, k_t, v_t, lengths):
     return k, v
 
 
+def extract_sequence(cache: dict, slot, T: int):
+    """Read one slot's first ``T`` cached positions as a contiguous block.
+
+    Inverse of insert_sequence: returns (k [L, T, kv, hd], v same) — the
+    disaggregated-prefill extract primitive (llm/disagg/). ``T`` is static
+    (one compiled program per prefill bucket, like insert); ``slot`` is a
+    traced scalar. Positions past the slot's real length are garbage the
+    consumer masks by length, exactly as prefill's padded tail."""
+    zero = jnp.zeros((), dtype=jnp.int32)
+    start = (zero, jnp.asarray(slot, jnp.int32), zero, zero, zero)
+    L, _, _, kv, hd = cache["k"].shape
+    size = (L, 1, T, kv, hd)
+    k = jax.lax.dynamic_slice(cache["k"], start, size)[:, 0]
+    v = jax.lax.dynamic_slice(cache["v"], start, size)[:, 0]
+    return k, v
+
+
 def free_slot(cache: dict, slot: int) -> dict:
     """Mark a slot empty (host-side bookkeeping mirrors this)."""
     return {**cache, "length": cache["length"].at[slot].set(0)}

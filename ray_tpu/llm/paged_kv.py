@@ -104,6 +104,23 @@ def insert_pages(pool: dict, page_ids, k_new, v_new) -> dict:
     }
 
 
+def gather_pages(pool: dict, page_ids):
+    """Read a sequence's pages back as one contiguous block.
+
+    Inverse of insert_pages: page_ids [n_pg] int32 (static length; padding
+    entries point at the trash page and yield garbage the consumer masks
+    by length). Returns (k [L, n_pg*page, kv, hd], v same) — the
+    disaggregated-prefill extract primitive for the paged layout
+    (llm/disagg/). Read-only over the pool: safe to run in the same
+    program as other gathers, never fused with a pool scatter (the
+    documented aliasing hazard)."""
+    L, _, page, kvh, hd = pool["k"].shape
+    npg = page_ids.shape[0]
+    k = pool["k"][:, page_ids].reshape(L, npg * page, kvh, hd)
+    v = pool["v"][:, page_ids].reshape(L, npg * page, kvh, hd)
+    return k, v
+
+
 def _combine(m1, l1, a1, m2, l2, a2):
     """Merge two online-softmax partials (flash-attention combine)."""
     m = jnp.maximum(m1, m2)

@@ -49,6 +49,12 @@ class LLMConfig:
     # speculative decoding (llm.spec.SpecConfig): forwarded to the engine
     # unless engine_kwargs already carries its own "speculative"
     speculative: object = None
+    # pre-warm at replica construction: compile the serving hot path
+    # (smallest prefill bucket + fused decode; prefill+extract on prefill
+    # replicas) BEFORE the replica reports healthy, so deployment
+    # spin-up — not the first request — pays the XLA compiles, in
+    # parallel across replicas (BENCH_scale.json: disagg_spinup)
+    prewarm: bool = True
 
 
 class LLMServer:
@@ -82,8 +88,22 @@ class LLMServer:
         self._stopped = False
         self._stepper_error: str | None = None
         self._work = threading.Event()
+        if llm_config.prewarm:
+            # BEFORE the stepping thread exists: engine.generate drives
+            # its own loop and would race a concurrent stepper
+            self._prewarm()
         self._stepper = threading.Thread(target=self._step_loop, daemon=True, name="llm-stepper")
         self._stepper.start()
+
+    def _prewarm(self):
+        """Compile the replica's hot programs at construction (smallest
+        prefill bucket, fused decode step, sampling; speculative programs
+        when enabled): the controller marks the replica RUNNING only
+        after __init__, so a warmed fleet serves its first real request
+        at steady-state latency instead of burying it under compiles."""
+        from ray_tpu.llm import SamplingParams
+
+        self.engine.generate([1, 2, 3], SamplingParams(max_tokens=2, temperature=0.0))
 
     def check_health(self):
         """Serve health hook: a dead stepper means a dead engine."""
@@ -138,8 +158,20 @@ class LLMServer:
         if self._stepper_error is not None:
             raise RuntimeError(f"llm stepper died:\n{self._stepper_error}")
         params = SamplingParams(**(sampling_params or {}))
-        ev = threading.Event()
         rid = self._admit(list(prompt_token_ids), params)
+        out = self._await_finished(rid, timeout_s)
+        return {
+            "request_id": out.request_id,
+            "prompt_token_ids": out.prompt_token_ids,
+            "token_ids": out.token_ids,
+            "finish_reason": out.finish_reason,
+        }
+
+    def _await_finished(self, rid: str, timeout_s: float):
+        """Block until the stepping thread finishes request ``rid`` and
+        return its RequestOutput (shared by generate, the disaggregated
+        handoff path, and the prefill replica's handoff wait)."""
+        ev = threading.Event()
         with self._lock:
             if rid in self._done:  # finished before we registered (tiny prompts)
                 ev.set()
@@ -156,12 +188,7 @@ class LLMServer:
             out = self._done.pop(rid, None)
         if out is None:
             raise RuntimeError(f"llm stepper died:\n{self._stepper_error or 'unknown'}")
-        return {
-            "request_id": out.request_id,
-            "prompt_token_ids": out.prompt_token_ids,
-            "token_ids": out.token_ids,
-            "finish_reason": out.finish_reason,
-        }
+        return out
 
     def _admit(self, prompt_token_ids, params) -> str:
         """Admission seam: monolithic replicas prefill locally; the
@@ -307,44 +334,150 @@ class OpenAIServer(LLMServer):
         yield "data: [DONE]\n\n"
 
 
-class PrefillServer:
-    """Prefill-only replica for disaggregated serving (reference:
-    python/ray/llm/tests/serve/deployments/prefill_decode_disagg/ — the
-    vLLM KV-connector split; here the KV payload is host numpy arrays
-    that ride the shm object plane between replicas)."""
+class PrefillServer(LLMServer):
+    """Prefill-only replica for disaggregated serving (llm/disagg/;
+    reference: python/ray/llm/tests/serve/deployments/
+    prefill_decode_disagg/ — the vLLM KV-connector split).
+
+    Engine-backed: concurrent prefill calls enqueue prefill-only requests
+    and the stepping thread BATCHES same-bucket prompts into one forward
+    (the engine's admission + prefill stages; the decode stage never sees
+    them). Each finished block is published as an OWNED object in this
+    replica's process — the replica is the block's owner for its whole
+    life — and only the tiny (meta, ref) pair travels back."""
 
     def __init__(self, llm_config: LLMConfig):
-        from ray_tpu.llm import LLMEngine
+        from dataclasses import replace as _replace
 
-        cfg = llm_config.model_config
-        if cfg is None:
-            from ray_tpu.models.llama import LlamaConfig
-
-            cfg = LlamaConfig.tiny(dtype="float32")
         kwargs = dict(llm_config.engine_kwargs)
-        kwargs.setdefault("enable_prefix_caching", False)  # prefill is stateless
-        self.engine = LLMEngine(cfg, params=llm_config.params, **kwargs)
+        kwargs.setdefault("enable_prefix_caching", False)  # stateless by default
+        super().__init__(_replace(llm_config, engine_kwargs=kwargs))
 
-    def prefill(self, prompt_token_ids) -> dict:
+    def _prewarm(self):
+        # a prefill replica's hot path is prefill + extract, not decode
+        self.engine.prefill_handoff([1, 2, 3])
+
+    def prefill(self, prompt_token_ids, timeout_s: float = 180.0) -> dict:
+        """-> {"meta": {...}, "ref": ObjectRef}: the handoff publish half
+        (llm/disagg/handoff.py)."""
+        from ray_tpu.llm.disagg import publish_handoff
+
+        if self._stepper_error is not None:
+            raise RuntimeError(f"llm stepper died:\n{self._stepper_error}")
+        rid = self.engine.add_prefill_request(list(prompt_token_ids))
+        try:
+            out = self._await_finished(rid, timeout_s)
+        except BaseException:
+            # waiter gave up (timeout/stepper death) possibly AFTER the
+            # prefill stage stashed the block: drop it or it leaks on the
+            # replica forever
+            self.engine.pop_handoff(rid)
+            raise
+        kv = self.engine.pop_handoff(rid)
+        if out.finish_reason != "handoff" or kv is None:
+            raise RuntimeError(f"prefill-only request {rid} failed: {out.finish_reason}")
+        meta, ref = publish_handoff(kv)
+        return {"meta": meta, "ref": ref}
+
+    def prefill_local(self, prompt_token_ids) -> dict:
+        """Legacy by-value path (payload rides the reply instead of the
+        owned-object plane); kept for callers without a direct plane."""
         return self.engine.prefill_remote(list(prompt_token_ids))
+
+
+class DecodeServer(LLMServer):
+    """Decode replica: admits handoff KV blocks (borrow -> fused
+    scatter-in) and runs continuous batching decode-only from there —
+    prompt compute and token generation scale independently. Speculative
+    decoding composes: pass LLMConfig.speculative and the admitted lanes
+    draft/verify exactly as local admissions do. Recompute-preemption
+    re-prefills LOCALLY (vLLM semantics: the preempted sequence's
+    prompt+generated re-admits on this replica, not through the router)."""
+
+    def __init__(self, llm_config: LLMConfig, prefill_handle=None):
+        super().__init__(llm_config)
+        self.prefill_handle = prefill_handle
+
+    def _prewarm(self):
+        super()._prewarm()
+        # warm the handoff admission path too: extract a local block and
+        # scatter it back in, compiling the fused scatter-in and the
+        # first-token sample for the smallest bucket before the replica
+        # reports RUNNING
+        from ray_tpu.llm import SamplingParams
+
+        kv = self.engine.prefill_handoff([1, 2, 3])
+        self.engine.add_prefilled(kv, SamplingParams(max_tokens=2, temperature=0.0))
+        while self.engine.has_unfinished():
+            self.engine.step()
+
+    def _admit(self, prompt_token_ids, params) -> str:
+        """Legacy decode-as-ingress path (prefill_handle given): fetch the
+        handoff ourselves, then admit."""
+        from ray_tpu.llm.disagg import fetch_handoff
+
+        if self.prefill_handle is None:
+            return super()._admit(prompt_token_ids, params)
+        out = self.prefill_handle.prefill.remote(list(prompt_token_ids)).result(timeout_s=180.0)
+        kv = fetch_handoff(out["ref"], out["meta"])
+        return self.engine.add_prefilled(kv, params)
+
+    def generate_from_handoff(self, meta: dict, ref, sampling_params: dict | None = None, timeout_s: float = 300.0) -> dict:
+        """Router path: borrow the published KV block (bounded-retry,
+        zero-copy fetch), scatter it into this replica's cache/pool, and
+        decode to completion. A lost handoff raises HandoffLostError to
+        the router — the signal to re-prefill — instead of hanging."""
+        from ray_tpu.llm import SamplingParams
+        from ray_tpu.llm.disagg import fetch_handoff
+
+        if self._stepper_error is not None:
+            raise RuntimeError(f"llm stepper died:\n{self._stepper_error}")
+        kv = fetch_handoff(ref, meta)
+        params = SamplingParams(**(sampling_params or {}))
+        rid = self.engine.add_prefilled(kv, params)
+        self._work.set()
+        out = self._await_finished(rid, timeout_s)
+        return {
+            "request_id": out.request_id,
+            "prompt_token_ids": out.prompt_token_ids,
+            "token_ids": out.token_ids,
+            "finish_reason": out.finish_reason,
+        }
+
+
+class DisaggRouterServer:
+    """Ingress of the disaggregated graph: llm/disagg/router.py policy
+    over the prefill and decode deployment handles. The router never
+    touches KV bytes — it moves (meta, ref) pairs and owns the bounded
+    retry budget for dead decode lanes and lost handoffs."""
+
+    def __init__(self, llm_config: LLMConfig, prefill_handle, decode_handle, max_attempts: int = 3):
+        from ray_tpu.llm.disagg import DisaggRouter
+
+        self._prefill_handle = prefill_handle
+        self._decode_handle = decode_handle
+
+        def _prefill(prompt):
+            out = prefill_handle.prefill.remote(prompt).result(timeout_s=180.0)
+            return out["meta"], out["ref"]
+
+        def _decode(meta, ref, prompt, sp):
+            return decode_handle.generate_from_handoff.remote(meta, ref, sp).result(timeout_s=600.0)
+
+        self.router = DisaggRouter(_prefill, _decode, max_attempts=max_attempts)
+
+    def generate(self, prompt_token_ids, sampling_params: dict | None = None) -> dict:
+        return self.router.generate(list(prompt_token_ids), sampling_params)
+
+    def disagg_stats(self) -> dict:
+        return self.router.stats()
 
     def check_health(self):
         return True
 
-
-class DecodeServer(LLMServer):
-    """Decode replica fed by a separate prefill deployment: admission
-    fetches KV through the prefill handle, then continuous batching
-    decodes locally — prompt compute and token generation scale
-    independently (reference: prefill_decode_disagg test deployments)."""
-
-    def __init__(self, llm_config: LLMConfig, prefill_handle):
-        super().__init__(llm_config)
-        self.prefill_handle = prefill_handle
-
-    def _admit(self, prompt_token_ids, params) -> str:
-        kv = self.prefill_handle.prefill.remote(prompt_token_ids).result(timeout_s=180.0)
-        return self.engine.add_prefilled(kv, params)
+    def __call__(self, request):
+        body = request.json() if hasattr(request, "json") else dict(request)
+        return self.generate(body["prompt_token_ids"], body.get("sampling_params"))
 
 
 def build_pd_disagg_deployment(
@@ -353,23 +486,37 @@ def build_pd_disagg_deployment(
     num_prefill_replicas: int = 1,
     num_decode_replicas: int = 1,
     name: str = "LLM",
+    max_attempts: int = 3,
 ):
-    """-> Application: decode ingress backed by a prefill deployment
-    (reference: prefill_decode_disagg serve graph). Call .generate on the
-    returned handle exactly like the monolithic deployment."""
+    """-> Application: router ingress over a prefill pool and a decode
+    pool with the KV block shipped as an owned handoff object between
+    them (llm/disagg/). N_prefill and N_decode scale independently; call
+    .generate on the returned handle exactly like the monolithic
+    deployment. Replicas pre-warm their compiles at creation
+    (LLMConfig.prewarm) so fleet spin-up, not the first request, pays
+    them."""
     from ray_tpu import serve
 
     health = {"health_check_timeout_s": 180.0, "health_check_period_s": 2.0}
     prefill_app = serve.deployment(
-        name=f"{name}-prefill", num_replicas=num_prefill_replicas, **health
+        name=f"{name}-prefill",
+        num_replicas=num_prefill_replicas,
+        max_ongoing_requests=llm_config.max_ongoing_requests,
+        **health,
     )(PrefillServer).bind(llm_config)
-    decode_dep = serve.deployment(
+    decode_app = serve.deployment(
         name=f"{name}-decode",
         num_replicas=num_decode_replicas,
         max_ongoing_requests=llm_config.max_ongoing_requests,
         **health,
-    )(DecodeServer)
-    return decode_dep.bind(llm_config, prefill_app)
+    )(DecodeServer).bind(llm_config)
+    router_dep = serve.deployment(
+        name=f"{name}-router",
+        num_replicas=1,
+        max_ongoing_requests=llm_config.max_ongoing_requests * max(num_decode_replicas, 1),
+        **health,
+    )(DisaggRouterServer)
+    return router_dep.bind(llm_config, prefill_app, decode_app, max_attempts)
 
 
 def _build_app(llm_config: LLMConfig, cls, name: str):

@@ -76,6 +76,80 @@ def test_owned_ref_promoted_for_constrained_task(rt_start):
         client.remove_node(node.node_id)
 
 
+def test_multi_mb_owned_object_borrow_roundtrip(rt_start):
+    """Large-payload regression for the disagg handoff plane: a multi-MB
+    OWNED object (direct.put_owned) round-trips through the direct
+    transport with borrow-release semantics — no byte copy on the borrow
+    path (zero-copy views into the shm segment; the GET frame carries
+    only the descriptor) and no premature free while a serialized-out
+    copy's borrow may still register (the backstop window, not the grace
+    window, governs — RT_OWNED_OBJECT_LEAK_BACKSTOP_S path)."""
+    import pickle
+
+    def _mmap_backed(a):
+        base = a
+        while True:
+            nxt = getattr(base, "base", None)
+            if nxt is None:
+                nxt = getattr(base, "obj", None)  # memoryview -> backing object
+            if nxt is None or nxt is base:
+                return type(base).__name__ == "mmap"
+            base = nxt
+
+    arr = np.arange(1_500_000, dtype=np.float32)  # 6 MB: far past inline
+    ref = direct.put_owned({"blob": arr, "tag": 7})
+    k = ref.id.binary()
+    store = _state().owned
+    assert store.owns(k)
+    assert store.entry(k).payload.shm is not None, "multi-MB payload must be shm-backed"
+
+    # owner-local zero-copy view: read-only, backed by the segment mapping
+    v = direct.get_owned_view(ref.id)
+    assert v["tag"] == 7 and np.array_equal(v["blob"], arr)
+    assert not v["blob"].flags.writeable and _mmap_backed(v["blob"])
+
+    # cross-process borrow through the direct transport: the worker pulls
+    # from the owner by hint and must ALSO land on a zero-copy mapping
+    @ray_tpu.remote
+    def consume(wrapped):
+        from ray_tpu.core import direct as d
+
+        val = d.get_owned_view(wrapped[0].id)
+        blob = val["blob"]
+        base = blob
+        while True:
+            nxt = getattr(base, "base", None)
+            if nxt is None:
+                nxt = getattr(base, "obj", None)
+            if nxt is None or nxt is base:
+                break
+            base = nxt
+        return float(blob.sum()), blob.flags.writeable, type(base).__name__
+
+    total, writeable, base_t = ray_tpu.get(consume.remote([ref]))
+    assert total == float(arr.sum())
+    assert not writeable and base_t == "mmap", (writeable, base_t)
+
+    # premature-free guard: a serialized-out ref with its borrow not yet
+    # registered must survive the GRACE window (only the leak backstop
+    # may reclaim it)
+    store.grace_s, store.backstop_s = 0.3, 30.0
+    blob = pickle.dumps(ref)  # pending_serialized += 1 (borrow in flight)
+    del ref
+    gc.collect()
+    time.sleep(1.2)  # several gc_pass beats past grace_s
+    assert store.entry(k) is not None, "live-borrow window premature free (ADVICE r5 regression)"
+
+    # ...and the LEAK BACKSTOP does reclaim it once a borrower that never
+    # registered (died before registration) is the only holder left — a
+    # crashed decode replica can never leak the KV block forever
+    store.backstop_s = 0.5
+    deadline = time.time() + 15
+    while time.time() < deadline and store.entry(k) is not None:
+        time.sleep(0.1)
+    assert store.entry(k) is None, "owned handoff block leaked past the backstop"
+
+
 def test_borrowed_owned_ref_across_workers(rt_start):
     """Worker A's owned result consumed by worker B via the owner."""
 

@@ -161,19 +161,24 @@ def test_jaxcheck_self_check_runs_clean():
     )
 
 
-def test_jaxcheck_traces_at_least_twelve_entries():
+def test_jaxcheck_traces_at_least_sixteen_entries():
     from ray_tpu.lint.jaxcheck import import_entry_modules, registry
 
     import_entry_modules()
     entries = registry.all_entries()
-    # PR 4 registered 8; the speculative subsystem (llm/spec/) adds its
-    # draft + verify fused steps — any entry silently dropping out of the
-    # registry is an invariant check that stopped running
-    assert len(entries) >= 12, [e.name for e in entries]
+    # PR 4 registered 8; the speculative subsystem (llm/spec/) added 4;
+    # disaggregated serving (llm/disagg/scatter.py) adds its extract +
+    # scatter-in pairs — any entry silently dropping out of the registry
+    # is an invariant check that stopped running
+    assert len(entries) >= 16, [e.name for e in entries]
     subsystems = {e.name.split(".")[0] for e in entries}
     assert {"llm", "parallel", "collective"} <= subsystems
     names = {e.name for e in entries}
     assert {"llm.spec_verify", "llm.spec_verify_paged", "llm.spec_ngram_propose", "llm.spec_draft_steps"} <= names
+    assert {
+        "llm.disagg_extract_slots", "llm.disagg_extract_paged",
+        "llm.disagg_scatter_slots", "llm.disagg_scatter_paged",
+    } <= names
 
 
 def test_cli_jax_flag_and_rt_wiring():
@@ -184,7 +189,7 @@ def test_cli_jax_flag_and_rt_wiring():
     )
     assert r.returncode == 0, r.stdout + r.stderr
     m = re.search(r"jaxcheck traced (\d+) entry point", r.stderr)
-    assert m and int(m.group(1)) >= 12, r.stderr
+    assert m and int(m.group(1)) >= 16, r.stderr
 
 
 def test_cli_list_rules_includes_jax_catalog(capsys):

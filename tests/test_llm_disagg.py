@@ -1,0 +1,291 @@
+"""Disaggregated prefill/decode serving (llm/disagg/): token-identity
+against the single-engine oracle, handoff codec validation, and the
+router's bounded failure policy.
+
+The sync single-engine loop is the oracle: a prefill engine extracting
+handoff blocks + a device-resident decode engine scattering them in must
+emit exactly the tokens the oracle emits, for both KV layouts, under
+admission / eviction / preemption / abort, greedy and seeded sampling,
+with speculative decoding composing on the decode side
+(tests mirror tests/test_llm_device_resident.py's methodology).
+
+Lean by design (tier-1 budget): one module-scoped prefill engine feeds
+every layout's decode test through the codec round-trip.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import ray_tpu  # noqa: E402
+from ray_tpu.llm import LLMEngine, SamplingParams  # noqa: E402
+from ray_tpu.llm.disagg import (  # noqa: E402
+    DisaggRequestError,
+    DisaggRouter,
+    HandoffError,
+    HandoffLostError,
+    decode_handoff,
+    encode_handoff,
+)
+from ray_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
+
+CFG = LlamaConfig.tiny(dtype="float32", remat=False, max_seq_len=256)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def prefill_eng(params):
+    """One slots-layout prefill engine shared by every decode test: the
+    handoff block is layout-agnostic, so a slots producer feeds both
+    slots and paged consumers (cross-layout shipping covered for free)."""
+    return LLMEngine(CFG, params, max_num_seqs=2, max_seq_len=128, enable_prefix_caching=False)
+
+
+def _ship(prefill_eng, prompt):
+    """Producer -> codec round-trip -> consumer-format payload."""
+    return decode_handoff(encode_handoff(prefill_eng.prefill_handoff(prompt)))
+
+
+def _drive(eng, schedule, aborts=None, max_steps=800):
+    """Step an engine over {step: [(admit_fn, rid_key)]} admissions;
+    returns ({key: tokens}, {key: reason})."""
+    finals, reasons, ids = {}, {}, {}
+    last_t = max(schedule)
+    t = 0
+    while t <= last_t or eng.has_unfinished():
+        for admit, key in schedule.get(t, []):
+            ids[admit()] = key
+        if aborts and t in aborts:
+            eng.abort_request([r for r, kk in ids.items() if kk == aborts[t]][0])
+        for o in eng.step():
+            if o.finished and o.request_id in ids:
+                finals[ids[o.request_id]] = o.token_ids
+                reasons[ids[o.request_id]] = o.finish_reason
+        t += 1
+        assert t < max_steps, "schedule never converged"
+    return finals, reasons
+
+
+def _mk_schedule(rng, n_req, max_len=90, max_tok=12):
+    """(prompts, sampling, step) tuples incl. one seeded stochastic lane."""
+    reqs = []
+    for i in range(n_req):
+        prompt = list(rng.integers(1, CFG.vocab_size - 1, size=int(rng.integers(4, max_len))))
+        sp = SamplingParams(max_tokens=int(rng.integers(3, max_tok)), temperature=0.0)
+        reqs.append((prompt, sp, int(rng.integers(0, 6))))
+    reqs.append(([7, 7, 7], SamplingParams(max_tokens=8, temperature=1.0, seed=123), 1))
+    return reqs
+
+
+def _oracle_streams(params, reqs, engine_kwargs, aborts=None):
+    """The single-engine sync oracle over the same request set."""
+    eng = LLMEngine(CFG, params=params, device_resident=False, **engine_kwargs)
+    sched = {}
+    for i, (prompt, sp, t) in enumerate(reqs):
+        sched.setdefault(t, []).append((lambda p=prompt, s=sp: eng.add_request(p, s), i))
+    return _drive(eng, sched, aborts)
+
+
+def _disagg_streams(params, prefill_eng, reqs, engine_kwargs, aborts=None, speculative=None):
+    """Prefill engine -> codec -> device-resident decode engine."""
+    dec = LLMEngine(CFG, params=params, device_resident=True, speculative=speculative, **engine_kwargs)
+    handoffs = {i: _ship(prefill_eng, prompt) for i, (prompt, _, _) in enumerate(reqs)}
+    sched = {}
+    for i, (_, sp, t) in enumerate(reqs):
+        sched.setdefault(t, []).append((lambda kv=handoffs[i], s=sp: dec.add_prefilled(kv, s), i))
+    finals, reasons = _drive(dec, sched, aborts)
+    return finals, reasons, dec
+
+
+def test_disagg_slots_token_identity_with_abort(params, prefill_eng):
+    """Slots decode engine fed by handoffs == sync single-engine oracle,
+    greedy + seeded sampling, with one mid-flight abort riding along."""
+    reqs = _mk_schedule(np.random.default_rng(0), 4)
+    kw = dict(max_num_seqs=3, max_seq_len=128, enable_prefix_caching=False)
+    aborts = {5: 0}  # abort the first request mid-decode
+    sync, sync_r = _oracle_streams(params, reqs, kw, aborts)
+    dis, dis_r, _ = _disagg_streams(params, prefill_eng, reqs, kw, aborts)
+    assert set(sync) == set(dis)
+    for key in sync:
+        if sync_r[key] == "aborted":
+            # aborts are host-timed: the two architectures cut the stream
+            # at (up to one token) different points; the surviving prefix
+            # must still be identical
+            n = min(len(sync[key]), len(dis[key]))
+            assert dis[key][:n] == sync[key][:n]
+        else:
+            assert dis[key] == sync[key], f"req {key}: disagg {dis[key]} != oracle {sync[key]}"
+            assert dis_r[key] == sync_r[key]
+    assert "aborted" in set(sync_r.values())
+
+
+def test_disagg_paged_token_identity_under_preemption(params, prefill_eng):
+    """Paged decode engine with a pool too small for the load: handoff
+    admissions + growth preemption (recompute re-prefill ON the decode
+    replica, vLLM semantics) still emit oracle-identical greedy tokens."""
+    rng = np.random.default_rng(1)
+    reqs = []
+    for i in range(4):
+        prompt = list(rng.integers(1, CFG.vocab_size - 1, size=int(rng.integers(50, 60))))
+        reqs.append((prompt, SamplingParams(max_tokens=int(rng.integers(40, 56)), temperature=0.0), int(rng.integers(0, 4))))
+    kw = dict(
+        max_num_seqs=3, max_seq_len=256, kv_layout="paged", page_size=32,
+        num_pages=8, enable_prefix_caching=False,
+    )
+    sync, sync_r = _oracle_streams(params, reqs, kw)
+    dis, dis_r, dec = _disagg_streams(params, prefill_eng, reqs, kw)
+    for key in sync:
+        assert dis[key] == sync[key], f"req {key}: disagg {dis[key]} != oracle {sync[key]}"
+    assert dis_r == sync_r
+    assert dec.preemption_count > 0, "schedule never exercised decode-side preemption"
+    assert dec._page_alloc.free_pages == dec._pcfg.num_pages - 1  # pool drained clean
+
+
+def test_disagg_spec_composes_on_decode_side(params, prefill_eng):
+    """Speculative decoding on the DECODE side of the split: handoff
+    admissions draft/verify like local ones, token-identical to the
+    non-speculative decode engine over the same handoffs."""
+    from ray_tpu.llm.spec import SpecConfig
+
+    # period-8 repeating prompts: the ngram drafter has something to hit
+    reqs = [
+        ([10 + (i % 8) for i in range(32)], SamplingParams(max_tokens=10, temperature=0.0), 0),
+        ([50 + (i % 8) for i in range(24)], SamplingParams(max_tokens=8, temperature=0.0), 1),
+    ]
+    kw = dict(max_num_seqs=2, max_seq_len=128, enable_prefix_caching=False)
+    plain, plain_r, _ = _disagg_streams(params, prefill_eng, reqs, kw)
+    spec, spec_r, dec = _disagg_streams(
+        params, prefill_eng, reqs, kw, speculative=SpecConfig(drafter="ngram", k=3)
+    )
+    assert spec == plain and spec_r == plain_r
+    assert dec.spec_stats()["rounds"] > 0, "spec path never engaged"
+
+
+def test_handoff_codec_rejects_inconsistent_payloads(params, prefill_eng):
+    kv = prefill_eng.prefill_handoff([5, 6, 7, 8])
+    wire = encode_handoff(kv)
+    assert decode_handoff(wire)["n"] == 4
+    bad = dict(wire)
+    bad["n"] = 0
+    with pytest.raises(HandoffError):
+        decode_handoff(bad)
+    bad = dict(wire)
+    bad["shape"] = (1, 2, 3, 4)
+    with pytest.raises(HandoffError):
+        decode_handoff(bad)
+    with pytest.raises(HandoffError):
+        decode_handoff({"kind": "other"})
+    trunc = dict(wire)
+    trunc["k"] = trunc["k"][:, :1]
+    with pytest.raises(HandoffError):
+        decode_handoff(trunc)
+
+
+# ------------------------------------------------- router failure policy
+# (real object plane, synthetic KV: no jax compiles in these tests)
+
+
+@pytest.fixture
+def rt_runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def _synthetic_kv(prompt):
+    n = len(prompt)
+    return {
+        "k": np.zeros((2, 64, 2, 4), np.float32),
+        "v": np.zeros((2, 64, 2, 4), np.float32),
+        "n": n,
+        "logits": np.zeros((32,), np.float32),
+        "prompt_token_ids": list(prompt),
+    }
+
+
+def test_router_reprefills_when_handoff_evicted(rt_runtime):
+    """Handoff object freed before scatter-in: the decode side's bounded
+    fetch raises HandoffLostError (no hang), the router re-prefills a
+    fresh block, the request succeeds."""
+    from ray_tpu.core import direct
+    from ray_tpu.llm.disagg import fetch_handoff, publish_handoff
+
+    calls = {"prefill": 0}
+
+    def prefill(prompt):
+        calls["prefill"] += 1
+        meta, ref = publish_handoff(_synthetic_kv(prompt))
+        if calls["prefill"] == 1:
+            direct.state().owned.free(ref.id.binary())  # evicted before scatter-in
+        return meta, ref
+
+    def decode(meta, ref, prompt, sp):
+        try:
+            kv = fetch_handoff(ref, meta, timeout_s=1.0, retries=1, retry_wait_s=0.05)
+        except HandoffLostError as e:
+            # as under Serve: the replica's exception crosses the wire
+            # wrapped in TaskError — the router must still unwrap it and
+            # re-prefill instead of burning retries on the dead ref
+            from ray_tpu.exceptions import TaskError
+
+            raise TaskError.from_exception(e)
+        return {"token_ids": [kv["n"]], "finish_reason": "length"}
+
+    router = DisaggRouter(prefill, decode, max_attempts=3)
+    t0 = time.time()
+    out = router.generate([1, 2, 3], {})
+    assert out["token_ids"] == [3]
+    assert time.time() - t0 < 30, "lost-handoff retry must be bounded, not a hang"
+    s = router.stats()
+    assert s["prefills"] == 2 and s["handoffs_lost"] == 1 and s["inflight"] == 0
+
+
+def test_router_reuses_handoff_across_decode_death(rt_runtime):
+    """Decode lane dies AFTER the handoff: the block still lives in its
+    owner, so the retry reuses the same ref — no wasted re-prefill."""
+    from ray_tpu.llm.disagg import fetch_handoff, publish_handoff
+
+    seen_refs = []
+
+    def prefill(prompt):
+        return publish_handoff(_synthetic_kv(prompt))
+
+    def decode(meta, ref, prompt, sp):
+        seen_refs.append(ref)
+        if len(seen_refs) == 1:
+            raise ConnectionError("decode replica died mid-request")
+        kv = fetch_handoff(ref, meta, timeout_s=1.0, retries=0)
+        return {"token_ids": list(kv["prompt_token_ids"]), "finish_reason": "length"}
+
+    router = DisaggRouter(prefill, decode, max_attempts=3)
+    out = router.generate([9, 8], {})
+    assert out["token_ids"] == [9, 8]
+    assert len(seen_refs) == 2 and seen_refs[0] is seen_refs[1], "same handoff must be reused"
+    s = router.stats()
+    assert s["prefills"] == 1 and s["decode_retries"] == 1
+
+
+def test_router_surfaces_terminal_failure(rt_runtime):
+    """Every lane dead: a client-visible DisaggRequestError after the
+    attempt budget — bounded, never hanging, nothing left in flight."""
+    from ray_tpu.llm.disagg import publish_handoff
+
+    def prefill(prompt):
+        return publish_handoff(_synthetic_kv(prompt))
+
+    def decode(meta, ref, prompt, sp):
+        raise ConnectionError("no decode lane alive")
+
+    router = DisaggRouter(prefill, decode, max_attempts=2)
+    with pytest.raises(DisaggRequestError):
+        router.generate([1], {})
+    s = router.stats()
+    assert s["failed"] == 1 and s["inflight"] == 0
