@@ -41,11 +41,21 @@ class HandoffLostError(RuntimeError):
     after exhausting their budget; the router reacts by re-prefilling."""
 
 
+def _scale_shape(shape: tuple) -> tuple:
+    """Expected wire scale shape [L, kv, T_pad] for a k block [L, T_pad,
+    kv, hd] — one f32 per (layer, head, position), position axis last
+    (llm/kv_quant.py)."""
+    return (shape[0], shape[2], shape[1])
+
+
 def encode(kv: dict) -> dict:
     """Engine handoff payload -> self-describing wire dict.
 
     ``kv`` is the engine's prefill-extract product: k/v [L, T_pad, kv_h,
-    hd] numpy, logits [vocab] f32, n real tokens, prompt_token_ids."""
+    hd] numpy, logits [vocab] f32, n real tokens, prompt_token_ids — and
+    for an int8 producer cache also k_scale/v_scale [L, kv_h, T_pad] f32
+    per-head scales; the wire then carries int8 values + scales (~half
+    the bytes of a bf16 block)."""
     k, v = np.asarray(kv["k"]), np.asarray(kv["v"])
     logits = np.asarray(kv["logits"], np.float32)
     n = int(kv["n"])
@@ -53,7 +63,7 @@ def encode(kv: dict) -> dict:
         raise HandoffError(f"KV block must be [L, T_pad, kv, hd] twins, got k{k.shape} v{v.shape}")
     if not 0 < n <= k.shape[1]:
         raise HandoffError(f"real length {n} outside block width {k.shape[1]}")
-    return {
+    wire = {
         "version": HANDOFF_VERSION,
         "kind": "kv_handoff",
         "n": n,
@@ -65,12 +75,31 @@ def encode(kv: dict) -> dict:
         "v": v,
         "logits": logits,
     }
+    if (kv.get("k_scale") is not None) != (kv.get("v_scale") is not None):
+        raise HandoffError("k_scale and v_scale must be supplied together")
+    if kv.get("k_scale") is not None:
+        k_sc, v_sc = np.asarray(kv["k_scale"]), np.asarray(kv["v_scale"])
+        if str(k.dtype) != "int8":
+            raise HandoffError(f"scale tensors supplied for a non-int8 block ({k.dtype})")
+        want = _scale_shape(k.shape)
+        if tuple(k_sc.shape) != want or tuple(v_sc.shape) != want:
+            raise HandoffError(f"scale shape must be {want} ([L, kv, T_pad]), got k{k_sc.shape} v{v_sc.shape}")
+        if str(k_sc.dtype) != "float32" or str(v_sc.dtype) != "float32":
+            raise HandoffError(f"scales must be float32, got k:{k_sc.dtype} v:{v_sc.dtype}")
+        wire["k_scale"] = k_sc
+        wire["v_scale"] = v_sc
+    elif str(k.dtype) == "int8":
+        raise HandoffError("int8 block without its per-head scale tensors")
+    return wire
 
 
 def decode(payload: dict) -> dict:
     """Wire dict -> validated engine admission payload (add_prefilled
     format). Raises HandoffError on anything inconsistent — a truncated
-    or foreign object must never scatter garbage into a live pool."""
+    or foreign object must never scatter garbage into a live pool. For
+    an int8 block the per-head scale tensors are validated (shape
+    [L, kv, T_pad], float32) with the same severity: a garbage scale
+    would silently re-scale every attended position."""
     if not isinstance(payload, dict) or payload.get("kind") != "kv_handoff":
         raise HandoffError(f"not a kv_handoff payload: {type(payload).__name__}")
     if payload.get("version") != HANDOFF_VERSION:
@@ -85,17 +114,35 @@ def decode(payload: dict) -> dict:
     prompt = payload["prompt_token_ids"]
     if not 0 < n <= shape[1] or n != len(prompt):
         raise HandoffError(f"length {n} inconsistent with block width {shape[1]} / prompt {len(prompt)}")
-    return {"k": k, "v": v, "n": n, "logits": payload["logits"], "prompt_token_ids": list(prompt)}
+    out = {"k": k, "v": v, "n": n, "logits": payload["logits"], "prompt_token_ids": list(prompt)}
+    if payload["dtype"] == "int8":
+        k_sc, v_sc = payload.get("k_scale"), payload.get("v_scale")
+        if k_sc is None or v_sc is None:
+            raise HandoffError("int8 block without its per-head scale tensors")
+        want = _scale_shape(shape)
+        if tuple(k_sc.shape) != want or tuple(v_sc.shape) != want:
+            raise HandoffError(f"scale shape mismatch: expected {want}, got k{tuple(k_sc.shape)} v{tuple(v_sc.shape)}")
+        if str(k_sc.dtype) != "float32" or str(v_sc.dtype) != "float32":
+            raise HandoffError(f"scale dtype must be float32, got k:{k_sc.dtype} v:{v_sc.dtype}")
+        out["k_scale"] = k_sc
+        out["v_scale"] = v_sc
+    elif payload.get("k_scale") is not None or payload.get("v_scale") is not None:
+        raise HandoffError(f"scale tensors on a non-int8 block ({payload['dtype']})")
+    return out
 
 
 def meta_of(payload: dict) -> dict:
     """Small router-facing summary (no arrays): what travels with the ref."""
+    nbytes = int(payload["k"].nbytes + payload["v"].nbytes + payload["logits"].nbytes)
+    if payload.get("k_scale") is not None:
+        nbytes += int(payload["k_scale"].nbytes + payload["v_scale"].nbytes)
     return {
         "n": payload["n"],
         "t_pad": payload["t_pad"],
         "shape": tuple(payload["shape"]),
         "dtype": payload["dtype"],
-        "nbytes": int(payload["k"].nbytes + payload["v"].nbytes + payload["logits"].nbytes),
+        "quantized": payload.get("k_scale") is not None,
+        "nbytes": nbytes,
     }
 
 

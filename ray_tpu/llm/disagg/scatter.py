@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.lint import jaxcheck
-from ray_tpu.llm.model_runner import _sds, _sds_cache, _sds_pool, _trace_cfg
+from ray_tpu.llm.model_runner import _sds, _sds_cache, _sds_cache_q, _sds_pool, _sds_pool_q, _trace_cfg
 
 
 # ---------------------------------------------------------------------------
@@ -65,6 +65,35 @@ def _bucket_scatter_paged(B=8, pages=64, page=16, npg=8):
     ), {}
 
 
+def _bucket_extract_slots_q(B=8, S=256, T=128):
+    cfg = _trace_cfg()
+    return (_sds_cache_q(cfg, B, S), _sds((), jnp.int32)), {"T": T}
+
+
+def _bucket_extract_paged_q(pages=64, page=16, npg=8):
+    cfg = _trace_cfg()
+    return (_sds_pool_q(cfg, pages, page), _sds((npg,), jnp.int32)), {}
+
+
+def _bucket_scatter_slots_q(B=8, S=256, T=128):
+    """Int8 producer -> int8 consumer: int8 block + wire-layout scales."""
+    cfg = _trace_cfg()
+    blk = _sds((cfg.num_layers, T, cfg.num_kv_heads, cfg.hd), jnp.int8)
+    sc = _sds((cfg.num_layers, cfg.num_kv_heads, T), jnp.float32)
+    return (_sds_cache_q(cfg, B, S), _sds((), jnp.int32), blk, blk, _sds((), jnp.int32), sc, sc), {}
+
+
+def _bucket_scatter_paged_q(B=8, pages=64, page=16, npg=8):
+    cfg = _trace_cfg()
+    max_pg = pages // B * 2
+    blk = _sds((cfg.num_layers, npg * page, cfg.num_kv_heads, cfg.hd), jnp.int8)
+    sc = _sds((cfg.num_layers, cfg.num_kv_heads, npg * page), jnp.float32)
+    return (
+        _sds_pool_q(cfg, pages, page), _sds((B, max_pg), jnp.int32), _sds((B,), jnp.int32),
+        _sds((), jnp.int32), _sds((max_pg,), jnp.int32), blk, blk, _sds((), jnp.int32), sc, sc,
+    ), {}
+
+
 # ---------------------------------------------------------------------------
 # extract (prefill side)
 # ---------------------------------------------------------------------------
@@ -77,7 +106,9 @@ def kv_extract_slots(cache, slot, T: int):
     """Extract one slot's first T positions as a contiguous block.
 
     Returns (k [L, T, kv, hd], v same); T static (per prefill bucket),
-    slot traced. Garbage past the real length is masked downstream."""
+    slot traced. For an int8 cache also (k_scale [L, kv, T], v_scale) —
+    the handoff wire layout, so quantized blocks leave at ~half the
+    bytes. Garbage past the real length is masked downstream."""
     from ray_tpu.llm.kv_cache import extract_sequence
 
     return extract_sequence(cache, slot, T)
@@ -108,18 +139,19 @@ def kv_extract_paged(pool, page_ids):
     donate=("cache",),
     donate_bytes=0,  # admission hot path: every buffer it touches counts
 )
-def kv_scatter_in_slots(cache, slot, k_blk, v_blk, n):
+def kv_scatter_in_slots(cache, slot, k_blk, v_blk, n, k_scale=None, v_scale=None):
     """Write a handoff block into `slot` at offset 0 and set its length —
     the slot-layout scatter-in, one program per bucket width.
 
     k_blk/v_blk: [L, T_pad, kv, hd] (padded tail is garbage, masked by
-    n); slot/n: traced scalars."""
-    zero = jnp.zeros((), dtype=jnp.int32)
-    start = (zero, jnp.asarray(slot, jnp.int32), zero, zero, zero)
-    k = jax.lax.dynamic_update_slice(cache["k"], k_blk[:, None].astype(cache["k"].dtype), start)
-    v = jax.lax.dynamic_update_slice(cache["v"], v_blk[:, None].astype(cache["v"].dtype), start)
-    lens = cache["length"].at[slot].set(jnp.asarray(n, jnp.int32))
-    return {"k": k, "v": v, "length": lens}
+    n); slot/n: traced scalars; k_scale/v_scale: [L, kv, T_pad] wire-
+    layout scales when the block is int8. Producer/consumer cache dtypes
+    may differ — kv_cache.insert_sequence requants transparently in all
+    four directions (fp block quantizes into an int8 cache; int8 block
+    dequantizes into an fp cache)."""
+    from ray_tpu.llm.kv_cache import insert_sequence
+
+    return insert_sequence(cache, slot, k_blk, v_blk, n, k_scale, v_scale)
 
 
 @jaxcheck.entry(
@@ -128,7 +160,7 @@ def kv_scatter_in_slots(cache, slot, k_blk, v_blk, n):
     donate=("pool", "tables", "lengths"),
     donate_bytes=0,
 )
-def kv_scatter_in_paged(pool, tables, lengths, slot, table_row, k_blk, v_blk, n):
+def kv_scatter_in_paged(pool, tables, lengths, slot, table_row, k_blk, v_blk, n, k_scale=None, v_scale=None):
     """Write a handoff block into its allocated pages AND refresh the
     device-resident scheduler lanes in ONE program: pool pages get the
     block (reshaped to whole pages), tables[slot] gets the row, and
@@ -136,24 +168,54 @@ def kv_scatter_in_paged(pool, tables, lengths, slot, table_row, k_blk, v_blk, n)
     insert + table-push + length-push admission sequence.
 
     table_row: [max_pg] int32 (allocated pages first, 0 = trash beyond);
-    k_blk/v_blk: [L, T_pad, kv, hd] with T_pad a page multiple. Scatter
-    only — the block is never read back in this program (aliasing
-    hazard)."""
-    L, T, kvh, hd = k_blk.shape
+    k_blk/v_blk: [L, T_pad, kv, hd] with T_pad a page multiple;
+    k_scale/v_scale: [L, kv, T_pad] wire-layout scales when the block is
+    int8 (paged_kv.insert_pages requants transparently across
+    producer/consumer dtype mismatches). Scatter only — the block is
+    never read back in this program (aliasing hazard)."""
+    from ray_tpu.llm.paged_kv import insert_pages
+
+    T = k_blk.shape[1]
     page = pool["k"].shape[2]
     npg = T // page
-    page_ids = table_row[:npg]
-    kr = k_blk.reshape(L, npg, page, kvh, hd).astype(pool["k"].dtype)
-    vr = v_blk.reshape(L, npg, page, kvh, hd).astype(pool["v"].dtype)
-    new_pool = {
-        "k": pool["k"].at[:, page_ids].set(kr),
-        "v": pool["v"].at[:, page_ids].set(vr),
-    }
+    new_pool = insert_pages(pool, table_row[:npg], k_blk, v_blk, k_scale, v_scale)
     return (
         new_pool,
         tables.at[slot].set(table_row),
         lengths.at[slot].set(jnp.asarray(n, jnp.int32)),
     )
+
+
+# int8 variants of all four programs (the disagg hot path with quantized
+# blocks + wire scales): registered as their own entries so donation and
+# the JXC003 dequant trap stay audited on the quantized path — including
+# the extracts, whose int8 branch returns a different pytree (values +
+# scale slices) than the fp buckets ever trace
+jaxcheck.entry(
+    name="llm.disagg_extract_slots_int8",
+    shapes={"b8_t128": _bucket_extract_slots_q},
+    donate_bytes=0,  # read-only over the cache: nothing to donate
+)(kv_extract_slots)
+
+jaxcheck.entry(
+    name="llm.disagg_extract_paged_int8",
+    shapes={"p64_npg8": _bucket_extract_paged_q},
+    donate_bytes=0,
+)(kv_extract_paged)
+
+jaxcheck.entry(
+    name="llm.disagg_scatter_slots_int8",
+    shapes={"b8_t128": _bucket_scatter_slots_q},
+    donate=("cache",),
+    donate_bytes=0,
+)(kv_scatter_in_slots)
+
+jaxcheck.entry(
+    name="llm.disagg_scatter_paged_int8",
+    shapes={"b8_p64": _bucket_scatter_paged_q},
+    donate=("pool", "tables", "lengths"),
+    donate_bytes=0,
+)(kv_scatter_in_paged)
 
 
 def make_handoff_fns():

@@ -236,6 +236,14 @@ class LLMEngine:
         max_num_seqs * max_seq_len / page_size) and ``page_size`` must
         divide every prefill bucket and the prefix block.
 
+        cache_dtype: KV-cache storage dtype, validated against
+        {bfloat16/bf16, float32/f32, int8} (None = the model dtype).
+        "int8" stores quantized K/V with per-layer/head amax scales
+        (llm/kv_quant.py): quantize-on-append inside the fused step,
+        dequantize-in-attention — ~2x the servable concurrency at fixed
+        cache HBM, with the fp cache as the accuracy oracle
+        (tests/test_llm_kv_int8.py).
+
         device_resident (default: RT_LLM_DEVICE_RESIDENT, on): the decode
         hot path keeps ALL per-step state on device — one fused jitted
         step per token, scheduler changes applied as scatter deltas, and
@@ -266,6 +274,12 @@ class LLMEngine:
         if kv_layout not in ("slots", "paged"):
             raise ValueError(f"kv_layout must be 'slots' or 'paged', got {kv_layout!r}")
         self.kv_layout = kv_layout
+        from ray_tpu.llm.kv_quant import is_int8, normalize_cache_dtype
+
+        # validate EARLY: an unsupported string must raise here, never
+        # fall through to jnp.dtype() (or worse, silently serve bf16)
+        self.kv_dtype = normalize_cache_dtype(cache_dtype) if cache_dtype is not None else config.dtype
+        self.kv_quant = is_int8(self.kv_dtype)
         if prefill_buckets is None:
             b, buckets = 64, []
             while b < self.max_seq_len:
@@ -296,7 +310,7 @@ class LLMEngine:
                 num_slots=self.max_num_seqs,
                 num_kv_heads=config.num_kv_heads,
                 head_dim=config.hd,
-                dtype=cache_dtype or config.dtype,
+                dtype=self.kv_dtype,
             )
             self._prefill, self._insert, self._decode, self._extend = make_paged_runner_fns(config)
             self._page_alloc = pkv.PageAllocator(self._pcfg.num_pages)
@@ -316,7 +330,7 @@ class LLMEngine:
                 max_seq_len=self.max_seq_len,
                 num_kv_heads=config.num_kv_heads,
                 head_dim=config.hd,
-                dtype=cache_dtype or config.dtype,
+                dtype=self.kv_dtype,
             )
         )
         # disaggregation plumbing: fused extract (prefill side) and
@@ -485,6 +499,42 @@ class LLMEngine:
                 },
             }
 
+    def kv_cache_stats(self) -> dict:
+        """KV-cache accounting (the HBM side of serving capacity): cache
+        dtype and layout, honest bytes/token (per-head scales included
+        for int8), allocated vs occupied HBM, and slot/page occupancy.
+        Sits next to spec_stats()/prefix_cache_stats() on the engine and
+        the serve replica."""
+        from ray_tpu.llm.kv_quant import bytes_per_token
+
+        cfg = self.config
+        per_tok = bytes_per_token(cfg.num_layers, cfg.num_kv_heads, cfg.hd, self.kv_dtype)
+        with self._lock:
+            arrs = self.pool if self.kv_layout == "paged" else self.cache
+            allocated = int(sum(int(a.nbytes) for name, a in arrs.items() if name != "length"))
+            out = {
+                "layout": self.kv_layout,
+                "dtype": self.kv_dtype,
+                "quantized": self.kv_quant,
+                "bytes_per_token": int(per_tok),
+                "allocated_bytes": allocated,
+                "slots_total": self.max_num_seqs,
+                "slots_in_use": sum(1 for s in self._slots if s is not None),
+            }
+            if self.kv_layout == "paged":
+                # host shadow lengths: exact for every bound lane, no sync
+                occupied = int(self._lengths.sum())
+                out["page_size"] = self._pcfg.page_size
+                out["pages_total"] = self._pcfg.num_pages - 1  # page 0 = trash
+                out["pages_free"] = self._page_alloc.free_pages
+            else:
+                occupied = sum(
+                    len(s.prompt_token_ids) + len(s.token_ids) for s in self._slots if s is not None
+                )
+            out["occupied_tokens"] = occupied
+            out["occupied_bytes"] = occupied * int(per_tok)
+            return out
+
     def _mesh_shardings(self, mesh):
         """Tensor-parallel serving (reference capability: the vLLM engine's
         tensor_parallel_size, llm/_internal/serve/engines/vllm/
@@ -518,6 +568,10 @@ class LLMEngine:
             cache_sh = {"k": kv_s, "v": kv_s}
         else:
             cache_sh = {"k": kv_s, "v": kv_s, "length": NamedSharding(mesh, P())}
+        if getattr(self, "kv_quant", False):
+            # scale tensors put kv_heads at axis 2 ([L,B,kv,S] / [L,P,kv,page])
+            sc_s = NamedSharding(mesh, P(None, None, tp, None))
+            cache_sh["k_scale"] = cache_sh["v_scale"] = sc_s
         return param_sh, cache_sh
 
     # ------------------------------------------------------------- admission
@@ -971,18 +1025,29 @@ class LLMEngine:
             v_pad = np.zeros_like(k_pad)
             k_pad[:, : kn.shape[1]] = kn
             v_pad[:, : vn.shape[1]] = vn
+            scales = ()
+            if kv.get("k_scale") is not None:  # int8 payload: pad the wire
+                # scales ([L, kv, T]) to the same page multiple
+                ks_w, vs_w = kv["k_scale"], kv["v_scale"]
+                ks_pad = np.zeros(ks_w.shape[:2] + (T_pad,), np.float32)
+                vs_pad = np.zeros_like(ks_pad)
+                ks_pad[..., : ks_w.shape[2]] = ks_w
+                vs_pad[..., : vs_w.shape[2]] = vs_w
+                scales = (jnp.asarray(ks_pad), jnp.asarray(vs_pad))
             if self._device_resident:
                 # ONE fused scatter-in (llm/disagg/scatter.py): pool pages
                 # + device table row + device length lane in a single
                 # program — the handoff admission hot path
                 self.pool, self._dtables, self._dlengths = self._scatter_paged(
                     self.pool, self._dtables, self._dlengths, np.int32(slot),
-                    table_row, jnp.asarray(k_pad), jnp.asarray(v_pad), np.int32(n_real),
+                    table_row, jnp.asarray(k_pad), jnp.asarray(v_pad), np.int32(n_real), *scales,
                 )
                 self._lengths[slot] = n_real
                 self._bind_slot(st, slot, jnp.asarray(kv["logits"])[None])
                 return
-            self.pool = self._insert(self.pool, table_row[: T_pad // page], jnp.asarray(k_pad), jnp.asarray(v_pad))
+            self.pool = self._insert(
+                self.pool, table_row[: T_pad // page], jnp.asarray(k_pad), jnp.asarray(v_pad), *scales
+            )
             logits = jnp.asarray(kv["logits"])[None]
             self._lengths[slot] = n_real
         else:
@@ -1015,16 +1080,21 @@ class LLMEngine:
         if st.prefilled is not None:
             # disaggregated admission: KV arrived from a prefill engine.
             # Device-resident mode scatters through the audited disagg
-            # program; the sync oracle keeps the legacy insert.
+            # program; the sync oracle keeps the legacy insert. An int8
+            # payload carries its wire-layout scales; producer/consumer
+            # dtype mismatches requant transparently inside the program.
             kv = st.prefilled
             st.prefilled = None
+            k_sc, v_sc = kv.get("k_scale"), kv.get("v_scale")
+            scales = (jnp.asarray(k_sc), jnp.asarray(v_sc)) if k_sc is not None else ()
             if self._device_resident:
                 self.cache = self._scatter_slots(
-                    self.cache, np.int32(slot), jnp.asarray(kv["k"]), jnp.asarray(kv["v"]), np.int32(int(kv["n"]))
+                    self.cache, np.int32(slot), jnp.asarray(kv["k"]), jnp.asarray(kv["v"]),
+                    np.int32(int(kv["n"])), *scales,
                 )
             else:
                 self.cache = self._insert(
-                    self.cache, slot, jnp.asarray(kv["k"]), jnp.asarray(kv["v"]), int(kv["n"])
+                    self.cache, slot, jnp.asarray(kv["k"]), jnp.asarray(kv["v"]), int(kv["n"]), *scales
                 )
             logits = jnp.asarray(kv["logits"])[None]
         else:
@@ -1104,7 +1174,9 @@ class LLMEngine:
         gather), stash the handoff payload, free the slot/pages. The
         block ships at the prompt's prefill-bucket width; the tail past
         the real length is garbage the decode side masks by length (the
-        same contract as prefill's own padding)."""
+        same contract as prefill's own padding). An int8 producer ships
+        int8 values + per-head scales ([L, kv, T] wire layout) — ~half
+        the object-plane bytes of a bf16 block."""
         import jax.numpy as jnp
 
         prompt = st.prompt_token_ids
@@ -1113,16 +1185,20 @@ class LLMEngine:
         if self.kv_layout == "paged":
             page = self._pcfg.page_size
             row = np.asarray(self._tables[slot][: T // page], np.int32)
-            k_blk, v_blk = self._extract_paged(self.pool, jnp.asarray(row))
+            out = self._extract_paged(self.pool, jnp.asarray(row))
         else:
-            k_blk, v_blk = self._extract_slots(self.cache, np.int32(slot), T)
-        self._handoffs[st.request_id] = {
-            "k": np.asarray(k_blk),
-            "v": np.asarray(v_blk),
+            out = self._extract_slots(self.cache, np.int32(slot), T)
+        payload = {
+            "k": np.asarray(out[0]),
+            "v": np.asarray(out[1]),
             "n": n,
             "logits": np.asarray(logits[0], np.float32),
             "prompt_token_ids": list(prompt),
         }
+        if len(out) == 4:
+            payload["k_scale"] = np.asarray(out[2])
+            payload["v_scale"] = np.asarray(out[3])
+        self._handoffs[st.request_id] = payload
         self._finish(st, "handoff")
 
     def _spec_admit(self, st: RequestState, slot: int, hist_tokens: list):

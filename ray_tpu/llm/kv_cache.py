@@ -25,6 +25,17 @@ single tunneled chip, so extra slots are nearly free throughput: 8 slots
 32K = 11 GiB + activations) is where block paging or prefix sharing
 becomes necessary rather than merely nice — the quantified threshold the
 earlier qualitative claim needed.
+
+Int8 cache (``dtype="int8"``, llm/kv_quant.py) moves that threshold by
+``2*hd/(hd+4)``: per token per layer the cache stores ``2*kv*(hd + 4)``
+bytes (int8 values + one f32 per-head scale) instead of ``2*kv*hd*2``
+bf16 bytes — 1.94x fewer at hd=128. The 11 GiB 32x32K working set above
+drops to ~5.7 GiB, so the same ~13.8 GiB budget that capped bf16 at 32
+slots x 8K holds int8 at 32 slots to ~16K or ~62 slots at 8K — and since
+decode is HBM-bandwidth-bound, the bytes each step streams shrink by the
+same factor. Quantization happens on append inside the fused step;
+attention dequantizes on read (scale layout [L, B, kv, S]: position axis
+last, so scale tiles waste nothing — see kv_quant.py).
 """
 
 from __future__ import annotations
@@ -34,6 +45,8 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.llm.kv_quant import dequantize, is_int8, quantize_heads
+
 
 @dataclass(frozen=True)
 class CacheConfig:
@@ -42,11 +55,22 @@ class CacheConfig:
     max_seq_len: int
     num_kv_heads: int
     head_dim: int
-    dtype: str = "bfloat16"
+    dtype: str = "bfloat16"  # bf16/f32 variants, or "int8" (kv_quant.py)
 
 
 def alloc(cfg: CacheConfig) -> dict:
     shape = (cfg.num_layers, cfg.num_slots, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim)
+    if is_int8(cfg.dtype):
+        # per-head scales with the position axis LAST ([L, B, kv, S]) so
+        # the trailing dims stay on (8,128) tile multiples (kv_quant.py)
+        sshape = (cfg.num_layers, cfg.num_slots, cfg.num_kv_heads, cfg.max_seq_len)
+        return {
+            "k": jnp.zeros(shape, dtype=jnp.int8),
+            "v": jnp.zeros(shape, dtype=jnp.int8),
+            "k_scale": jnp.zeros(sshape, dtype=jnp.float32),
+            "v_scale": jnp.zeros(sshape, dtype=jnp.float32),
+            "length": jnp.zeros((cfg.num_slots,), dtype=jnp.int32),
+        }
     dt = jnp.dtype(cfg.dtype)
     return {
         "k": jnp.zeros(shape, dtype=dt),
@@ -55,18 +79,39 @@ def alloc(cfg: CacheConfig) -> dict:
     }
 
 
-def insert_sequence(cache: dict, slot, k_new, v_new, length):
+def insert_sequence(cache: dict, slot, k_new, v_new, length, k_scale=None, v_scale=None):
     """Write a prefilled sequence into `slot` at offset 0.
 
     k_new/v_new: [L, T_pad, kv_heads, head_dim] (padded tail is garbage and
     stays masked by `length`). slot/length: traced scalars — one compiled
     program serves every slot and every prefill bucket.
+
+    Dtype adaptation is transparent in all four directions: fp block into
+    an int8 cache quantizes here (prefill writes quantized blocks); an
+    int8 block (+ ``k_scale``/``v_scale`` [L, kv, T_pad], the handoff wire
+    layout) into an int8 cache copies bytes; int8 into an fp cache
+    dequantizes; fp into fp is the original path.
     """
     zero = jnp.zeros((), dtype=jnp.int32)
     start = (zero, jnp.asarray(slot, jnp.int32), zero, zero, zero)
+    quant = "k_scale" in cache
+    if not quant and k_scale is not None:  # int8 block -> fp cache
+        k_new = dequantize(k_new, k_scale.transpose(0, 2, 1))
+        v_new = dequantize(v_new, v_scale.transpose(0, 2, 1))
+        k_scale = v_scale = None
+    if quant:
+        if k_scale is None:  # fp block -> quantize on insert
+            k_new, sk = quantize_heads(k_new)  # sk: [L, T, kv]
+            v_new, sv = quantize_heads(v_new)
+            k_scale, v_scale = sk.transpose(0, 2, 1), sv.transpose(0, 2, 1)
+        s_start = (zero, jnp.asarray(slot, jnp.int32), zero, zero)
+        k_sc = jax.lax.dynamic_update_slice(cache["k_scale"], k_scale[:, None].astype(jnp.float32), s_start)
+        v_sc = jax.lax.dynamic_update_slice(cache["v_scale"], v_scale[:, None].astype(jnp.float32), s_start)
     k = jax.lax.dynamic_update_slice(cache["k"], k_new[:, None].astype(cache["k"].dtype), start)
     v = jax.lax.dynamic_update_slice(cache["v"], v_new[:, None].astype(cache["v"].dtype), start)
     lens = cache["length"].at[slot].set(jnp.asarray(length, jnp.int32))
+    if quant:
+        return {"k": k, "v": v, "k_scale": k_sc, "v_scale": v_sc, "length": lens}
     return {"k": k, "v": v, "length": lens}
 
 
@@ -88,20 +133,42 @@ def append_token_layer(k_layer, v_layer, k_t, v_t, lengths):
     return k, v
 
 
+def append_scale_layer(scale_layer, s_t, lengths):
+    """Per-slot scale append companion to append_token_layer.
+
+    scale_layer: [slots, kv, S] (position axis last); s_t: [slots, kv];
+    lengths: [slots] write positions.
+    """
+
+    def _upd(sc_b, s_b, pos):
+        return jax.lax.dynamic_update_slice(sc_b, s_b[:, None], (jnp.zeros((), jnp.int32), pos))
+
+    return jax.vmap(_upd)(scale_layer, s_t, lengths)
+
+
 def extract_sequence(cache: dict, slot, T: int):
     """Read one slot's first ``T`` cached positions as a contiguous block.
 
     Inverse of insert_sequence: returns (k [L, T, kv, hd], v same) — the
-    disaggregated-prefill extract primitive (llm/disagg/). ``T`` is static
-    (one compiled program per prefill bucket, like insert); ``slot`` is a
-    traced scalar. Positions past the slot's real length are garbage the
-    consumer masks by length, exactly as prefill's padded tail."""
+    disaggregated-prefill extract primitive (llm/disagg/) — plus, for an
+    int8 cache, (k_scale [L, kv, T], v_scale same): the handoff wire
+    layout, so quantized blocks ship self-describing at ~half the bytes.
+    ``T`` is static (one compiled program per prefill bucket, like
+    insert); ``slot`` is a traced scalar. Positions past the slot's real
+    length are garbage the consumer masks by length, exactly as
+    prefill's padded tail."""
     zero = jnp.zeros((), dtype=jnp.int32)
     start = (zero, jnp.asarray(slot, jnp.int32), zero, zero, zero)
     L, _, _, kv, hd = cache["k"].shape
     size = (L, 1, T, kv, hd)
     k = jax.lax.dynamic_slice(cache["k"], start, size)[:, 0]
     v = jax.lax.dynamic_slice(cache["v"], start, size)[:, 0]
+    if "k_scale" in cache:
+        s_start = (zero, jnp.asarray(slot, jnp.int32), zero, zero)
+        s_size = (L, 1, kv, T)
+        k_sc = jax.lax.dynamic_slice(cache["k_scale"], s_start, s_size)[:, 0]
+        v_sc = jax.lax.dynamic_slice(cache["v_scale"], s_start, s_size)[:, 0]
+        return k, v, k_sc, v_sc
     return k, v
 
 

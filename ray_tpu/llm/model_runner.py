@@ -62,6 +62,20 @@ def _sds_pool(cfg: LlamaConfig, pages: int, page: int):
     return {"k": kv, "v": kv}
 
 
+def _sds_cache_q(cfg: LlamaConfig, B: int, S: int):
+    """Int8-cache bucket twin of _sds_cache: int8 values + f32 per-head
+    scales with the position axis last (the kv_quant.py tile layout)."""
+    kv = _sds((cfg.num_layers, B, S, cfg.num_kv_heads, cfg.hd), jnp.int8)
+    sc = _sds((cfg.num_layers, B, cfg.num_kv_heads, S), jnp.float32)
+    return {"k": kv, "v": kv, "k_scale": sc, "v_scale": sc, "length": _sds((B,), jnp.int32)}
+
+
+def _sds_pool_q(cfg: LlamaConfig, pages: int, page: int):
+    kv = _sds((cfg.num_layers, pages, page, cfg.num_kv_heads, cfg.hd), jnp.int8)
+    sc = _sds((cfg.num_layers, pages, cfg.num_kv_heads, page), jnp.float32)
+    return {"k": kv, "v": kv, "k_scale": sc, "v_scale": sc}
+
+
 def _sds_lanes(B: int):
     """(tokens, keys, temps, top_k, top_p) slot lanes."""
     return (
@@ -92,6 +106,22 @@ def _bucket_paged_fused(B=8, pages=64, page=16):
     tokens, keys, temps, top_k, top_p = _sds_lanes(B)
     return (
         _sds_params(cfg), _sds_pool(cfg, pages, page), tables, lengths,
+        tokens, keys, temps, top_k, top_p, cfg,
+    ), {}
+
+
+def _bucket_fused_q(B=8, S=256):
+    cfg = _trace_cfg()
+    return (_sds_params(cfg), _sds_cache_q(cfg, B, S)) + _sds_lanes(B) + (cfg,), {}
+
+
+def _bucket_paged_fused_q(B=8, pages=64, page=16):
+    cfg = _trace_cfg()
+    tables = _sds((B, pages // B * 2), jnp.int32)
+    lengths = _sds((B,), jnp.int32)
+    tokens, keys, temps, top_k, top_p = _sds_lanes(B)
+    return (
+        _sds_params(cfg), _sds_pool_q(cfg, pages, page), tables, lengths,
         tokens, keys, temps, top_k, top_p, cfg,
     ), {}
 
@@ -175,6 +205,11 @@ def decode_step(params, cache, tokens, cfg: LlamaConfig):
     new cache). The new token is written at position cache.length[b] and
     attends to positions 0..length[b] inclusive.
 
+    An int8 cache (k_scale/v_scale present) quantizes the appended token
+    INSIDE this program and dequantizes the row for attention at the f32
+    compute dtype the score/value einsums already use (kv_quant.py) —
+    same program count, roughly half the cache bytes streamed.
+
     CONTRACT: the speculative draft scan (llm/spec/drafter.py
     draft_steps) chains this k+1 times inside one program with an
     overridden length lane — masking must stay a pure function of the
@@ -184,6 +219,7 @@ def decode_step(params, cache, tokens, cfg: LlamaConfig):
     B = tokens.shape[0]
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     rep = nh // nkv
+    quant = "k_scale" in cache
     lengths = cache["length"]
     cos, sin = rotary_embedding(lengths[:, None], cfg.hd, cfg.rope_theta)  # [B, 1, hd/2]
     x = jnp.take(params["embed"], tokens[:, None], axis=0)  # [B, 1, H]
@@ -192,32 +228,54 @@ def decode_step(params, cache, tokens, cfg: LlamaConfig):
     attn_ok = (jnp.arange(S, dtype=jnp.int32)[None, :] <= lengths[:, None])[:, None, None]  # [B,1,1,S]
 
     def layer_fn(x, xs):
-        layer, k_cache, v_cache = xs  # k/v_cache: [B, S, nkv, hd]
+        from ray_tpu.llm.kv_cache import append_scale_layer, append_token_layer
+        from ray_tpu.llm.kv_quant import quantize_heads
+
+        if quant:
+            layer, k_cache, v_cache, k_sc, v_sc = xs  # scales: [B, nkv, S]
+        else:
+            layer, k_cache, v_cache = xs  # k/v_cache: [B, S, nkv, hd]
         xn = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
         q, k_t, v_t = _qkv(xn, layer, cfg)  # q: [B,1,nh,hd]
         qh = apply_rope(q.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)  # [B,1,nh,hd]
         kh = apply_rope(k_t.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)
-        from ray_tpu.llm.kv_cache import append_token_layer
 
         write_pos = jnp.minimum(lengths, S - 1)
-        k_cache, v_cache = append_token_layer(k_cache, v_cache, kh[:, 0], v_t[:, 0], write_pos)
+        k_tok, v_tok = kh[:, 0], v_t[:, 0]
+        if quant:
+            k_tok, sk = quantize_heads(k_tok)  # [B, kv, hd] i8, [B, kv] f32
+            v_tok, sv = quantize_heads(v_tok)
+            k_sc = append_scale_layer(k_sc, sk, write_pos)
+            v_sc = append_scale_layer(v_sc, sv, write_pos)
+        k_cache, v_cache = append_token_layer(k_cache, v_cache, k_tok, v_tok, write_pos)
         # GQA attention against the cache: head h uses kv head h // rep
         qg = qh[:, 0].reshape(B, nkv, rep, hd)
         kc = k_cache.transpose(0, 2, 1, 3)  # [B,nkv,S,hd]
         vc = v_cache.transpose(0, 2, 1, 3)
+        if quant:
+            kc = kc.astype(jnp.float32) * k_sc[..., None]
+            vc = vc.astype(jnp.float32) * v_sc[..., None]
         scores = jnp.einsum("bgrh,bgsh->bgrs", qg, kc, preferred_element_type=jnp.float32) / jnp.sqrt(hd)
         scores = jnp.where(attn_ok, scores, -jnp.inf)  # [B,1,1,S] bcast
         probs = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bgrs,bgsh->bgrh", probs, vc.astype(jnp.float32)).reshape(B, 1, nh * hd).astype(x.dtype)
         x = x + jnp.dot(o, layer["wo"])
         x = _mlp(x, layer, cfg)
-        return x, (k_cache, v_cache)
+        return x, ((k_cache, v_cache, k_sc, v_sc) if quant else (k_cache, v_cache))
 
-    x, (ks, vs) = jax.lax.scan(layer_fn, x, (params["layers"], cache["k"], cache["v"]))
+    xs = (params["layers"], cache["k"], cache["v"])
+    if quant:
+        xs += (cache["k_scale"], cache["v_scale"])
+    x, ys = jax.lax.scan(layer_fn, x, xs)
     x = rms_norm(x[:, 0], params["final_norm"], cfg.rms_eps)
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
     logits = jnp.dot(x, unembed, preferred_element_type=jnp.float32)
-    new_cache = {"k": ks, "v": vs, "length": lengths + 1}
+    if quant:
+        ks, vs, kscs, vscs = ys
+        new_cache = {"k": ks, "v": vs, "k_scale": kscs, "v_scale": vscs, "length": lengths + 1}
+    else:
+        ks, vs = ys
+        new_cache = {"k": ks, "v": vs, "length": lengths + 1}
     return logits, new_cache
 
 
@@ -240,6 +298,7 @@ def extend(params, cache, slot, tokens, length, cfg: LlamaConfig):
     T = tokens.shape[0]
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     rep = nh // nkv
+    quant = "k_scale" in cache
     S = cache["k"].shape[2]
     slot = jnp.asarray(slot, jnp.int32)
     start = cache["length"][slot]
@@ -252,16 +311,30 @@ def extend(params, cache, slot, tokens, length, cfg: LlamaConfig):
     zero = jnp.zeros((), jnp.int32)
 
     def layer_fn(x, xs):
-        layer, k_row, v_row = xs  # [S, nkv, hd] for this slot
+        from ray_tpu.llm.kv_quant import quantize_heads
+
+        if quant:
+            layer, k_row, v_row, k_sc, v_sc = xs  # scales: [nkv, S]
+        else:
+            layer, k_row, v_row = xs  # [S, nkv, hd] for this slot
         xn = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
         q, k_t, v_t = _qkv(xn, layer, cfg)  # [1, T, nh/nkv, hd]
         qh = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)  # [1, nh, T, hd]
         kh = apply_rope(k_t.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)  # [1, T, nkv, hd]
-        k_row = jax.lax.dynamic_update_slice(k_row, kh[0].astype(k_row.dtype), (start, zero, zero))
-        v_row = jax.lax.dynamic_update_slice(v_row, v_t[0].astype(v_row.dtype), (start, zero, zero))
+        k_suf, v_suf = kh[0], v_t[0]  # [T, nkv, hd]
+        if quant:
+            k_suf, sk = quantize_heads(k_suf)  # sk: [T, nkv]
+            v_suf, sv = quantize_heads(v_suf)
+            k_sc = jax.lax.dynamic_update_slice(k_sc, sk.T, (zero, start))
+            v_sc = jax.lax.dynamic_update_slice(v_sc, sv.T, (zero, start))
+        k_row = jax.lax.dynamic_update_slice(k_row, k_suf.astype(k_row.dtype), (start, zero, zero))
+        v_row = jax.lax.dynamic_update_slice(v_row, v_suf.astype(v_row.dtype), (start, zero, zero))
         qg = qh[0].reshape(nkv, rep, T, hd)
         kc = k_row.transpose(1, 0, 2)  # [nkv, S, hd]
         vc = v_row.transpose(1, 0, 2)
+        if quant:
+            kc = kc.astype(jnp.float32) * k_sc[..., None]
+            vc = vc.astype(jnp.float32) * v_sc[..., None]
         scores = jnp.einsum("grth,gsh->grts", qg, kc, preferred_element_type=jnp.float32) / jnp.sqrt(hd)
         scores = jnp.where(attn_ok[0], scores, -jnp.inf)  # [nkv, rep, T, S] vs [1, T, S]
         probs = jax.nn.softmax(scores, axis=-1)
@@ -269,18 +342,27 @@ def extend(params, cache, slot, tokens, length, cfg: LlamaConfig):
         o = o.transpose(2, 0, 1, 3).reshape(1, T, nh * hd).astype(x.dtype)
         x = x + jnp.dot(o, layer["wo"])
         x = _mlp(x, layer, cfg)
-        return x, (k_row, v_row)
+        return x, ((k_row, v_row, k_sc, v_sc) if quant else (k_row, v_row))
 
-    k_rows = cache["k"][:, slot]  # [L, S, nkv, hd]
-    v_rows = cache["v"][:, slot]
-    x, (k_new, v_new) = jax.lax.scan(layer_fn, x, (params["layers"], k_rows, v_rows))
+    xs = (params["layers"], cache["k"][:, slot], cache["v"][:, slot])  # [L, S, nkv, hd]
+    if quant:
+        xs += (cache["k_scale"][:, slot], cache["v_scale"][:, slot])  # [L, nkv, S]
+    x, ys = jax.lax.scan(layer_fn, x, xs)
     x = rms_norm(x[0], params["final_norm"], cfg.rms_eps)  # [T, H]
     x_last = x[jnp.maximum(length - 1, 0)]
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
     logits = jnp.dot(x_last, unembed, preferred_element_type=jnp.float32)
+    if quant:
+        k_new, v_new, ksc_new, vsc_new = ys
+    else:
+        k_new, v_new = ys
     k = jax.lax.dynamic_update_slice(cache["k"], k_new[:, None], (zero, slot, zero, zero, zero))
     v = jax.lax.dynamic_update_slice(cache["v"], v_new[:, None], (zero, slot, zero, zero, zero))
     lens = cache["length"].at[slot].set(start + length)
+    if quant:
+        ksc = jax.lax.dynamic_update_slice(cache["k_scale"], ksc_new[:, None], (zero, slot, zero, zero))
+        vsc = jax.lax.dynamic_update_slice(cache["v_scale"], vsc_new[:, None], (zero, slot, zero, zero))
+        return logits, {"k": k, "v": v, "k_scale": ksc, "v_scale": vsc, "length": lens}
     return logits, {"k": k, "v": v, "length": lens}
 
 
@@ -299,6 +381,7 @@ def decode_attn_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig):
     B = tokens.shape[0]
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     rep = nh // nkv
+    quant = "k_scale" in pool
     cos, sin = rotary_embedding(lengths[:, None], cfg.hd, cfg.rope_theta)
     x = jnp.take(params["embed"], tokens[:, None], axis=0)  # [B, 1, H]
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
@@ -306,19 +389,27 @@ def decode_attn_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig):
     from ray_tpu.llm.paged_kv import _paged_attn_batch
 
     def layer_fn(x, xs):
-        layer, k_pool_l, v_pool_l = xs  # [P, page, kv, hd]
+        if quant:
+            layer, k_pool_l, v_pool_l, k_sc_l, v_sc_l = xs  # scales: [P, kv, page]
+        else:
+            layer, k_pool_l, v_pool_l = xs  # [P, page, kv, hd]
+            k_sc_l = v_sc_l = None
         xn = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
         q, k_t, v_t = _qkv(xn, layer, cfg)  # [B, 1, nh/nkv, hd]
         qh = apply_rope(q.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)
         kh = apply_rope(k_t.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)
         qg = qh[:, 0].reshape(B, nkv, rep, hd)
-        o = _paged_attn_batch(qg, k_pool_l, v_pool_l, tables, lengths, scale, k_self=kh[:, 0], v_self=v_t[:, 0])
+        o = _paged_attn_batch(qg, k_pool_l, v_pool_l, tables, lengths, scale, k_self=kh[:, 0], v_self=v_t[:, 0],
+                              k_scale_l=k_sc_l, v_scale_l=v_sc_l)
         o = o.reshape(B, 1, nh * hd).astype(x.dtype)
         x = x + jnp.dot(o, layer["wo"])
         x = _mlp(x, layer, cfg)
         return x, (kh[:, 0], v_t[:, 0])
 
-    x, (k_new, v_new) = jax.lax.scan(layer_fn, x, (params["layers"], pool["k"], pool["v"]))
+    xs = (params["layers"], pool["k"], pool["v"])
+    if quant:
+        xs += (pool["k_scale"], pool["v_scale"])
+    x, (k_new, v_new) = jax.lax.scan(layer_fn, x, xs)
     x = rms_norm(x[:, 0], params["final_norm"], cfg.rms_eps)
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
     logits = jnp.dot(x, unembed, preferred_element_type=jnp.float32)
@@ -327,7 +418,22 @@ def decode_attn_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig):
 
 def append_paged(pool, write_page, write_off, k_new, v_new):
     """Scatter-only half of the paged decode step: write each slot's new
-    token K/V at (write_page[b], write_off[b]) for every layer."""
+    token K/V at (write_page[b], write_off[b]) for every layer. An int8
+    pool quantizes here — the append program IS the quantizer, so the
+    attention half stays read-only and the aliasing split holds."""
+    if "k_scale" in pool:
+        from ray_tpu.llm.kv_quant import quantize_heads
+
+        k_new, sk = quantize_heads(k_new)  # [L, B, kv, hd] i8, [L, B, kv] f32
+        v_new, sv = quantize_heads(v_new)
+        return {
+            "k": pool["k"].at[:, write_page, write_off].set(k_new),
+            "v": pool["v"].at[:, write_page, write_off].set(v_new),
+            # scale layout [L, P, kv, page]: advanced indices split by the
+            # kv slice, so the indexed result is [B, L, kv]
+            "k_scale": pool["k_scale"].at[:, write_page, :, write_off].set(sk.transpose(1, 0, 2)),
+            "v_scale": pool["v_scale"].at[:, write_page, :, write_off].set(sv.transpose(1, 0, 2)),
+        }
     return {
         "k": pool["k"].at[:, write_page, write_off].set(k_new.astype(pool["k"].dtype)),
         "v": pool["v"].at[:, write_page, write_off].set(v_new.astype(pool["v"].dtype)),
@@ -369,6 +475,7 @@ def extend_attn_paged(params, pool, table_row, start, tokens, length, cfg: Llama
     T = tokens.shape[0]
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     rep = nh // nkv
+    quant = "k_scale" in pool
     start = jnp.asarray(start, jnp.int32)
     positions = start + jnp.arange(T, dtype=jnp.int32)
     cos, sin = rotary_embedding(positions, cfg.hd, cfg.rope_theta)
@@ -378,19 +485,27 @@ def extend_attn_paged(params, pool, table_row, start, tokens, length, cfg: Llama
     from ray_tpu.llm.paged_kv import _paged_attn_seq
 
     def layer_fn(x, xs):
-        layer, k_pool_l, v_pool_l = xs
+        if quant:
+            layer, k_pool_l, v_pool_l, k_sc_l, v_sc_l = xs
+        else:
+            layer, k_pool_l, v_pool_l = xs
+            k_sc_l = v_sc_l = None
         xn = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
         q, k_t, v_t = _qkv(xn, layer, cfg)  # [1, T, nh/nkv, hd]
         qh = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)  # [1, nh, T, hd]
         kh = apply_rope(k_t.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)  # [1, T, nkv, hd]
         qg = qh[0].reshape(nkv, rep, T, hd)
-        o = _paged_attn_seq(qg, k_pool_l, v_pool_l, table_row, start, kh[0], v_t[0], scale)
+        o = _paged_attn_seq(qg, k_pool_l, v_pool_l, table_row, start, kh[0], v_t[0], scale,
+                            k_scale_l=k_sc_l, v_scale_l=v_sc_l)
         o = o.transpose(2, 0, 1, 3).reshape(1, T, nh * hd).astype(x.dtype)
         x = x + jnp.dot(o, layer["wo"])
         x = _mlp(x, layer, cfg)
         return x, (kh[0], v_t[0])
 
-    x, (k_chunk, v_chunk) = jax.lax.scan(layer_fn, x, (params["layers"], pool["k"], pool["v"]))
+    xs = (params["layers"], pool["k"], pool["v"])
+    if quant:
+        xs += (pool["k_scale"], pool["v_scale"])
+    x, (k_chunk, v_chunk) = jax.lax.scan(layer_fn, x, xs)
     x = rms_norm(x[0], params["final_norm"], cfg.rms_eps)  # [T, H]
     x_last = x[jnp.maximum(length - 1, 0)]
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
@@ -400,7 +515,19 @@ def extend_attn_paged(params, pool, table_row, start, tokens, length, cfg: Llama
 
 def append_chunk_paged(pool, write_page, write_off, k_chunk, v_chunk):
     """Scatter-only half of paged chunked-prefill: write the suffix K/V
-    rows (write_page/write_off: [T]) for every layer."""
+    rows (write_page/write_off: [T]) for every layer. An int8 pool
+    quantizes here, exactly as append_paged does for decode."""
+    if "k_scale" in pool:
+        from ray_tpu.llm.kv_quant import quantize_heads
+
+        k_chunk, sk = quantize_heads(k_chunk)  # [L, T, kv, hd] i8, [L, T, kv] f32
+        v_chunk, sv = quantize_heads(v_chunk)
+        return {
+            "k": pool["k"].at[:, write_page, write_off].set(k_chunk),
+            "v": pool["v"].at[:, write_page, write_off].set(v_chunk),
+            "k_scale": pool["k_scale"].at[:, write_page, :, write_off].set(sk.transpose(1, 0, 2)),
+            "v_scale": pool["v_scale"].at[:, write_page, :, write_off].set(sv.transpose(1, 0, 2)),
+        }
     return {
         "k": pool["k"].at[:, write_page, write_off].set(k_chunk.astype(pool["k"].dtype)),
         "v": pool["v"].at[:, write_page, write_off].set(v_chunk.astype(pool["v"].dtype)),
@@ -451,6 +578,21 @@ def fused_step(
     return cache, toks, logps, new_keys, temps, top_k, top_p
 
 
+# int8-cache variant of the SAME program (quantize-on-append inside
+# decode_step, dequantize-in-attention): its own registry entry so the
+# donation audit and the JXC003 bf16->f32-before-dot trap are checked on
+# the quantized hot path too (the dequant is an int8->f32 convert feeding
+# the attention einsums at their existing compute dtype, and must never
+# drift onto the flops-dominant dots — regression-locked in
+# tests/test_lint_rules.py).
+jaxcheck.entry(
+    name="llm.fused_step_int8",
+    shapes={"b8_s256": _bucket_fused_q},
+    donate=("cache", "keys", "temps", "top_k", "top_p"),
+    donate_bytes=0,
+)(fused_step)
+
+
 def make_fused_fns(cfg: LlamaConfig):
     """Jit of fused_step with the production donation set."""
     return jax.jit(partial(fused_step, cfg=cfg), donate_argnums=(1, 3, 4, 5, 6))
@@ -485,6 +627,16 @@ def paged_fused_step(
     logits, k_new, v_new = decode_attn_paged(params, pool, tables, lengths, tokens, cfg)
     toks, logps, new_keys = sample(logits, keys, temps, top_k, top_p)
     return toks, logps, new_keys, k_new, v_new, write_page, write_off, lengths + 1, temps, top_k, top_p
+
+
+# int8-pool variant (see llm.fused_step_int8's rationale); the pool stays
+# undonated/read-only here — the append program is the quantizer
+jaxcheck.entry(
+    name="llm.paged_fused_step_int8",
+    shapes={"b8_p64": _bucket_paged_fused_q},
+    donate=("lengths", "keys", "temps", "top_k", "top_p"),
+    donate_bytes=0,
+)(paged_fused_step)
 
 
 def make_fused_paged_fns(cfg: LlamaConfig):

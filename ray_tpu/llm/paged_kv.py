@@ -32,6 +32,8 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.llm.kv_quant import dequantize, is_int8, quantize_heads
+
 _NEG = -1e30  # -inf surrogate: keeps exp() NaN-free for fully-masked pages
 
 
@@ -44,7 +46,7 @@ class PagedCacheConfig:
     num_slots: int
     num_kv_heads: int
     head_dim: int
-    dtype: str = "bfloat16"
+    dtype: str = "bfloat16"  # bf16/f32 variants, or "int8" (kv_quant.py)
 
     @property
     def max_seq_len(self) -> int:
@@ -53,6 +55,16 @@ class PagedCacheConfig:
 
 def alloc(cfg: PagedCacheConfig) -> dict:
     shape = (cfg.num_layers, cfg.num_pages, cfg.page_size, cfg.num_kv_heads, cfg.head_dim)
+    if is_int8(cfg.dtype):
+        # per-head scales, position axis last ([L, P, kv, page]) — the
+        # same tile rationale as the slot layout (kv_quant.py)
+        sshape = (cfg.num_layers, cfg.num_pages, cfg.num_kv_heads, cfg.page_size)
+        return {
+            "k": jnp.zeros(shape, dtype=jnp.int8),
+            "v": jnp.zeros(shape, dtype=jnp.int8),
+            "k_scale": jnp.zeros(sshape, dtype=jnp.float32),
+            "v_scale": jnp.zeros(sshape, dtype=jnp.float32),
+        }
     dt = jnp.dtype(cfg.dtype)
     return {"k": jnp.zeros(shape, dtype=dt), "v": jnp.zeros(shape, dtype=dt)}
 
@@ -87,21 +99,41 @@ class PageAllocator:
 # ---------------------------------------------------------------------------
 # jitted pool ops
 # ---------------------------------------------------------------------------
-def insert_pages(pool: dict, page_ids, k_new, v_new) -> dict:
+def insert_pages(pool: dict, page_ids, k_new, v_new, k_scale=None, v_scale=None) -> dict:
     """Write a prefilled sequence's K/V into its pages.
 
     k_new/v_new: [L, T_pad, kv, hd] with T_pad == len(page_ids)*page_size
     (host pads); page_ids: [n_pg] int32 (padding entries = 0 -> trash).
+
+    Same four-way dtype adaptation as kv_cache.insert_sequence: an fp
+    block quantizes into an int8 pool, an int8 block (+ scales in the
+    [L, kv, T_pad] wire layout) copies bytes, int8 into fp dequantizes.
     """
     L, T, kvh, hd = k_new.shape
     npg = page_ids.shape[0]
     page = pool["k"].shape[2]
+    quant = "k_scale" in pool
+    if not quant and k_scale is not None:  # int8 block -> fp pool
+        k_new = dequantize(k_new, k_scale.transpose(0, 2, 1))
+        v_new = dequantize(v_new, v_scale.transpose(0, 2, 1))
+        k_scale = v_scale = None
+    if quant and k_scale is None:  # fp block -> quantize on insert
+        k_new, sk = quantize_heads(k_new)  # sk: [L, T, kv]
+        v_new, sv = quantize_heads(v_new)
+        k_scale, v_scale = sk.transpose(0, 2, 1), sv.transpose(0, 2, 1)
     kr = k_new.reshape(L, npg, page, kvh, hd).astype(pool["k"].dtype)
     vr = v_new.reshape(L, npg, page, kvh, hd).astype(pool["v"].dtype)
-    return {
+    out = {
         "k": pool["k"].at[:, page_ids].set(kr),
         "v": pool["v"].at[:, page_ids].set(vr),
     }
+    if quant:
+        # wire layout [L, kv, T] -> page-major [L, npg, kv, page]
+        sr_k = k_scale.reshape(L, kvh, npg, page).transpose(0, 2, 1, 3).astype(jnp.float32)
+        sr_v = v_scale.reshape(L, kvh, npg, page).transpose(0, 2, 1, 3).astype(jnp.float32)
+        out["k_scale"] = pool["k_scale"].at[:, page_ids].set(sr_k)
+        out["v_scale"] = pool["v_scale"].at[:, page_ids].set(sr_v)
+    return out
 
 
 def gather_pages(pool: dict, page_ids):
@@ -111,13 +143,19 @@ def gather_pages(pool: dict, page_ids):
     entries point at the trash page and yield garbage the consumer masks
     by length). Returns (k [L, n_pg*page, kv, hd], v same) — the
     disaggregated-prefill extract primitive for the paged layout
-    (llm/disagg/). Read-only over the pool: safe to run in the same
-    program as other gathers, never fused with a pool scatter (the
-    documented aliasing hazard)."""
+    (llm/disagg/) — plus (k_scale [L, kv, n_pg*page], v_scale same) for
+    an int8 pool, the handoff wire layout. Read-only over the pool: safe
+    to run in the same program as other gathers, never fused with a pool
+    scatter (the documented aliasing hazard)."""
     L, _, page, kvh, hd = pool["k"].shape
     npg = page_ids.shape[0]
     k = pool["k"][:, page_ids].reshape(L, npg * page, kvh, hd)
     v = pool["v"][:, page_ids].reshape(L, npg * page, kvh, hd)
+    if "k_scale" in pool:
+        # [L, npg, kv, page] -> wire layout [L, kv, npg*page]
+        k_sc = pool["k_scale"][:, page_ids].transpose(0, 2, 1, 3).reshape(L, kvh, npg * page)
+        v_sc = pool["v_scale"][:, page_ids].transpose(0, 2, 1, 3).reshape(L, kvh, npg * page)
+        return k, v, k_sc, v_sc
     return k, v
 
 
@@ -129,7 +167,8 @@ def _combine(m1, l1, a1, m2, l2, a2):
     return m, l1 * x1 + l2 * x2, a1 * x1[..., None] + a2 * x2[..., None]
 
 
-def _paged_attn_batch(qg, pool_k_l, pool_v_l, table, lengths, scale, k_self=None, v_self=None):
+def _paged_attn_batch(qg, pool_k_l, pool_v_l, table, lengths, scale, k_self=None, v_self=None,
+                      k_scale_l=None, v_scale_l=None):
     """Online-softmax attention of one query token per slot over paged KV.
 
     qg: [B, nkv, rep, hd]; pool_*_l: [P, page, kv, hd] (one layer);
@@ -141,6 +180,10 @@ def _paged_attn_batch(qg, pool_k_l, pool_v_l, table, lengths, scale, k_self=None
     aliasing pattern XLA's CPU thunk executor was observed to mis-order
     (nondeterministic stale reads), and keeping the self term out of
     memory sidesteps it while also saving the round trip.
+
+    k_scale_l/v_scale_l ([P, kv, page], int8 pools only): gathered pages
+    dequantize at the f32 compute dtype this function already uses —
+    the convert stays off the flops-dominant dots (JXC003).
     Returns [B, nkv, rep, hd] float32.
     """
     B, nkv, rep, hd = qg.shape
@@ -153,6 +196,9 @@ def _paged_attn_batch(qg, pool_k_l, pool_v_l, table, lengths, scale, k_self=None
         pids = table[:, p]  # [B]
         kp = pool_k_l[pids].astype(jnp.float32)  # [B, page, kv, hd]
         vp = pool_v_l[pids].astype(jnp.float32)
+        if k_scale_l is not None:
+            kp = kp * k_scale_l[pids].transpose(0, 2, 1)[..., None]  # [B, page, kv, 1]
+            vp = vp * v_scale_l[pids].transpose(0, 2, 1)[..., None]
         s = jnp.einsum("bgrh,bpgh->bgrp", qf, kp)  # [B, nkv, rep, page]
         pos = p * page + jnp.arange(page, dtype=jnp.int32)  # [page]
         ok = pos[None, :] < lengths[:, None]  # [B, page] cached only
@@ -177,7 +223,8 @@ def _paged_attn_batch(qg, pool_k_l, pool_v_l, table, lengths, scale, k_self=None
     return acc / jnp.maximum(l, 1e-20)[..., None]
 
 
-def _paged_attn_seq(qg, pool_k_l, pool_v_l, table_row, start, k_chunk, v_chunk, scale):
+def _paged_attn_seq(qg, pool_k_l, pool_v_l, table_row, start, k_chunk, v_chunk, scale,
+                    k_scale_l=None, v_scale_l=None):
     """Online-softmax attention of T query tokens of ONE sequence: a
     cached PREFIX (positions 0..start-1, read from pages) plus the chunk's
     own K/V attended causally IN REGISTERS (the chunk was produced this
@@ -186,7 +233,9 @@ def _paged_attn_seq(qg, pool_k_l, pool_v_l, table_row, start, k_chunk, v_chunk, 
 
     qg: [nkv, rep, T, hd]; table_row: [max_pg] int32; start: [] int32;
     k_chunk/v_chunk: [T, kv, hd]. Query t (absolute position start+t)
-    attends prefix fully and chunk positions 0..t. Returns
+    attends prefix fully and chunk positions 0..t. k_scale_l/v_scale_l
+    ([P, kv, page], int8 pools only) dequantize the gathered prefix pages
+    at the f32 compute dtype; the in-register chunk stays fp. Returns
     [nkv, rep, T, hd] float32.
 
     CONTRACT: this function is also vmapped over lanes by the
@@ -204,6 +253,9 @@ def _paged_attn_seq(qg, pool_k_l, pool_v_l, table_row, start, k_chunk, v_chunk, 
         pid = table_row[p]
         kp = pool_k_l[pid].astype(jnp.float32)  # [page, kv, hd]
         vp = pool_v_l[pid].astype(jnp.float32)
+        if k_scale_l is not None:
+            kp = kp * k_scale_l[pid].transpose(1, 0)[..., None]  # [page, kv, 1]
+            vp = vp * v_scale_l[pid].transpose(1, 0)[..., None]
         s = jnp.einsum("grth,pgh->grtp", qf, kp)  # [nkv, rep, T, page]
         pos = p * page + jnp.arange(page, dtype=jnp.int32)
         ok = pos < start  # [page] prefix only, same bound for every query

@@ -40,9 +40,11 @@ from ray_tpu.llm.model_runner import (
     _qkv,
     _sds,
     _sds_cache,
+    _sds_cache_q,
     _sds_lanes,
     _sds_params,
     _sds_pool,
+    _sds_pool_q,
     _trace_cfg,
 )
 from ray_tpu.models.llama import LlamaConfig
@@ -135,10 +137,14 @@ def _forward_block_slots(params, cache, toks_blk, cfg: LlamaConfig):
     (per-position scatter, OOB dropped) and attention reads the updated
     row with mask j <= position — the functional-update idiom
     decode_step/fused_step already rely on (no pool-style aliasing
-    hazard in the slot layout). Returns (logits [B, T, V] f32, ks, vs)."""
+    hazard in the slot layout). An int8 cache quantizes the block's K/V
+    on the same scatter and dequantizes the row for attention, exactly
+    as decode_step does per token. Returns (logits [B, T, V] f32, ks,
+    vs) — plus (k_scales, v_scales) [L, B, kv, S] when quantized."""
     B, T = toks_blk.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     rep = nh // nkv
+    quant = "k_scale" in cache
     S = cache["k"].shape[2]
     lengths = cache["length"]
     positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
@@ -149,29 +155,48 @@ def _forward_block_slots(params, cache, toks_blk, cfg: LlamaConfig):
     attn_ok = (jnp.arange(S, dtype=jnp.int32)[None, None, :] <= positions[:, :, None])[:, None, None]  # [B,1,1,T,S]
 
     def layer_fn(x, xs):
-        layer, k_cache, v_cache = xs  # [B, S, kv, hd]
+        from ray_tpu.llm.kv_quant import quantize_heads
+
+        if quant:
+            layer, k_cache, v_cache, k_sc, v_sc = xs  # scales: [B, kv, S]
+        else:
+            layer, k_cache, v_cache = xs  # [B, S, kv, hd]
         xn = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
         q, k_t, v_t = _qkv(xn, layer, cfg)  # [B, T, nh/nkv, hd]
         qh = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)  # [B, nh, T, hd]
         kh = apply_rope(k_t.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)  # [B, T, nkv, hd]
-        k_cache = k_cache.at[rows, positions].set(kh.astype(k_cache.dtype), mode="drop")
-        v_cache = v_cache.at[rows, positions].set(v_t.astype(v_cache.dtype), mode="drop")
+        k_blk, v_blk = kh, v_t
+        if quant:
+            k_blk, sk = quantize_heads(k_blk)  # [B, T, kv] scales
+            v_blk, sv = quantize_heads(v_blk)
+            # mixed advanced/slice indexing puts the [B, T] index dims
+            # first: the indexed scale slots are [B, T, kv]
+            k_sc = k_sc.at[rows, :, positions].set(sk, mode="drop")
+            v_sc = v_sc.at[rows, :, positions].set(sv, mode="drop")
+        k_cache = k_cache.at[rows, positions].set(k_blk.astype(k_cache.dtype), mode="drop")
+        v_cache = v_cache.at[rows, positions].set(v_blk.astype(v_cache.dtype), mode="drop")
         qg = qh.reshape(B, nkv, rep, T, hd)
         kc = k_cache.transpose(0, 2, 1, 3)  # [B, nkv, S, hd]
         vc = v_cache.transpose(0, 2, 1, 3)
+        if quant:
+            kc = kc.astype(jnp.float32) * k_sc[..., None]
+            vc = vc.astype(jnp.float32) * v_sc[..., None]
         scores = jnp.einsum("bgrth,bgsh->bgrts", qg, kc, preferred_element_type=jnp.float32) / jnp.sqrt(hd)
         scores = jnp.where(attn_ok, scores, -jnp.inf)
         o = jnp.einsum("bgrts,bgsh->bgrth", jax.nn.softmax(scores, axis=-1), vc.astype(jnp.float32))
         o = o.transpose(0, 3, 1, 2, 4).reshape(B, T, nh * hd).astype(x.dtype)
         x = x + jnp.dot(o, layer["wo"])
         x = _mlp(x, layer, cfg)
-        return x, (k_cache, v_cache)
+        return x, ((k_cache, v_cache, k_sc, v_sc) if quant else (k_cache, v_cache))
 
-    x, (ks, vs) = jax.lax.scan(layer_fn, x, (params["layers"], cache["k"], cache["v"]))
+    xs = (params["layers"], cache["k"], cache["v"])
+    if quant:
+        xs += (cache["k_scale"], cache["v_scale"])
+    x, ys = jax.lax.scan(layer_fn, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
     logits = jnp.einsum("bth,hv->btv", x, unembed, preferred_element_type=jnp.float32)
-    return logits, ks, vs
+    return (logits,) + tuple(ys)
 
 
 def _bucket_spec_verify(B=8, S=256, k=4, H=517):
@@ -210,13 +235,35 @@ def spec_verify_slots(
     TOKEN lane is also donated: the host reads the round's results from
     the dedicated emit/logps/acc outputs, never from the token lane."""
     toks_blk = jnp.concatenate([tokens[:, None], proposals], axis=1)
-    logits, ks, vs = _forward_block_slots(params, cache, toks_blk, cfg)
+    logits, *kv_out = _forward_block_slots(params, cache, toks_blk, cfg)
     emit, logps, acc, final, new_keys = _accept_and_sample(
         logits, proposals, spec_k, keys, temps, top_k, top_p
     )
     hist, hist_len = _update_hist(hist, hist_len, emit, acc)
-    new_cache = {"k": ks, "v": vs, "length": cache["length"] + acc + 1}
+    new_cache = {"k": kv_out[0], "v": kv_out[1], "length": cache["length"] + acc + 1}
+    if len(kv_out) == 4:  # int8 cache: the scale lanes ride the rollback too
+        new_cache["k_scale"], new_cache["v_scale"] = kv_out[2], kv_out[3]
     return new_cache, emit, logps, acc, final, new_keys, temps, top_k, top_p, spec_k, hist, hist_len
+
+
+def _bucket_spec_verify_q(B=8, S=256, k=4, H=517):
+    cfg = _trace_cfg()
+    tokens, keys, temps, top_k, top_p = _sds_lanes(B)
+    return (
+        _sds_params(cfg), _sds_cache_q(cfg, B, S), _sds((B, k), jnp.int32),
+        tokens, keys, temps, top_k, top_p, _sds((B,), jnp.int32),
+        _sds((B, H), jnp.int32), _sds((B,), jnp.int32), cfg,
+    ), {}
+
+
+# int8-cache variant (see model_runner's llm.fused_step_int8 rationale:
+# donation + the JXC003 dequant trap audited on the quantized spec path)
+jaxcheck.entry(
+    name="llm.spec_verify_int8",
+    shapes={"b8_s256": _bucket_spec_verify_q},
+    donate=("cache", "tokens", "keys", "temps", "top_k", "top_p", "spec_k", "hist", "hist_len"),
+    donate_bytes=0,
+)(spec_verify_slots)
 
 
 def make_spec_verify_slots(cfg: LlamaConfig, k: int):
@@ -274,6 +321,7 @@ def spec_verify_paged(
     T = k + 1
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     rep = nh // nkv
+    quant = "k_scale" in pool
     page = pool["k"].shape[2]
     max_pg = tables.shape[1]
     toks_blk = jnp.concatenate([tokens[:, None], proposals], axis=1)
@@ -283,21 +331,28 @@ def spec_verify_paged(
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
 
     def layer_fn(x, xs):
-        layer, k_pool_l, v_pool_l = xs
+        if quant:
+            layer, k_pool_l, v_pool_l, k_sc_l, v_sc_l = xs
+        else:
+            layer, k_pool_l, v_pool_l = xs
+            k_sc_l = v_sc_l = None
         xn = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
         q, k_t, v_t = _qkv(xn, layer, cfg)  # [B, T, nh/nkv, hd]
         qh = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)  # [B, nh, T, hd]
         kh = apply_rope(k_t.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)  # [B, T, nkv, hd]
         qg = qh.reshape(B, nkv, rep, T, hd)
-        o = jax.vmap(_paged_attn_seq, in_axes=(0, None, None, 0, 0, 0, 0, None))(
-            qg, k_pool_l, v_pool_l, tables, lengths, kh, v_t, scale
+        o = jax.vmap(_paged_attn_seq, in_axes=(0, None, None, 0, 0, 0, 0, None, None, None))(
+            qg, k_pool_l, v_pool_l, tables, lengths, kh, v_t, scale, k_sc_l, v_sc_l
         )  # [B, nkv, rep, T, hd]
         o = o.transpose(0, 3, 1, 2, 4).reshape(B, T, nh * hd).astype(x.dtype)
         x = x + jnp.dot(o, layer["wo"])
         x = _mlp(x, layer, cfg)
         return x, (kh, v_t)
 
-    x, (k_blk, v_blk) = jax.lax.scan(layer_fn, x, (params["layers"], pool["k"], pool["v"]))
+    xs = (params["layers"], pool["k"], pool["v"])
+    if quant:
+        xs += (pool["k_scale"], pool["v_scale"])
+    x, (k_blk, v_blk) = jax.lax.scan(layer_fn, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
     logits = jnp.einsum("bth,hv->btv", x, unembed, preferred_element_type=jnp.float32)
@@ -323,11 +378,43 @@ def spec_append_paged(pool, wp, wo, k_blk, v_blk):
     block's K/V ([L, B, T, kv, hd]) at (wp, wo) [B, T] for every layer.
     Rejected positions land in the lane's own dead tail (or the trash
     page) and are overwritten before the length rollback could expose
-    them."""
+    them. An int8 pool quantizes here — the append program is the
+    quantizer, mirroring append_paged."""
+    if "k_scale" in pool:
+        from ray_tpu.llm.kv_quant import quantize_heads
+
+        k_blk, sk = quantize_heads(k_blk)  # [L, B, T, kv] scales
+        v_blk, sv = quantize_heads(v_blk)
+        return {
+            "k": pool["k"].at[:, wp, wo].set(k_blk),
+            "v": pool["v"].at[:, wp, wo].set(v_blk),
+            # [L, P, kv, page] indexed at [:, wp, :, wo] -> [B, T, L, kv]
+            "k_scale": pool["k_scale"].at[:, wp, :, wo].set(sk.transpose(1, 2, 0, 3)),
+            "v_scale": pool["v_scale"].at[:, wp, :, wo].set(sv.transpose(1, 2, 0, 3)),
+        }
     return {
         "k": pool["k"].at[:, wp, wo].set(k_blk.astype(pool["k"].dtype)),
         "v": pool["v"].at[:, wp, wo].set(v_blk.astype(pool["v"].dtype)),
     }
+
+
+def _bucket_spec_verify_paged_q(B=8, pages=64, page=16, k=4, H=517):
+    cfg = _trace_cfg()
+    tokens, keys, temps, top_k, top_p = _sds_lanes(B)
+    return (
+        _sds_params(cfg), _sds_pool_q(cfg, pages, page), _sds((B, pages // B * 2), jnp.int32),
+        _sds((B,), jnp.int32), _sds((B, k), jnp.int32),
+        tokens, keys, temps, top_k, top_p, _sds((B,), jnp.int32),
+        _sds((B, H), jnp.int32), _sds((B,), jnp.int32), cfg,
+    ), {}
+
+
+jaxcheck.entry(
+    name="llm.spec_verify_paged_int8",
+    shapes={"b8_p64": _bucket_spec_verify_paged_q},
+    donate=("lengths", "tokens", "keys", "temps", "top_k", "top_p", "spec_k", "hist", "hist_len"),
+    donate_bytes=0,
+)(spec_verify_paged)
 
 
 def make_spec_verify_paged(cfg: LlamaConfig, k: int):

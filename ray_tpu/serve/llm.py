@@ -208,6 +208,11 @@ class LLMServer:
         round, per-request effective k."""
         return self.engine.spec_stats()
 
+    def kv_cache_stats(self) -> dict:
+        """KV-cache accounting: dtype/layout, bytes per token (int8
+        scales included), allocated vs occupied HBM, slot/page occupancy."""
+        return self.engine.kv_cache_stats()
+
     def __call__(self, request):
         """HTTP entry: POST {"prompt_token_ids": [...], "sampling_params": {...}}."""
         body = request.json() if hasattr(request, "json") else dict(request)

@@ -161,16 +161,18 @@ def test_jaxcheck_self_check_runs_clean():
     )
 
 
-def test_jaxcheck_traces_at_least_sixteen_entries():
+def test_jaxcheck_traces_at_least_twenty_four_entries():
     from ray_tpu.lint.jaxcheck import import_entry_modules, registry
 
     import_entry_modules()
     entries = registry.all_entries()
     # PR 4 registered 8; the speculative subsystem (llm/spec/) added 4;
     # disaggregated serving (llm/disagg/scatter.py) adds its extract +
-    # scatter-in pairs — any entry silently dropping out of the registry
-    # is an invariant check that stopped running
-    assert len(entries) >= 16, [e.name for e in entries]
+    # scatter-in pairs; the int8 KV cache registers quantized variants of
+    # every hot-path program it touches (fused decode x2, spec verify x2,
+    # disagg extract x2 + scatter x2) — any entry silently dropping out
+    # of the registry is an invariant check that stopped running
+    assert len(entries) >= 24, [e.name for e in entries]
     subsystems = {e.name.split(".")[0] for e in entries}
     assert {"llm", "parallel", "collective"} <= subsystems
     names = {e.name for e in entries}
@@ -178,6 +180,12 @@ def test_jaxcheck_traces_at_least_sixteen_entries():
     assert {
         "llm.disagg_extract_slots", "llm.disagg_extract_paged",
         "llm.disagg_scatter_slots", "llm.disagg_scatter_paged",
+    } <= names
+    assert {
+        "llm.fused_step_int8", "llm.paged_fused_step_int8",
+        "llm.spec_verify_int8", "llm.spec_verify_paged_int8",
+        "llm.disagg_extract_slots_int8", "llm.disagg_extract_paged_int8",
+        "llm.disagg_scatter_slots_int8", "llm.disagg_scatter_paged_int8",
     } <= names
 
 
@@ -189,7 +197,7 @@ def test_cli_jax_flag_and_rt_wiring():
     )
     assert r.returncode == 0, r.stdout + r.stderr
     m = re.search(r"jaxcheck traced (\d+) entry point", r.stderr)
-    assert m and int(m.group(1)) >= 16, r.stderr
+    assert m and int(m.group(1)) >= 24, r.stderr
 
 
 def test_cli_list_rules_includes_jax_catalog(capsys):

@@ -870,6 +870,46 @@ def test_paged_fused_step_lane_donation_regression():
     assert len(_findings(old, "JXC001")) == 3
 
 
+def test_int8_dequant_does_not_trip_jxc003():
+    """The int8 KV dequant (int8->f32 convert feeding the attention
+    einsums) must never register as JXC003's bf16->f32-before-dot trap:
+    the conversion happens at the compute dtype attention already uses
+    and stays off the flops-dominant dots. Traced over every quantized
+    hot-path entry (fused decode, spec verify, disagg scatter-in) for
+    both layouts — a refactor that routes the dequant through a bf16
+    intermediate feeding the unembed/projection matmuls would fire
+    here."""
+    from ray_tpu.lint.jaxcheck import import_entry_modules, registry
+
+    import_entry_modules()
+    for name in (
+        "llm.fused_step_int8", "llm.paged_fused_step_int8",
+        "llm.spec_verify_int8", "llm.spec_verify_paged_int8",
+        "llm.disagg_extract_slots_int8", "llm.disagg_extract_paged_int8",
+        "llm.disagg_scatter_slots_int8", "llm.disagg_scatter_paged_int8",
+    ):
+        spec = registry.get_entry(name)
+        assert spec is not None, name
+        assert _findings(spec, "JXC003") == [], name
+        assert _findings(spec, "JXCERR") == [], name  # all int8 buckets trace
+
+
+def test_int8_fused_step_donation_audited():
+    """The int8 cache pytree (values + scale lanes) donates wholesale:
+    dropping the donation must resurface JXC001 on the quantized entry."""
+    from dataclasses import replace
+
+    from ray_tpu.lint.jaxcheck import import_entry_modules, registry
+
+    import_entry_modules()
+    spec = registry.get_entry("llm.fused_step_int8")
+    assert spec is not None
+    assert _findings(spec, "JXC001") == []
+    old = replace(spec, donate=("keys", "temps", "top_k", "top_p"))
+    msgs = [f.message for f in _findings(old, "JXC001")]
+    assert any("'cache" in m for m in msgs), msgs
+
+
 def test_tpl001_bounded_helper_from_async_still_flags():
     # mirrors the lexical gate exactly: a timeout bound clears the
     # actor-deadlock case but a bounded get still parks an event loop

@@ -188,6 +188,97 @@ def test_handoff_codec_rejects_inconsistent_payloads(params, prefill_eng):
         decode_handoff(trunc)
 
 
+# ----------------------------------------------- int8 (quantized) handoffs
+
+
+@pytest.fixture(scope="module")
+def prefill_eng_q8(params):
+    """Int8-cache prefill engine: its handoff blocks ship int8 values +
+    per-head scales ([L, kv, T_pad] wire layout) — ~half the bytes."""
+    return LLMEngine(
+        CFG, params, max_num_seqs=2, max_seq_len=128,
+        enable_prefix_caching=False, cache_dtype="int8",
+    )
+
+
+def test_disagg_int8_token_identity(params, prefill_eng_q8):
+    """Int8 producer -> codec -> int8 device-resident consumer emits
+    exactly what the int8 single-engine sync oracle emits (greedy): the
+    quantized bytes that leave the producer are the bytes a local
+    prefill would have written, so the streams are bit-for-bit the same
+    cache state."""
+    reqs = [
+        ([5, 6, 7, 8] * 4, SamplingParams(max_tokens=8, temperature=0.0), 0),
+        ([9, 10, 11] * 5, SamplingParams(max_tokens=6, temperature=0.0), 1),
+    ]
+    kw = dict(max_num_seqs=2, max_seq_len=128, enable_prefix_caching=False, cache_dtype="int8")
+    sync, sync_r = _oracle_streams(params, reqs, kw)
+    dis, dis_r, _ = _disagg_streams(params, prefill_eng_q8, reqs, kw)
+    assert dis == sync and dis_r == sync_r
+
+
+def test_handoff_codec_validates_quantized_scales(prefill_eng_q8):
+    """Scale-tensor shape/dtype are validated on decode: a garbage scale
+    must raise HandoffError, never rescale a live pool."""
+    kv = prefill_eng_q8.prefill_handoff([3, 4, 5, 6, 7])
+    assert kv["k"].dtype == np.int8 and kv["k_scale"].shape == (
+        CFG.num_layers, CFG.num_kv_heads, kv["k"].shape[1],
+    )
+    wire = encode_handoff(kv)
+    out = decode_handoff(wire)
+    assert out["k_scale"].dtype == np.float32
+    bad = dict(wire)
+    bad["k_scale"] = wire["k_scale"][:, :1]  # truncated head axis
+    with pytest.raises(HandoffError):
+        decode_handoff(bad)
+    bad = dict(wire)
+    bad["k_scale"] = wire["k_scale"].astype(np.float64)
+    with pytest.raises(HandoffError):
+        decode_handoff(bad)
+    bad = dict(wire)
+    del bad["k_scale"], bad["v_scale"]  # int8 block without scales
+    with pytest.raises(HandoffError):
+        decode_handoff(bad)
+    bad = dict(wire)
+    bad["dtype"] = "float32"  # scales on a claimed-fp block (either lane)
+    bad["k"] = bad["k"].astype(np.float32)
+    bad["v"] = bad["v"].astype(np.float32)
+    with pytest.raises(HandoffError):
+        decode_handoff(bad)
+    # and the encoder refuses inconsistent producer payloads outright
+    bad_kv = dict(kv)
+    bad_kv["k_scale"] = kv["k_scale"][:, :, :1]
+    with pytest.raises(HandoffError):
+        encode_handoff(bad_kv)
+    bad_kv = dict(kv)
+    del bad_kv["v_scale"]  # unpaired scale lane: HandoffError, not KeyError
+    with pytest.raises(HandoffError):
+        encode_handoff(bad_kv)
+
+
+def test_disagg_cross_dtype_requants_transparently(params, prefill_eng, prefill_eng_q8):
+    """Producer and consumer cache dtypes may differ — the contract is
+    TRANSPARENT requant, locked both ways: an fp block admitted by an
+    int8 consumer quantizes at scatter-in (identical to a local int8
+    prefill, so oracle-identical), and an int8 block admitted by an fp
+    consumer dequantizes and decodes (first token rides the payload's fp
+    logits, so it matches the int8 oracle's first token exactly)."""
+    prompt = [7, 8, 9, 10] * 4
+    sp = SamplingParams(max_tokens=6, temperature=0.0)
+    kw = dict(max_num_seqs=2, max_seq_len=128, enable_prefix_caching=False)
+    reqs = [(prompt, sp, 0)]
+    oracle_q8, _ = _oracle_streams(params, reqs, {**kw, "cache_dtype": "int8"})
+
+    # fp producer -> int8 consumer: quantize-on-scatter == local prefill
+    dis, _, _ = _disagg_streams(params, prefill_eng, reqs, {**kw, "cache_dtype": "int8"})
+    assert dis == oracle_q8
+
+    # int8 producer -> fp consumer: dequantized block decodes cleanly
+    dis_fp, reasons, _ = _disagg_streams(params, prefill_eng_q8, reqs, kw)
+    assert len(dis_fp[0]) == sp.max_tokens and reasons[0] == "length"
+    assert dis_fp[0][0] == oracle_q8[0][0]
+
+
 # ------------------------------------------------- router failure policy
 # (real object plane, synthetic KV: no jax compiles in these tests)
 

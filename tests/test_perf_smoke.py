@@ -113,6 +113,46 @@ def test_llm_engine_throughput_floor():
     assert decode_tok_s > 25, f"decode throughput collapsed: {decode_tok_s:.0f} tok/s"
 
 
+def test_llm_int8_decode_step_floor():
+    """Int8-KV decode throughput floor: the quantized step must stay no
+    worse than 1.1x the bf16 step on CPU (the perf gate BENCH_serve.json
+    records on a quiet box — here with interleaved best-of-N so load
+    jitter hits both engines alike). A structural regression — dequant
+    materializing the full cache in f32 outside the fused step, a
+    per-step requant of old positions, a lost scale-lane donation —
+    shows up as the int8 step falling far behind bf16's."""
+    pytest.importorskip("jax")
+    from ray_tpu.llm import LLMEngine, SamplingParams
+    from ray_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.tiny(dtype="float32", remat=False, max_seq_len=256)
+    B, P, G = 4, 32, 24
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, cfg.vocab_size - 1, size=P)) for _ in range(B)]
+    engines = {}
+    for dt in ("bfloat16", "int8"):
+        eng = LLMEngine(cfg, max_num_seqs=B, max_seq_len=128, enable_prefix_caching=False, cache_dtype=dt)
+        eng.generate(prompts, SamplingParams(max_tokens=2))  # compile everything
+        engines[dt] = eng
+    best = {dt: float("inf") for dt in engines}
+    for _ in range(3):  # interleaved rounds: jitter degrades both alike
+        for dt, eng in engines.items():
+            for p in prompts:
+                eng.add_request(p, SamplingParams(max_tokens=G))
+            while eng.num_waiting:
+                eng.step()
+            t0 = time.perf_counter()
+            steps = 0
+            while eng.has_unfinished():
+                eng.step()
+                steps += 1
+            best[dt] = min(best[dt], (time.perf_counter() - t0) / max(steps, 1))
+    assert best["int8"] <= 1.1 * best["bfloat16"], (
+        f"int8 decode step regressed past the 1.1x bf16 gate: "
+        f"int8 {best['int8'] * 1e3:.2f} ms vs bf16 {best['bfloat16'] * 1e3:.2f} ms"
+    )
+
+
 def test_actor_call_floor(rt):
     @ray_tpu.remote
     class A:
