@@ -36,6 +36,21 @@ _HBM_GBPS = {
     "TPU v6e": 1640.0,
 }
 
+# one-way ICI bandwidth per link (GB/s) for the tensor-parallel
+# all-reduce roofline: at TP>=2 the per-layer all-reduce is the
+# ICI-bound cost of every decode step, and a 1D tp ring ships
+# 2(n-1)/n x payload per chip per all-reduce (the factor
+# collective_wire_report already folds in).
+_ICI_GBPS = {
+    "TPU v4": 45.0,
+    "TPU v5 lite": 45.0,
+    "TPU v5e": 45.0,
+    "TPU v5p": 90.0,
+    "TPU v5": 90.0,  # v5p spelling on some hosts (matches _HBM_GBPS); AFTER the v5e/v5p keys — the lookup is first-startswith-wins
+    "TPU v6 lite": 90.0,
+    "TPU v6e": 90.0,
+}
+
 
 def _device_info() -> dict:
     """Prove which device the numbers came from (VERDICT r5: the artifact
@@ -190,6 +205,7 @@ def bench_engine(
         **info,
         "kv_dtype": eng.kv_dtype,
         "tp": _tp_of(eng),
+        "tp_collective": eng.tp_collective,
         "device_resident": eng._device_resident,
         "prefill_tokens_per_s": round(prefill_tok_s, 1),
         "prefill_ms_per_step": round(prefill_s / max(prefill_waves, 1) * 1e3, 2),
@@ -291,6 +307,7 @@ def bench_spec(cfg, prompt_len: int, gen_len: int, max_num_seqs: int = 8, k: int
         **_device_info(),
         "kv_dtype": cfg.dtype,
         "tp": 1,
+        "tp_collective": "fp",
         "drafter": "ngram",
         "k": k,
         "ngram": ngram,
@@ -403,8 +420,139 @@ def bench_kv_int8(cfg, prompt_len: int, gen_len: int, max_num_seqs: int = 8, rep
         **_device_info(),
         "kv_dtype": "int8",
         "tp": 1,
+        "tp_collective": "fp",
         "baseline_dtype": "bfloat16",
         "layouts": layouts,
+        "batch": max_num_seqs,
+        "prompt_len": prompt_len,
+        "gen_len": gen_len,
+    }
+
+
+def bench_tp(cfg, prompt_len: int, gen_len: int, max_num_seqs: int = 8, repeats: int = 1) -> dict:
+    """Tensor-parallel A/B (ROADMAP item 1's bench ask): tp=1 vs tp=2
+    (explicit shard_map psum) vs tp=2 + int8 quantized all-reduce, slot
+    layout, recording per-mode decode ms/step, greedy-output equivalence
+    (tp=2 fp must match tp=1 EXACTLY; int8 must keep exact top-1 on the
+    decisive-logits copy-model workload), and the bytes-on-the-wire
+    evidence: a jaxpr-level accounting of every collective's operand
+    dtype/bytes per fused step plus the v5e ICI roofline those bytes
+    imply. On CPU the wall-clock columns measure virtual devices sharing
+    one socket (tp=2 is SLOWER there — more programs, same silicon); the
+    wire-byte columns are platform-independent and are the gate."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.collective.ici import collective_wire_report
+    from ray_tpu.llm.engine import LLMEngine
+    from ray_tpu.llm.model_runner import _sharded_fused_slots
+    from ray_tpu.llm.sampling import SamplingParams
+    from ray_tpu.parallel.mesh import create_mesh
+
+    if len(jax.devices()) < 2:
+        return {"metric": "engine_tp_ab", **_device_info(), "skipped": "needs >= 2 devices"}
+    mesh = create_mesh(tp=2, devices=jax.devices()[:2])
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, cfg.vocab_size - 1, size=prompt_len)) for _ in range(max_num_seqs)]
+    sp = SamplingParams(temperature=0.0, max_tokens=gen_len)
+
+    def run(mesh_, coll):
+        eng = LLMEngine(
+            cfg, max_num_seqs=max_num_seqs, max_seq_len=cfg.max_seq_len,
+            enable_prefix_caching=False, mesh=mesh_, tp_collective=coll, seed=0,
+        )
+        eng.generate(prompts, SamplingParams(temperature=0.0, max_tokens=4))  # warm/compile
+        decode_s, steps, toks = float("inf"), 1, None
+        for _ in range(max(repeats, 1)):
+            ids = [eng.add_request(p, sp) for p in prompts]
+            while eng.num_waiting:
+                eng.step()
+            t0 = time.perf_counter()
+            n_steps, finals = 0, {}
+            while eng.has_unfinished():
+                for o in eng.step():
+                    if o.finished:
+                        finals[o.request_id] = o.token_ids
+                n_steps += 1
+            d_s = time.perf_counter() - t0
+            if d_s / max(n_steps, 1) < decode_s / max(steps, 1):
+                decode_s, steps = d_s, n_steps
+            toks = [finals[i] for i in ids]
+        return toks, decode_s / max(steps, 1) * 1e3, eng
+
+    toks1, ms1, _ = run(None, "fp")
+    toks2, ms2, eng2 = run(mesh, "fp")
+    toksq, msq, engq = run(mesh, "int8")
+
+    # exact top-1 for the int8 collective is gated on a DECISIVE-logits
+    # workload (the copy model bench_spec uses): random-weight logits are
+    # near-uniform, where any rounding flips a meaningless argmax
+    cp = _copy_model_params(cfg)
+    cprompt = [[1, 2, 3, 4, 5, 6, 7, 8]] * 2
+    csp = SamplingParams(temperature=0.0, max_tokens=min(gen_len, 24))
+    cp_base = [o.token_ids for o in LLMEngine(
+        cfg, cp, max_num_seqs=2, max_seq_len=cfg.max_seq_len, enable_prefix_caching=False,
+    ).generate(cprompt, csp)]
+    cp_q = [o.token_ids for o in LLMEngine(
+        cfg, cp, max_num_seqs=2, max_seq_len=cfg.max_seq_len, enable_prefix_caching=False,
+        mesh=mesh, tp_collective="int8",
+    ).generate(cprompt, csp)]
+
+    # bytes-on-the-wire: trace the two fused programs and account every
+    # collective operand (scan-aware, so per-layer psums count L times)
+    sds = lambda t: jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t)  # noqa: E731
+    args = (sds(eng2.params), sds(eng2.cache), sds(eng2._dtokens), sds(eng2._dkeys),
+            sds(eng2._dtemps), sds(eng2._dtopk), sds(eng2._dtopp))
+    wire = {}
+    for coll in ("fp", "int8"):
+        rep = collective_wire_report(
+            jax.make_jaxpr(_sharded_fused_slots(cfg, mesh, coll, eng2.kv_quant))(*args), axis_size=2
+        )
+        layer = [op for op in rep["ops"] if op["count"] > 1]
+        wire[coll] = {
+            "bytes_per_step_by_dtype": rep["bytes_by_dtype"],
+            "bytes_per_step_total": rep["total_bytes"],
+            "per_layer_allreduce_bytes": int(sum(op["wire_bytes"] for op in layer)),
+            "per_layer_dtypes": sorted({op["dtype"] for op in layer}),
+        }
+    ratio_layer = wire["int8"]["per_layer_allreduce_bytes"] / max(wire["fp"]["per_layer_allreduce_bytes"], 1)
+    # ICI roofline: what those bytes cost on a real chip (v5e default when
+    # the bench ran TPU-less — the CPU cannot show the ICI wall-clock win)
+    info = _device_info()
+    ici = next((v for k, v in _ICI_GBPS.items() if info["device_kind"].startswith(k)), _ICI_GBPS["TPU v5e"])
+    roof = {
+        "ici_gbps_per_link_oneway": ici,
+        "assumed_device": info["device_kind"] if info["device"] == "tpu" else "TPU v5e (TPU-less run)",
+        "fp_allreduce_us_per_step": round(wire["fp"]["bytes_per_step_total"] / (ici * 1e9) * 1e6, 2),
+        "int8_allreduce_us_per_step": round(wire["int8"]["bytes_per_step_total"] / (ici * 1e9) * 1e6, 2),
+    }
+    print(
+        f"  tp=1 {ms1:.2f} ms/step | tp=2 fp {ms2:.2f} | tp=2 int8c {msq:.2f}; "
+        f"per-layer all-reduce bytes int8/fp = {ratio_layer:.2f} "
+        f"({wire['int8']['per_layer_allreduce_bytes']}/{wire['fp']['per_layer_allreduce_bytes']}); "
+        f"v5e ICI roofline {roof['fp_allreduce_us_per_step']} -> {roof['int8_allreduce_us_per_step']} us/step",
+        flush=True,
+    )
+    return {
+        "metric": "engine_tp_ab",
+        **info,
+        "kv_dtype": eng2.kv_dtype,
+        "tp": 2,
+        "tp_collective": "int8",  # the mode under test; per-mode rows below
+        "modes": {
+            "tp1": {"decode_step_ms": round(ms1, 2), "tp": 1, "tp_collective": "fp"},
+            "tp2_fp": {
+                "decode_step_ms": round(ms2, 2), "tp": 2, "tp_collective": "fp",
+                "outputs_match_tp1": toks2 == toks1,
+            },
+            "tp2_int8": {
+                "decode_step_ms": round(msq, 2), "tp": 2, "tp_collective": "int8",
+                "copy_model_top1_match": cp_q == cp_base,
+            },
+        },
+        "wire": wire,
+        "per_layer_allreduce_bytes_ratio": round(ratio_layer, 3),
+        "ici_roofline": roof,
         "batch": max_num_seqs,
         "prompt_len": prompt_len,
         "gen_len": gen_len,
@@ -581,6 +729,7 @@ def bench_disagg(cfg, prompt_len: int, gen_len: int, max_num_seqs: int = 4, n_lo
         **_device_info(),
         "kv_dtype": cfg.dtype,
         "tp": 1,
+        "tp_collective": "fp",
         "disagg": True,  # provenance: this record came from the split-path A/B
         "workload": (
             f"{len(shorts)} decode streams (prompt {short_len}, gen {gen_len}) + "
@@ -656,6 +805,7 @@ def bench_full_stack(cfg, prompt_len: int, gen_len: int, concurrency: int, tiny:
             **_device_info(),
             "kv_dtype": cfg.dtype,
             "tp": 1,
+            "tp_collective": "fp",
             "concurrency": concurrency,
             "requests": n,
             "errors": len(errors),
@@ -689,6 +839,16 @@ def main(argv=None):
     ap.add_argument("--repeats", type=int, default=3, help="best-of-N engine phases (min = least-contended sample)")
     args = ap.parse_args(argv)
 
+    # the tp A/B needs >= 2 devices: on a TPU-less host give the CPU
+    # platform virtual devices BEFORE jax initializes (harmless on real
+    # TPU hosts — the flag only affects the host platform)
+    import os
+
+    if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+        )
+
     cfg, prompt_len, gen_len = _model(args.tiny or args.small)
     if args.small:
         from ray_tpu.models.llama import LlamaConfig
@@ -718,6 +878,7 @@ def main(argv=None):
     if args.speculative:
         benches.append(("engine_spec_ngram", lambda: bench_spec(cfg, prompt_len, gen_len, k=args.spec_k, repeats=args.repeats)))
     benches.append(("engine_kv_int8_ab", lambda: bench_kv_int8(cfg, prompt_len, gen_len, repeats=args.repeats)))
+    benches.append(("engine_tp_ab", lambda: bench_tp(cfg, prompt_len, gen_len, repeats=args.repeats)))
     benches.append(("engine_disagg_ab", lambda: bench_disagg(cfg, prompt_len, gen_len)))
     benches.append(("full_stack", lambda: bench_full_stack(cfg, prompt_len, gen_len, args.concurrency, args.tiny or args.small)))
     for name, fn in benches:

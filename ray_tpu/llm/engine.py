@@ -219,6 +219,7 @@ class LLMEngine:
         seed: int = 0,
         cache_dtype: str | None = None,
         mesh=None,
+        tp_collective: str = "fp",
         enable_prefix_caching: bool = True,
         prefix_cache_bytes: int = 256 << 20,
         prefix_block: int = 64,
@@ -258,7 +259,15 @@ class LLMEngine:
         the device-resident loop — a drafter proposes up to k tokens per
         lane and one fused verify step accepts/extends them (llm/spec/).
         Greedy output stays token-identical to speculative=None, which is
-        the subsystem's equivalence oracle (tests/test_llm_spec.py)."""
+        the subsystem's equivalence oracle (tests/test_llm_spec.py).
+
+        tp_collective: dtype of the per-layer tensor-parallel all-reduce
+        on the device-resident fused/spec hot path (only meaningful with
+        a tp>=2 mesh). "fp" (default) reduces exactly at the operand
+        dtype; "int8" quantizes the all-reduce payload to int8 with f32
+        amax scales (EQuARX, arxiv 2506.17615) — ~1/2 the ICI bytes per
+        layer at bf16 operands, with the fp-collective engine as the
+        accuracy oracle (tests/test_llm_tp.py)."""
         import jax
         import jax.numpy as jnp
 
@@ -269,6 +278,9 @@ class LLMEngine:
 
         self.config = config
         self.mesh = mesh
+        if tp_collective not in ("fp", "int8"):
+            raise ValueError(f"tp_collective must be 'fp' or 'int8', got {tp_collective!r}")
+        self.tp_collective = tp_collective
         self.max_num_seqs = int(max_num_seqs)
         self.max_seq_len = int(max_seq_len or config.max_seq_len)
         if kv_layout not in ("slots", "paged"):
@@ -395,13 +407,37 @@ class LLMEngine:
         # in-flight fused step awaiting host readback:
         # (tokens [B] dev, logps [B] dev, [(RequestState, slot), ...])
         self._pending = None
+        # the shard_map hot path engages on a PURE tp mesh (other axes
+        # would shard dims the per-shard programs assume replicated; a
+        # mixed mesh falls back to the GSPMD compilation, fp collectives)
+        from ray_tpu.parallel.mesh import axis_size, is_tp_only
+
+        self._tp_fused = (
+            mesh is not None and is_tp_only(mesh) and axis_size(mesh, "tp") > 1 and self._device_resident
+        )
+        if tp_collective == "int8" and not self._tp_fused:
+            raise ValueError(
+                "tp_collective='int8' quantizes the explicit shard_map all-reduce, which only "
+                "exists on the device-resident fused path over a pure tp>=2 mesh "
+                "(got mesh=%s, device_resident=%s)" % (getattr(mesh, "axis_names", None), self._device_resident)
+            )
+        if self._tp_fused and tp_collective == "int8" and config.hidden_size % axis_size(mesh, "tp"):
+            raise ValueError(
+                f"hidden_size ({config.hidden_size}) must divide by tp ({axis_size(mesh, 'tp')}) "
+                "to chunk the int8 quantized all-reduce payload; use tp_collective='fp'"
+            )
         if self._device_resident:
             from ray_tpu.llm.model_runner import make_delta_fns, make_fused_fns, make_fused_paged_fns
 
+            tp_mesh = mesh if self._tp_fused else None
             if kv_layout == "paged":
-                self._fused_attn, self._fused_append = make_fused_paged_fns(config)
+                self._fused_attn, self._fused_append = make_fused_paged_fns(
+                    config, mesh=tp_mesh, tp_collective=tp_collective, kv_quant=self.kv_quant
+                )
             else:
-                self._fused_step = make_fused_fns(config)
+                self._fused_step = make_fused_fns(
+                    config, mesh=tp_mesh, tp_collective=tp_collective, kv_quant=self.kv_quant
+                )
             self._set_lane, self._set_table, self._set_table_cell = make_delta_fns()
             if mesh is None:
                 _put = jnp.asarray
@@ -427,8 +463,11 @@ class LLMEngine:
                     "speculative decoding runs on the device-resident loop only "
                     "(the plain loop is kept untouched as its equivalence oracle)"
                 )
-            if mesh is not None:
-                raise ValueError("speculative decoding does not support tp meshes yet")
+            if mesh is not None and not self._tp_fused:
+                raise ValueError(
+                    "speculative decoding over a mesh needs the shard_map fused path "
+                    f"(a pure tp>=2 mesh); got axes {getattr(mesh, 'axis_names', None)}"
+                )
             self._init_spec(speculative, _put)
 
     def _init_spec(self, spec_cfg, _put):
@@ -457,6 +496,15 @@ class LLMEngine:
             self._drafter = ModelDrafter(dcfg, params=spec_cfg.draft_params, k=k, seed=spec_cfg.draft_seed)
         else:
             self._drafter = NGramDrafter(k=k, n=spec_cfg.ngram)
+        if self.mesh is not None and not self._drafter.supports_mesh:
+            # the verify step shards like the fused step, but a draft
+            # MODEL brings its own weights + slot KV cache + fused
+            # k+1-step chain, none of which is mesh-sharded yet
+            raise NotImplementedError(
+                f"drafter '{self._drafter.kind}' does not support tensor-parallel meshes: the "
+                "draft model's params/KV cache and its fused draft_steps chain are not sharded "
+                "over tp; use the zero-weight drafter='ngram' (its proposal lanes are replicated)"
+            )
         self._drafter.init_slots(B, self.max_seq_len, self.prefill_buckets)
         self._controller = AdaptiveKController(spec_cfg)
         # token-history lanes: prompt + everything emitted on device, one
@@ -467,10 +515,15 @@ class LLMEngine:
         self._dhist_len = _put(jnp.zeros((B,), jnp.int32))
         self._dspec_k = _put(jnp.full((B,), k, jnp.int32))
         self._lane_k = np.full((B,), k, np.int32)  # host mirror, updated with the device lane
+        tp_mesh = self.mesh if self._tp_fused else None
         if self.kv_layout == "paged":
-            self._verify_attn, self._verify_append = specv.make_spec_verify_paged(self.config, k)
+            self._verify_attn, self._verify_append = specv.make_spec_verify_paged(
+                self.config, k, mesh=tp_mesh, tp_collective=self.tp_collective, kv_quant=self.kv_quant
+            )
         else:
-            self._verify_step = specv.make_spec_verify_slots(self.config, k)
+            self._verify_step = specv.make_spec_verify_slots(
+                self.config, k, mesh=tp_mesh, tp_collective=self.tp_collective, kv_quant=self.kv_quant
+            )
         self._set_hist = jax.jit(specv.set_hist_row)
         self._set_slot_scalar = jax.jit(specv.set_slot_scalar)
         self._spec_rounds = self._spec_lane_rounds = 0
@@ -550,10 +603,32 @@ class LLMEngine:
         from ray_tpu.parallel.mesh import ShardingRules, axis_or_none, mesh_axes
 
         tp = axis_or_none(mesh, "tp")
-        tp_size = mesh_axes(mesh).get("tp", 1)
-        if self.config.num_kv_heads % max(tp_size, 1) != 0:
+        tp_size = max(mesh_axes(mesh).get("tp", 1), 1)
+        # validate EVERY tp-sharded model dim up front with an actionable
+        # message — an indivisible q-head count or MLP width used to fail
+        # deep inside GSPMD partitioning with an inscrutable HLO error
+        if self.config.num_kv_heads % tp_size != 0:
             raise ValueError(
-                f"num_kv_heads ({self.config.num_kv_heads}) must divide by tp ({tp_size}) to shard the KV cache"
+                f"num_kv_heads ({self.config.num_kv_heads}) must divide by tp ({tp_size}) to shard "
+                "the KV cache; pick tp from the divisors of num_kv_heads (or replicate KV by "
+                "raising num_kv_heads to match)"
+            )
+        if self.config.num_heads % tp_size != 0:
+            raise ValueError(
+                f"num_heads ({self.config.num_heads}) must divide by tp ({tp_size}) to shard the "
+                "attention projections (wq/wo split by head); pick tp from the divisors of num_heads"
+            )
+        if self.config.intermediate_size % tp_size != 0:
+            raise ValueError(
+                f"intermediate_size ({self.config.intermediate_size}) must divide by tp ({tp_size}) "
+                "to shard the MLP (w_gate/w_up/w_down split on the hidden dim); pad "
+                "intermediate_size to a multiple of tp"
+            )
+        if self.config.vocab_size % tp_size != 0:
+            raise ValueError(
+                f"vocab_size ({self.config.vocab_size}) must divide by tp ({tp_size}) to shard the "
+                "embed/unembed tables (and the shard_map decode path's logits gather); pad the "
+                "vocab to a multiple of tp"
             )
         rules = ShardingRules()
         param_sh = jax.tree.map(

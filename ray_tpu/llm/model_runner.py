@@ -14,6 +14,7 @@ engines/vllm/vllm_engine.py) with a jit-native implementation.
 
 from __future__ import annotations
 
+from dataclasses import dataclass, replace
 from functools import partial
 
 import jax
@@ -23,6 +24,115 @@ from ray_tpu.lint import jaxcheck
 from ray_tpu.models.llama import LlamaConfig
 from ray_tpu.ops.flash_attention import flash_attention
 from ray_tpu.ops.layers import apply_rope, rms_norm, rotary_embedding
+
+
+# ---------------------------------------------------------------------------
+# Tensor parallelism over the ICI mesh: the fused decode hot path is
+# re-expressed under shard_map so the per-layer TP all-reduce is an
+# EXPLICIT psum the runtime controls (instead of a GSPMD-inserted
+# collective), which is what makes the opt-in int8 quantized all-reduce
+# (collective/ici.quantized_psum, EQuARX arxiv 2506.17615) expressible at
+# all. tpc=None keeps every function byte-for-byte the single-device
+# program it was — the tp=1 engine stays the token-identical oracle.
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class TpSpec:
+    """Static description of the tensor-parallel axis a sharded step runs
+    over: closed into the shard_map body, never traced."""
+
+    axis: str = "tp"
+    size: int = 1
+    collective: str = "fp"  # "fp" (exact psum) | "int8" (quantized wire)
+
+
+def _tp_reduce(x, tpc: TpSpec | None):
+    """The per-layer TP all-reduce (attention-out and MLP-out partials).
+    fp: exact lax.psum; int8: EQuARX-style quantized reduce-scatter +
+    all-gather with int8 wire payload (~1/2 the ICI bytes at bf16)."""
+    if tpc is None:
+        return x
+    if tpc.collective == "int8":
+        from ray_tpu.collective.ici import quantized_psum
+
+        return quantized_psum(x, tpc.axis)
+    return jax.lax.psum(x, tpc.axis)
+
+
+def _tp_embed(embed, tokens, tpc: TpSpec | None):
+    """Token lookup against a vocab-row-sharded embedding: each shard
+    gathers locally (clipped), masks out-of-shard rows, and one small
+    [B, H] fp psum assembles the vectors — once per step, not per layer,
+    so it stays full precision in both collective modes."""
+    if tpc is None:
+        return jnp.take(embed, tokens, axis=0)
+    v_loc = embed.shape[0]
+    loc = tokens - jax.lax.axis_index(tpc.axis) * v_loc
+    ok = (loc >= 0) & (loc < v_loc)
+    x = jnp.take(embed, jnp.clip(loc, 0, v_loc - 1), axis=0)
+    x = jnp.where(ok[..., None], x, jnp.zeros((), x.dtype))
+    return jax.lax.psum(x, tpc.axis)
+
+
+def _tp_gather_logits(logits, tpc: TpSpec | None):
+    """Vocab-sharded unembed partials -> full logits on every shard (the
+    sampler needs the whole distribution). fp all-gather in both modes:
+    it runs once per step and logit precision feeds top-k/top-p surgery."""
+    if tpc is None:
+        return logits
+    return jax.lax.all_gather(logits, tpc.axis, axis=logits.ndim - 1, tiled=True)
+
+
+def _shard_cfg(cfg: LlamaConfig, tp: int) -> LlamaConfig:
+    """Per-shard view of the model config for shard_map bodies: head
+    counts divide by tp (the local arrays carry the divided dims), and
+    head_dim is pinned so the hd property stops deriving it from the
+    now-wrong hidden/num_heads ratio."""
+    return replace(
+        cfg,
+        num_heads=cfg.num_heads // tp,
+        num_kv_heads=cfg.num_kv_heads // tp,
+        head_dim=cfg.hd,
+    )
+
+
+def _tp_shard_map(f, mesh, in_specs, out_specs):
+    """jax.shard_map where available; jax.experimental fallback on 0.4.x
+    (same shim as parallel/pipeline.py). check_rep=False: lane outputs are
+    replicated by construction (every shard computes the full sampler on
+    the gathered logits), not by inference."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, axis_names={"tp"})
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+
+
+def _param_pspecs(cfg: LlamaConfig, mesh):
+    """PartitionSpec pytree for the llama params over this mesh — the
+    same logical-axes -> mesh-axes lowering the engine's GSPMD shardings
+    use, so shard_map consumes the engine's arrays without resharding."""
+    from ray_tpu.models.llama import param_logical_axes
+    from ray_tpu.parallel.mesh import ShardingRules
+
+    rules = ShardingRules()
+    return jax.tree.map(
+        lambda axes: rules.spec(axes, mesh),
+        param_logical_axes(cfg),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def _cache_pspecs(kv_layout: str, kv_quant: bool):
+    """PartitionSpecs for the KV cache/pool pytree (kv_heads on tp; the
+    int8 scale lanes shard their kv axis too) — mirrors
+    engine._mesh_shardings."""
+    from jax.sharding import PartitionSpec as P
+
+    kv = P(None, None, None, "tp", None)
+    specs = {"k": kv, "v": kv} if kv_layout == "paged" else {"k": kv, "v": kv, "length": P()}
+    if kv_quant:
+        specs["k_scale"] = specs["v_scale"] = P(None, None, "tp", None)
+    return specs
 
 
 # ---------------------------------------------------------------------------
@@ -144,11 +254,11 @@ def _qkv(xn, layer, cfg: LlamaConfig):
     return q, k, v
 
 
-def _mlp(x, layer, cfg: LlamaConfig):
+def _mlp(x, layer, cfg: LlamaConfig, tpc: TpSpec | None = None):
     xn = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
     g = jnp.dot(xn, layer["w_gate"])
     u = jnp.dot(xn, layer["w_up"])
-    return x + jnp.dot(jax.nn.silu(g) * u, layer["w_down"])
+    return x + _tp_reduce(jnp.dot(jax.nn.silu(g) * u, layer["w_down"]), tpc)
 
 
 @jaxcheck.entry(
@@ -197,7 +307,7 @@ def prefill(params, tokens, length, cfg: LlamaConfig):
     shapes={"b8_s256": _bucket_decode},
     donate=("cache",),
 )
-def decode_step(params, cache, tokens, cfg: LlamaConfig):
+def decode_step(params, cache, tokens, cfg: LlamaConfig, tpc: TpSpec | None = None):
     """Advance every slot one token.
 
     tokens: [slots] int32 (next input token per slot, garbage for empty
@@ -209,6 +319,13 @@ def decode_step(params, cache, tokens, cfg: LlamaConfig):
     INSIDE this program and dequantizes the row for attention at the f32
     compute dtype the score/value einsums already use (kv_quant.py) —
     same program count, roughly half the cache bytes streamed.
+
+    With ``tpc`` set this is the per-shard body of a shard_map over the
+    tp axis (cfg is the DIVIDED per-shard view from _shard_cfg): heads
+    and the MLP hidden dim are local, and the attention-out / MLP-out
+    partial sums all-reduce explicitly via _tp_reduce — the collective
+    the runtime owns and (opt-in) quantizes. tpc=None is bit-for-bit the
+    single-device program.
 
     CONTRACT: the speculative draft scan (llm/spec/drafter.py
     draft_steps) chains this k+1 times inside one program with an
@@ -222,7 +339,7 @@ def decode_step(params, cache, tokens, cfg: LlamaConfig):
     quant = "k_scale" in cache
     lengths = cache["length"]
     cos, sin = rotary_embedding(lengths[:, None], cfg.hd, cfg.rope_theta)  # [B, 1, hd/2]
-    x = jnp.take(params["embed"], tokens[:, None], axis=0)  # [B, 1, H]
+    x = _tp_embed(params["embed"], tokens[:, None], tpc)  # [B, 1, H]
     S = cache["k"].shape[2]
     # mask: new token sits at index `length`, may attend to 0..length
     attn_ok = (jnp.arange(S, dtype=jnp.int32)[None, :] <= lengths[:, None])[:, None, None]  # [B,1,1,S]
@@ -259,8 +376,8 @@ def decode_step(params, cache, tokens, cfg: LlamaConfig):
         scores = jnp.where(attn_ok, scores, -jnp.inf)  # [B,1,1,S] bcast
         probs = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bgrs,bgsh->bgrh", probs, vc.astype(jnp.float32)).reshape(B, 1, nh * hd).astype(x.dtype)
-        x = x + jnp.dot(o, layer["wo"])
-        x = _mlp(x, layer, cfg)
+        x = x + _tp_reduce(jnp.dot(o, layer["wo"]), tpc)
+        x = _mlp(x, layer, cfg, tpc)
         return x, ((k_cache, v_cache, k_sc, v_sc) if quant else (k_cache, v_cache))
 
     xs = (params["layers"], cache["k"], cache["v"])
@@ -269,7 +386,7 @@ def decode_step(params, cache, tokens, cfg: LlamaConfig):
     x, ys = jax.lax.scan(layer_fn, x, xs)
     x = rms_norm(x[:, 0], params["final_norm"], cfg.rms_eps)
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
-    logits = jnp.dot(x, unembed, preferred_element_type=jnp.float32)
+    logits = _tp_gather_logits(jnp.dot(x, unembed, preferred_element_type=jnp.float32), tpc)
     if quant:
         ks, vs, kscs, vscs = ys
         new_cache = {"k": ks, "v": vs, "k_scale": kscs, "v_scale": vscs, "length": lengths + 1}
@@ -366,7 +483,7 @@ def extend(params, cache, slot, tokens, length, cfg: LlamaConfig):
     return logits, {"k": k, "v": v, "length": lens}
 
 
-def decode_attn_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig):
+def decode_attn_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig, tpc: TpSpec | None = None):
     """READ-ONLY half of the paged decode step: attention over the cached
     pages plus the current token's K/V in registers. Returns
     (logits [slots, vocab] f32, k_new [L, slots, kv, hd], v_new same) —
@@ -377,13 +494,16 @@ def decode_attn_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig):
     nondeterministically on the XLA CPU runtime (in-place scatter racing
     page gathers). Keeping each program one-directional removes the
     aliasing hazard on every backend and costs one extra dispatch.
+
+    ``tpc``: shard_map body mode, exactly as on decode_step — per-shard
+    cfg, explicit all-reduce of the attention/MLP partials.
     """
     B = tokens.shape[0]
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     rep = nh // nkv
     quant = "k_scale" in pool
     cos, sin = rotary_embedding(lengths[:, None], cfg.hd, cfg.rope_theta)
-    x = jnp.take(params["embed"], tokens[:, None], axis=0)  # [B, 1, H]
+    x = _tp_embed(params["embed"], tokens[:, None], tpc)  # [B, 1, H]
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
 
     from ray_tpu.llm.paged_kv import _paged_attn_batch
@@ -402,8 +522,8 @@ def decode_attn_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig):
         o = _paged_attn_batch(qg, k_pool_l, v_pool_l, tables, lengths, scale, k_self=kh[:, 0], v_self=v_t[:, 0],
                               k_scale_l=k_sc_l, v_scale_l=v_sc_l)
         o = o.reshape(B, 1, nh * hd).astype(x.dtype)
-        x = x + jnp.dot(o, layer["wo"])
-        x = _mlp(x, layer, cfg)
+        x = x + _tp_reduce(jnp.dot(o, layer["wo"]), tpc)
+        x = _mlp(x, layer, cfg, tpc)
         return x, (kh[:, 0], v_t[:, 0])
 
     xs = (params["layers"], pool["k"], pool["v"])
@@ -412,7 +532,7 @@ def decode_attn_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig):
     x, (k_new, v_new) = jax.lax.scan(layer_fn, x, xs)
     x = rms_norm(x[:, 0], params["final_norm"], cfg.rms_eps)
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
-    logits = jnp.dot(x, unembed, preferred_element_type=jnp.float32)
+    logits = _tp_gather_logits(jnp.dot(x, unembed, preferred_element_type=jnp.float32), tpc)
     return logits, k_new, v_new
 
 
@@ -559,6 +679,7 @@ def fused_step(
     top_k,
     top_p,
     cfg: LlamaConfig,
+    tpc: TpSpec | None = None,
 ):
     """ONE program for the slot layout's whole decode hot path: decode ->
     sample -> append-KV -> advance lengths. Nothing in it touches the
@@ -570,10 +691,15 @@ def fused_step(
     and the engine rebinds its handles each step, so every buffer the
     loop touches stays device-resident with exactly one live copy.
     tokens is deliberately NOT donated (see inline disable above).
+
+    With ``tpc`` this is the shard_map body over the tp mesh: the lanes
+    are replicated, the sampler runs identically on every shard over the
+    all-gathered logits, and the ONE-program-per-token invariant extends
+    across chips — the all-reduce lives inside this jitted step.
     """
     from ray_tpu.llm.sampling import sample
 
-    logits, cache = decode_step(params, cache, tokens, cfg)
+    logits, cache = decode_step(params, cache, tokens, cfg, tpc)
     toks, logps, new_keys = sample(logits, keys, temps, top_k, top_p)
     return cache, toks, logps, new_keys, temps, top_k, top_p
 
@@ -593,8 +719,35 @@ jaxcheck.entry(
 )(fused_step)
 
 
-def make_fused_fns(cfg: LlamaConfig):
-    """Jit of fused_step with the production donation set."""
+def _sharded_fused_slots(cfg: LlamaConfig, mesh, tp_collective: str, kv_quant: bool):
+    """The slot fused step under shard_map over the tp axis (unjitted):
+    params/cache enter at their engine shardings, lanes replicated, and
+    the per-layer all-reduce is the explicit _tp_reduce psum."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import axis_size
+
+    tp = axis_size(mesh, "tp")
+    tpc = TpSpec("tp", tp, tp_collective)
+    cache_sp = _cache_pspecs("slots", kv_quant)
+    rep = P()
+    return _tp_shard_map(
+        partial(fused_step, cfg=_shard_cfg(cfg, tp), tpc=tpc),
+        mesh,
+        in_specs=(_param_pspecs(cfg, mesh), cache_sp, rep, rep, rep, rep, rep),
+        out_specs=(cache_sp, rep, rep, rep, rep, rep, rep),
+    )
+
+
+def make_fused_fns(cfg: LlamaConfig, mesh=None, tp_collective: str = "fp", kv_quant: bool = False):
+    """Jit of fused_step with the production donation set. With a tp>1
+    mesh the step compiles as ONE SPMD program via shard_map — the
+    per-layer tp all-reduce is an explicit psum inside it, quantized to
+    int8 on the wire when tp_collective="int8"."""
+    from ray_tpu.parallel.mesh import axis_size
+
+    if mesh is not None and axis_size(mesh, "tp") > 1:
+        return jax.jit(_sharded_fused_slots(cfg, mesh, tp_collective, kv_quant), donate_argnums=(1, 3, 4, 5, 6))
     return jax.jit(partial(fused_step, cfg=cfg), donate_argnums=(1, 3, 4, 5, 6))
 
 
@@ -615,16 +768,18 @@ def paged_fused_step(
     top_k,
     top_p,
     cfg: LlamaConfig,
+    tpc: TpSpec | None = None,
 ):
     """READ-ONLY half of the paged device-resident step: attention +
     sample + write-target math; the scatter-append into the pool is a
     SEPARATE program (append_paged) — see decode_attn_paged for the
     gather/scatter aliasing hazard that forbids fusing them. Sampling
-    lanes are donated-and-passed-through exactly as in fused_step."""
+    lanes are donated-and-passed-through exactly as in fused_step.
+    ``tpc``: shard_map body mode (see fused_step)."""
     from ray_tpu.llm.sampling import sample
 
     write_page, write_off = decode_write_targets(tables, lengths, pool["k"].shape[2])
-    logits, k_new, v_new = decode_attn_paged(params, pool, tables, lengths, tokens, cfg)
+    logits, k_new, v_new = decode_attn_paged(params, pool, tables, lengths, tokens, cfg, tpc)
     toks, logps, new_keys = sample(logits, keys, temps, top_k, top_p)
     return toks, logps, new_keys, k_new, v_new, write_page, write_off, lengths + 1, temps, top_k, top_p
 
@@ -639,12 +794,42 @@ jaxcheck.entry(
 )(paged_fused_step)
 
 
-def make_fused_paged_fns(cfg: LlamaConfig):
+def _sharded_fused_paged(cfg: LlamaConfig, mesh, tp_collective: str, kv_quant: bool):
+    """paged_fused_step under shard_map over the tp axis (unjitted). The
+    pool enters read-only at its engine sharding; the new-token K/V
+    leaves kv-sharded for the (GSPMD, collective-free) append program."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import axis_size
+
+    tp = axis_size(mesh, "tp")
+    tpc = TpSpec("tp", tp, tp_collective)
+    pool_sp = _cache_pspecs("paged", kv_quant)
+    kv_new = P(None, None, "tp", None)  # k_new/v_new: [L, B, kv, hd]
+    rep = P()
+    return _tp_shard_map(
+        partial(paged_fused_step, cfg=_shard_cfg(cfg, tp), tpc=tpc),
+        mesh,
+        in_specs=(_param_pspecs(cfg, mesh), pool_sp, rep, rep, rep, rep, rep, rep, rep),
+        out_specs=(rep, rep, rep, kv_new, kv_new, rep, rep, rep, rep, rep, rep),
+    )
+
+
+def make_fused_paged_fns(cfg: LlamaConfig, mesh=None, tp_collective: str = "fp", kv_quant: bool = False):
     """Device-resident decode step for the paged layout: TWO programs
     (attention+sample, then scatter-append), neither of which ever syncs
     with the host. tables is read every step and mutated only by
-    scheduler deltas."""
-    attn_fn = jax.jit(partial(paged_fused_step, cfg=cfg), donate_argnums=(3, 5, 6, 7, 8))
+    scheduler deltas. With a tp>1 mesh the attention half compiles under
+    shard_map (explicit per-layer all-reduce, optionally int8 on the
+    wire); the append half stays a plain GSPMD jit — its scatter is
+    elementwise per kv-head, so partitioning it needs no collectives and
+    the documented gather/scatter program split is untouched."""
+    from ray_tpu.parallel.mesh import axis_size
+
+    if mesh is not None and axis_size(mesh, "tp") > 1:
+        attn_fn = jax.jit(_sharded_fused_paged(cfg, mesh, tp_collective, kv_quant), donate_argnums=(3, 5, 6, 7, 8))
+    else:
+        attn_fn = jax.jit(partial(paged_fused_step, cfg=cfg), donate_argnums=(3, 5, 6, 7, 8))
     append_fn = jax.jit(append_paged, donate_argnums=(0,))
     return attn_fn, append_fn
 
@@ -680,6 +865,113 @@ def make_delta_fns():
     replacement for re-uploading whole host arrays every step. Nothing is
     donated (see set_lane's inline rationale)."""
     return jax.jit(set_lane), jax.jit(set_table), jax.jit(set_table_cell)
+
+
+# ---------------------------------------------------------------------------
+# jaxcheck entries for the SHARDED serving path: the fused steps traced
+# over a real 2-way tp mesh (the tracing env guarantees >= 8 virtual CPU
+# devices), so JXC005 finally audits the serving-path collectives against
+# their declared mesh axes — psum/all_gather/all_to_all/axis_index must
+# all run over 'tp' and nothing else, and the donation/padding/upcast
+# rules re-check the program in its multi-chip form.
+# ---------------------------------------------------------------------------
+def _tp2_mesh():
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise RuntimeError("tp entries trace over 2 devices; the tracing env provides 8 virtual CPU devices")
+    return Mesh(np.asarray(devs[:2]), ("tp",))
+
+
+def _bucket_fused_tp(B=8, S=256):
+    cfg = _trace_cfg()
+    return (_sds_params(cfg), _sds_cache(cfg, B, S)) + _sds_lanes(B), {}
+
+
+def _bucket_paged_fused_tp(B=8, pages=64, page=16):
+    cfg = _trace_cfg()
+    tables = _sds((B, pages // B * 2), jnp.int32)
+    lengths = _sds((B,), jnp.int32)
+    tokens, keys, temps, top_k, top_p = _sds_lanes(B)
+    return (
+        _sds_params(cfg), _sds_pool(cfg, pages, page), tables, lengths,
+        tokens, keys, temps, top_k, top_p,
+    ), {}
+
+
+@jaxcheck.entry(
+    name="llm.fused_step_tp",
+    shapes={"b8_s256_tp2": _bucket_fused_tp},
+    donate=("cache", "keys", "temps", "top_k", "top_p"),
+    donate_bytes=0,
+    mesh_axes=("tp",),
+)
+def fused_step_tp(
+    params,
+    cache,
+    tokens,  # tpulint: disable=JXC001 — same delayed-readback rationale as fused_step's token lane
+    keys,
+    temps,
+    top_k,
+    top_p,
+):
+    """make_fused_fns(mesh=2-way tp) in registry-traceable form: the fp
+    collective schedule (explicit per-layer psum over 'tp')."""
+    return _sharded_fused_slots(_trace_cfg(), _tp2_mesh(), "fp", False)(
+        params, cache, tokens, keys, temps, top_k, top_p
+    )
+
+
+@jaxcheck.entry(
+    name="llm.fused_step_tp_int8c",
+    shapes={"b8_s256_tp2": _bucket_fused_tp},
+    donate=("cache", "keys", "temps", "top_k", "top_p"),
+    donate_bytes=0,
+    mesh_axes=("tp",),
+)
+def fused_step_tp_int8c(
+    params,
+    cache,
+    tokens,  # tpulint: disable=JXC001 — same delayed-readback rationale as fused_step's token lane
+    keys,
+    temps,
+    top_k,
+    top_p,
+):
+    """The int8-collective variant (tp_collective="int8"): the per-layer
+    all-reduce ships int8 + f32 amax scales over ICI. The dequants feed
+    residual adds and the exact f32 chunk accumulate — never a
+    flops-dominant dot, so JXC003 stays clean by construction here."""
+    return _sharded_fused_slots(_trace_cfg(), _tp2_mesh(), "int8", False)(
+        params, cache, tokens, keys, temps, top_k, top_p
+    )
+
+
+@jaxcheck.entry(
+    name="llm.paged_fused_step_tp",
+    shapes={"b8_p64_tp2": _bucket_paged_fused_tp},
+    donate=("lengths", "keys", "temps", "top_k", "top_p"),
+    donate_bytes=0,
+    mesh_axes=("tp",),
+)
+def paged_fused_step_tp(
+    params,
+    pool,  # read-only by design (the gather/scatter aliasing hazard); donated by the append program instead
+    tables,
+    lengths,
+    tokens,  # tpulint: disable=JXC001 — same delayed-readback rationale as fused_step's token lane
+    keys,
+    temps,
+    top_k,
+    top_p,
+):
+    """make_fused_paged_fns(mesh=2-way tp)'s attention half in
+    registry-traceable form (the append half is collective-free GSPMD)."""
+    return _sharded_fused_paged(_trace_cfg(), _tp2_mesh(), "fp", False)(
+        params, pool, tables, lengths, tokens, keys, temps, top_k, top_p
+    )
 
 
 def make_runner_fns(cfg: LlamaConfig):

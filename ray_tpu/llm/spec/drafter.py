@@ -44,10 +44,19 @@ from ray_tpu.models.llama import LlamaConfig
 
 @runtime_checkable
 class Drafter(Protocol):
-    """What LLMEngine needs from a drafter implementation."""
+    """What LLMEngine needs from a drafter implementation.
+
+    ``supports_mesh``: whether the drafter composes with a tensor-parallel
+    engine mesh. A drafter qualifies when its per-lane state is replicated
+    (or absent) — the engine's hist lanes are replicated over tp and the
+    verify step itself compiles SPMD, so a zero-weight drafter rides along
+    unchanged. A drafter with its own sharded-model state must implement
+    mesh-aware prefill/propose before flipping this on.
+    """
 
     kind: str
     k: int
+    supports_mesh: bool
 
     def init_slots(self, num_slots: int, max_seq_len: int, prefill_buckets: tuple) -> None: ...
 
@@ -98,9 +107,12 @@ def ngram_propose(hist, hist_len, n: int, k: int):
 
 
 class NGramDrafter:
-    """Prompt-lookup drafter: stateless beyond the engine's hist lanes."""
+    """Prompt-lookup drafter: stateless beyond the engine's hist lanes.
+    Mesh-safe: the hist/length lanes are replicated over tp and propose
+    has no weights — the same jitted program runs on every shard."""
 
     kind = "ngram"
+    supports_mesh = True
 
     def __init__(self, k: int = 4, n: int = 3):
         self.k = int(k)
@@ -184,6 +196,10 @@ class ModelDrafter:
     """
 
     kind = "model"
+    # the draft model's params, slot KV cache and fused draft_steps chain
+    # are single-device today; the engine raises NotImplementedError on a
+    # mesh rather than silently replicating a second model per chip
+    supports_mesh = False
 
     def __init__(self, config: LlamaConfig, params=None, k: int = 4, seed: int = 0):
         from ray_tpu.models.llama import init_params

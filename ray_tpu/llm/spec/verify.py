@@ -36,7 +36,10 @@ import jax.numpy as jnp
 
 from ray_tpu.lint import jaxcheck
 from ray_tpu.llm.model_runner import (
+    TpSpec,
+    _cache_pspecs,
     _mlp,
+    _param_pspecs,
     _qkv,
     _sds,
     _sds_cache,
@@ -45,6 +48,12 @@ from ray_tpu.llm.model_runner import (
     _sds_params,
     _sds_pool,
     _sds_pool_q,
+    _shard_cfg,
+    _tp2_mesh,
+    _tp_embed,
+    _tp_gather_logits,
+    _tp_reduce,
+    _tp_shard_map,
     _trace_cfg,
 )
 from ray_tpu.models.llama import LlamaConfig
@@ -131,7 +140,7 @@ def _update_hist(hist, hist_len, emit, acc):
 # ---------------------------------------------------------------------------
 # slot layout
 # ---------------------------------------------------------------------------
-def _forward_block_slots(params, cache, toks_blk, cfg: LlamaConfig):
+def _forward_block_slots(params, cache, toks_blk, cfg: LlamaConfig, tpc: TpSpec | None = None):
     """Target forward over T=k+1 tokens per slot at positions
     length..length+T-1. Block K/V is written into the cache rows first
     (per-position scatter, OOB dropped) and attention reads the updated
@@ -139,8 +148,11 @@ def _forward_block_slots(params, cache, toks_blk, cfg: LlamaConfig):
     decode_step/fused_step already rely on (no pool-style aliasing
     hazard in the slot layout). An int8 cache quantizes the block's K/V
     on the same scatter and dequantizes the row for attention, exactly
-    as decode_step does per token. Returns (logits [B, T, V] f32, ks,
-    vs) — plus (k_scales, v_scales) [L, B, kv, S] when quantized."""
+    as decode_step does per token. ``tpc``: shard_map body mode, as on
+    decode_step — verify compiles SPMD like the fused step, with the
+    per-layer all-reduce explicit (and optionally int8 on the wire).
+    Returns (logits [B, T, V] f32, ks, vs) — plus (k_scales, v_scales)
+    [L, B, kv, S] when quantized."""
     B, T = toks_blk.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     rep = nh // nkv
@@ -149,7 +161,7 @@ def _forward_block_slots(params, cache, toks_blk, cfg: LlamaConfig):
     lengths = cache["length"]
     positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
     cos, sin = rotary_embedding(positions, cfg.hd, cfg.rope_theta)  # [B, T, hd/2]
-    x = jnp.take(params["embed"], toks_blk, axis=0)  # [B, T, H]
+    x = _tp_embed(params["embed"], toks_blk, tpc)  # [B, T, H]
     rows = jnp.arange(B, dtype=jnp.int32)[:, None]
     # query i sits at position length+i and may attend cache 0..length+i
     attn_ok = (jnp.arange(S, dtype=jnp.int32)[None, None, :] <= positions[:, :, None])[:, None, None]  # [B,1,1,T,S]
@@ -185,8 +197,8 @@ def _forward_block_slots(params, cache, toks_blk, cfg: LlamaConfig):
         scores = jnp.where(attn_ok, scores, -jnp.inf)
         o = jnp.einsum("bgrts,bgsh->bgrth", jax.nn.softmax(scores, axis=-1), vc.astype(jnp.float32))
         o = o.transpose(0, 3, 1, 2, 4).reshape(B, T, nh * hd).astype(x.dtype)
-        x = x + jnp.dot(o, layer["wo"])
-        x = _mlp(x, layer, cfg)
+        x = x + _tp_reduce(jnp.dot(o, layer["wo"]), tpc)
+        x = _mlp(x, layer, cfg, tpc)
         return x, ((k_cache, v_cache, k_sc, v_sc) if quant else (k_cache, v_cache))
 
     xs = (params["layers"], cache["k"], cache["v"])
@@ -195,7 +207,7 @@ def _forward_block_slots(params, cache, toks_blk, cfg: LlamaConfig):
     x, ys = jax.lax.scan(layer_fn, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
-    logits = jnp.einsum("bth,hv->btv", x, unembed, preferred_element_type=jnp.float32)
+    logits = _tp_gather_logits(jnp.einsum("bth,hv->btv", x, unembed, preferred_element_type=jnp.float32), tpc)
     return (logits,) + tuple(ys)
 
 
@@ -228,6 +240,7 @@ def spec_verify_slots(
     hist,
     hist_len,
     cfg: LlamaConfig,
+    tpc: TpSpec | None = None,
 ):
     """ONE program for the slot layout's speculative tick: wide target
     forward over (t0, d1..dk) -> accept/sample -> append block KV ->
@@ -235,7 +248,7 @@ def spec_verify_slots(
     TOKEN lane is also donated: the host reads the round's results from
     the dedicated emit/logps/acc outputs, never from the token lane."""
     toks_blk = jnp.concatenate([tokens[:, None], proposals], axis=1)
-    logits, *kv_out = _forward_block_slots(params, cache, toks_blk, cfg)
+    logits, *kv_out = _forward_block_slots(params, cache, toks_blk, cfg, tpc)
     emit, logps, acc, final, new_keys = _accept_and_sample(
         logits, proposals, spec_k, keys, temps, top_k, top_p
     )
@@ -266,10 +279,36 @@ jaxcheck.entry(
 )(spec_verify_slots)
 
 
-def make_spec_verify_slots(cfg: LlamaConfig, k: int):
+def _sharded_spec_verify_slots(cfg: LlamaConfig, mesh, tp_collective: str, kv_quant: bool):
+    """spec_verify_slots under shard_map over the tp axis (unjitted) —
+    the verify step compiles SPMD exactly like the fused decode step."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import axis_size
+
+    tp = axis_size(mesh, "tp")
+    tpc = TpSpec("tp", tp, tp_collective)
+    cache_sp = _cache_pspecs("slots", kv_quant)
+    rep = P()
+    return _tp_shard_map(
+        partial(spec_verify_slots, cfg=_shard_cfg(cfg, tp), tpc=tpc),
+        mesh,
+        in_specs=(_param_pspecs(cfg, mesh), cache_sp) + (rep,) * 9,
+        out_specs=(cache_sp,) + (rep,) * 11,
+    )
+
+
+def make_spec_verify_slots(cfg: LlamaConfig, k: int, mesh=None, tp_collective: str = "fp", kv_quant: bool = False):
     """Jit of spec_verify_slots with the production donation set (the
-    static width k is baked into the proposals shape by the caller)."""
+    static width k is baked into the proposals shape by the caller).
+    With a tp>1 mesh the tick compiles under shard_map — same explicit
+    collective schedule as make_fused_fns."""
     del k  # shapes carry it; one compile per configured width
+    from ray_tpu.parallel.mesh import axis_size
+
+    if mesh is not None and axis_size(mesh, "tp") > 1:
+        body = _sharded_spec_verify_slots(cfg, mesh, tp_collective, kv_quant)
+        return jax.jit(body, donate_argnums=(1, 3, 4, 5, 6, 7, 8, 9, 10))
     return jax.jit(partial(spec_verify_slots, cfg=cfg), donate_argnums=(1, 3, 4, 5, 6, 7, 8, 9, 10))
 
 
@@ -308,13 +347,15 @@ def spec_verify_paged(
     hist,
     hist_len,
     cfg: LlamaConfig,
+    tpc: TpSpec | None = None,
 ):
     """READ-ONLY half of the paged speculative tick: block attention over
     the cached pages (prefix from the pool, the block itself in
     registers via `_paged_attn_seq`, vmapped over lanes) + accept/sample
     + write-target math; the pool scatter is spec_append_paged. Rows past
     a lane's table edge redirect to the trash page — those positions only
-    arise in rounds whose tokens the host already discarded."""
+    arise in rounds whose tokens the host already discarded. ``tpc``:
+    shard_map body mode, as on decode_step/_forward_block_slots."""
     from ray_tpu.llm.paged_kv import _paged_attn_seq
 
     B, k = proposals.shape
@@ -327,7 +368,7 @@ def spec_verify_paged(
     toks_blk = jnp.concatenate([tokens[:, None], proposals], axis=1)
     positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
     cos, sin = rotary_embedding(positions, cfg.hd, cfg.rope_theta)
-    x = jnp.take(params["embed"], toks_blk, axis=0)  # [B, T, H]
+    x = _tp_embed(params["embed"], toks_blk, tpc)  # [B, T, H]
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
 
     def layer_fn(x, xs):
@@ -345,8 +386,8 @@ def spec_verify_paged(
             qg, k_pool_l, v_pool_l, tables, lengths, kh, v_t, scale, k_sc_l, v_sc_l
         )  # [B, nkv, rep, T, hd]
         o = o.transpose(0, 3, 1, 2, 4).reshape(B, T, nh * hd).astype(x.dtype)
-        x = x + jnp.dot(o, layer["wo"])
-        x = _mlp(x, layer, cfg)
+        x = x + _tp_reduce(jnp.dot(o, layer["wo"]), tpc)
+        x = _mlp(x, layer, cfg, tpc)
         return x, (kh, v_t)
 
     xs = (params["layers"], pool["k"], pool["v"])
@@ -355,7 +396,7 @@ def spec_verify_paged(
     x, (k_blk, v_blk) = jax.lax.scan(layer_fn, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
-    logits = jnp.einsum("bth,hv->btv", x, unembed, preferred_element_type=jnp.float32)
+    logits = _tp_gather_logits(jnp.einsum("bth,hv->btv", x, unembed, preferred_element_type=jnp.float32), tpc)
     emit, logps, acc, final, new_keys = _accept_and_sample(
         logits, proposals, spec_k, keys, temps, top_k, top_p
     )
@@ -417,13 +458,124 @@ jaxcheck.entry(
 )(spec_verify_paged)
 
 
-def make_spec_verify_paged(cfg: LlamaConfig, k: int):
+def _sharded_spec_verify_paged(cfg: LlamaConfig, mesh, tp_collective: str, kv_quant: bool):
+    """spec_verify_paged under shard_map over the tp axis (unjitted); the
+    block K/V leaves kv-sharded for the collective-free GSPMD append."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import axis_size
+
+    tp = axis_size(mesh, "tp")
+    tpc = TpSpec("tp", tp, tp_collective)
+    pool_sp = _cache_pspecs("paged", kv_quant)
+    kv_blk = P(None, None, None, "tp", None)  # k_blk/v_blk: [L, B, T, kv, hd]
+    rep = P()
+    return _tp_shard_map(
+        partial(spec_verify_paged, cfg=_shard_cfg(cfg, tp), tpc=tpc),
+        mesh,
+        in_specs=(_param_pspecs(cfg, mesh), pool_sp) + (rep,) * 11,
+        out_specs=(rep,) * 5 + (kv_blk, kv_blk) + (rep,) * 9,
+    )
+
+
+def make_spec_verify_paged(cfg: LlamaConfig, k: int, mesh=None, tp_collective: str = "fp", kv_quant: bool = False):
     """(attention+accept program, scatter-append program) for the paged
-    layout — two dispatches, never fused (see decode_attn_paged)."""
+    layout — two dispatches, never fused (see decode_attn_paged). With a
+    tp>1 mesh the attention half compiles under shard_map, same explicit
+    collective schedule as the fused step."""
     del k
-    attn_fn = jax.jit(partial(spec_verify_paged, cfg=cfg), donate_argnums=(3, 5, 6, 7, 8, 9, 10, 11, 12))
+    from ray_tpu.parallel.mesh import axis_size
+
+    if mesh is not None and axis_size(mesh, "tp") > 1:
+        attn_fn = jax.jit(
+            _sharded_spec_verify_paged(cfg, mesh, tp_collective, kv_quant),
+            donate_argnums=(3, 5, 6, 7, 8, 9, 10, 11, 12),
+        )
+    else:
+        attn_fn = jax.jit(partial(spec_verify_paged, cfg=cfg), donate_argnums=(3, 5, 6, 7, 8, 9, 10, 11, 12))
     append_fn = jax.jit(spec_append_paged, donate_argnums=(0,))
     return attn_fn, append_fn
+
+
+# ---------------------------------------------------------------------------
+# jaxcheck entries for the SHARDED verify steps (see model_runner's tp
+# entries): JXC005 audits the spec tick's collectives against the
+# declared tp axis, and the donation/upcast rules re-check the SPMD form.
+# ---------------------------------------------------------------------------
+def _bucket_spec_verify_tp(B=8, S=256, k=4, H=517):
+    cfg = _trace_cfg()
+    tokens, keys, temps, top_k, top_p = _sds_lanes(B)
+    return (
+        _sds_params(cfg), _sds_cache(cfg, B, S), _sds((B, k), jnp.int32),
+        tokens, keys, temps, top_k, top_p, _sds((B,), jnp.int32),
+        _sds((B, H), jnp.int32), _sds((B,), jnp.int32),
+    ), {}
+
+
+@jaxcheck.entry(
+    name="llm.spec_verify_tp",
+    shapes={"b8_s256_tp2": _bucket_spec_verify_tp},
+    donate=("cache", "tokens", "keys", "temps", "top_k", "top_p", "spec_k", "hist", "hist_len"),
+    donate_bytes=0,
+    mesh_axes=("tp",),
+)
+def spec_verify_tp(
+    params,
+    cache,
+    proposals,  # fresh drafter output, never re-read by the host: no buffer to save by donating
+    tokens,
+    keys,
+    temps,
+    top_k,
+    top_p,
+    spec_k,
+    hist,
+    hist_len,
+):
+    """make_spec_verify_slots(mesh=2-way tp) in registry-traceable form."""
+    return _sharded_spec_verify_slots(_trace_cfg(), _tp2_mesh(), "fp", False)(
+        params, cache, proposals, tokens, keys, temps, top_k, top_p, spec_k, hist, hist_len
+    )
+
+
+def _bucket_spec_verify_paged_tp(B=8, pages=64, page=16, k=4, H=517):
+    cfg = _trace_cfg()
+    tokens, keys, temps, top_k, top_p = _sds_lanes(B)
+    return (
+        _sds_params(cfg), _sds_pool(cfg, pages, page), _sds((B, pages // B * 2), jnp.int32),
+        _sds((B,), jnp.int32), _sds((B, k), jnp.int32),
+        tokens, keys, temps, top_k, top_p, _sds((B,), jnp.int32),
+        _sds((B, H), jnp.int32), _sds((B,), jnp.int32),
+    ), {}
+
+
+@jaxcheck.entry(
+    name="llm.spec_verify_paged_tp",
+    shapes={"b8_p64_tp2": _bucket_spec_verify_paged_tp},
+    donate=("lengths", "tokens", "keys", "temps", "top_k", "top_p", "spec_k", "hist", "hist_len"),
+    donate_bytes=0,
+    mesh_axes=("tp",),
+)
+def spec_verify_paged_tp(
+    params,
+    pool,  # read-only by design (the gather/scatter aliasing hazard); donated by the append program instead
+    tables,
+    lengths,
+    proposals,  # fresh drafter output (see spec_verify_slots)
+    tokens,
+    keys,
+    temps,
+    top_k,
+    top_p,
+    spec_k,
+    hist,
+    hist_len,
+):
+    """make_spec_verify_paged(mesh=2-way tp)'s attention half in
+    registry-traceable form (the append half is collective-free GSPMD)."""
+    return _sharded_spec_verify_paged(_trace_cfg(), _tp2_mesh(), "fp", False)(
+        params, pool, tables, lengths, proposals, tokens, keys, temps, top_k, top_p, spec_k, hist, hist_len
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -97,6 +97,18 @@ def axis_or_none(mesh: Mesh, *names: str):
     return tuple(present) if len(present) > 1 else present[0]
 
 
+def axis_size(mesh: Mesh, name: str) -> int:
+    """Size of one named axis (1 when absent — the degenerate no-op)."""
+    return mesh_axes(mesh).get(name, 1)
+
+
+def is_tp_only(mesh: Mesh) -> bool:
+    """True when the mesh is a pure tensor-parallel mesh (the serving
+    shard_map hot path engages only here: other axes would shard params
+    on dims the manual per-shard programs assume replicated)."""
+    return set(mesh.axis_names) == {"tp"}
+
+
 # ----------------------------------------------------------------------
 # logical sharding rules
 # ----------------------------------------------------------------------

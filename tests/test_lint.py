@@ -161,7 +161,7 @@ def test_jaxcheck_self_check_runs_clean():
     )
 
 
-def test_jaxcheck_traces_at_least_twenty_four_entries():
+def test_jaxcheck_traces_at_least_twenty_eight_entries():
     from ray_tpu.lint.jaxcheck import import_entry_modules, registry
 
     import_entry_modules()
@@ -170,9 +170,12 @@ def test_jaxcheck_traces_at_least_twenty_four_entries():
     # disaggregated serving (llm/disagg/scatter.py) adds its extract +
     # scatter-in pairs; the int8 KV cache registers quantized variants of
     # every hot-path program it touches (fused decode x2, spec verify x2,
-    # disagg extract x2 + scatter x2) — any entry silently dropping out
-    # of the registry is an invariant check that stopped running
-    assert len(entries) >= 24, [e.name for e in entries]
+    # disagg extract x2 + scatter x2); tensor-parallel serving adds the
+    # shard_map'd fused/paged-fused/spec-verify steps over mesh buckets
+    # (where JXC005 finally audits real serving-path collectives) — any
+    # entry silently dropping out of the registry is an invariant check
+    # that stopped running
+    assert len(entries) >= 28, [e.name for e in entries]
     subsystems = {e.name.split(".")[0] for e in entries}
     assert {"llm", "parallel", "collective"} <= subsystems
     names = {e.name for e in entries}
@@ -187,6 +190,16 @@ def test_jaxcheck_traces_at_least_twenty_four_entries():
         "llm.disagg_extract_slots_int8", "llm.disagg_extract_paged_int8",
         "llm.disagg_scatter_slots_int8", "llm.disagg_scatter_paged_int8",
     } <= names
+    assert {
+        "llm.fused_step_tp", "llm.fused_step_tp_int8c", "llm.paged_fused_step_tp",
+        "llm.spec_verify_tp", "llm.spec_verify_paged_tp",
+    } <= names
+    # the tp entries declare their mesh axis, so JXC005 has teeth on them
+    by_name = {e.name: e for e in entries}
+    assert all(by_name[n].mesh_axes == ("tp",) for n in (
+        "llm.fused_step_tp", "llm.fused_step_tp_int8c", "llm.paged_fused_step_tp",
+        "llm.spec_verify_tp", "llm.spec_verify_paged_tp",
+    ))
 
 
 def test_cli_jax_flag_and_rt_wiring():
@@ -197,7 +210,7 @@ def test_cli_jax_flag_and_rt_wiring():
     )
     assert r.returncode == 0, r.stdout + r.stderr
     m = re.search(r"jaxcheck traced (\d+) entry point", r.stderr)
-    assert m and int(m.group(1)) >= 24, r.stderr
+    assert m and int(m.group(1)) >= 28, r.stderr
 
 
 def test_cli_list_rules_includes_jax_catalog(capsys):
