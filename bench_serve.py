@@ -576,6 +576,18 @@ def _dist(ttfts, itls) -> dict:
     }
 
 
+def _tel_latencies(eng, rids, short_ids):
+    """TTFT/ITL samples (seconds) from the engine's flight recorder
+    (llm/telemetry.py) for the benchmarked requests: the SAME numbers a
+    live /metrics scrape aggregates, so the committed bench and the
+    production dashboards can never drift apart silently. ITL is taken
+    over the decode-heavy streams only (mirrors the stopwatch path)."""
+    recs = eng.telemetry().get("requests", [])
+    ttfts = [r["ttft_s"] for r in recs if r["request_id"] in rids and r["ttft_s"] is not None]
+    itls = [x for r in recs if r["request_id"] in short_ids for x in r["itl_s"]]
+    return ttfts, itls
+
+
 def bench_disagg(cfg, prompt_len: int, gen_len: int, max_num_seqs: int = 4, n_long: int = 6) -> dict:
     """Disaggregated prefill/decode A/B on a MIXED workload: latency-
     sensitive decode streams with long-prompt prefills arriving mid-
@@ -649,7 +661,7 @@ def bench_disagg(cfg, prompt_len: int, gen_len: int, max_num_seqs: int = 4, n_lo
             outs = eng.step()
             _record(outs, time.perf_counter(), submit, last_tok, short_ids, ttfts, itls)
             steps += 1
-        return ttfts, itls
+        return ttfts, itls, _tel_latencies(eng, set(submit), short_ids)
 
     def run_disagg():
         pre, dec = _engine(), _engine()
@@ -670,8 +682,11 @@ def bench_disagg(cfg, prompt_len: int, gen_len: int, max_num_seqs: int = 4, n_lo
                     item = in_q.get()
                     if item is None:
                         return
-                    kind, prompt = item
-                    kv = decode_handoff(encode_handoff(pre.prefill_handoff(prompt)))
+                    kind, prompt, arrived = item
+                    # the arrival wall stamp rides the handoff so the
+                    # decode engine's telemetry TTFT spans queue + prefill
+                    # + ship, matching what the bench stopwatch measures
+                    kv = decode_handoff(encode_handoff(pre.prefill_handoff(prompt, submitted_at=arrived)))
                     ready.put((kind, kv))
             except BaseException as e:  # noqa: BLE001
                 # surface through the ready queue: the decode loop must
@@ -688,7 +703,7 @@ def bench_disagg(cfg, prompt_len: int, gen_len: int, max_num_seqs: int = 4, n_lo
         pending_t = {"short": _deque(), "long": _deque()}
         for p in shorts:
             pending_t["short"].append(time.perf_counter())
-            in_q.put(("short", p))
+            in_q.put(("short", p, time.time()))
         li = steps = done = 0
         n_total = len(shorts) + len(longs)
         while done < n_total or li < len(longs):
@@ -696,7 +711,7 @@ def bench_disagg(cfg, prompt_len: int, gen_len: int, max_num_seqs: int = 4, n_lo
             # early) flushes the remaining arrivals immediately
             if li < len(longs) and (steps >= (li + 1) * inject_every or not dec.has_unfinished()):
                 pending_t["long"].append(time.perf_counter())
-                in_q.put(("long", longs[li]))
+                in_q.put(("long", longs[li], time.time()))
                 li += 1
             try:
                 kind, kv = ready.get_nowait()
@@ -718,11 +733,31 @@ def bench_disagg(cfg, prompt_len: int, gen_len: int, max_num_seqs: int = 4, n_lo
             steps += 1
         in_q.put(None)
         th.join(timeout=10)
-        return ttfts, itls
+        return ttfts, itls, _tel_latencies(dec, set(submit), short_ids)
 
-    s_ttft, s_itl = run_single()
-    d_ttft, d_itl = run_disagg()
-    single, split = _dist(s_ttft, s_itl), _dist(d_ttft, d_itl)
+    s_ttft, s_itl, s_tel = run_single()
+    d_ttft, d_itl, d_tel = run_disagg()
+    # committed numbers come from the ENGINE'S FLIGHT RECORDER (the same
+    # samples the live rt_llm_ttft_s/rt_llm_itl_s series aggregate); the
+    # bench's own stopwatch survives only as a cross-check so the two
+    # measurement paths can never drift apart silently
+    single, split = _dist(*s_tel), _dist(*d_tel)
+    single_sw, split_sw = _dist(s_ttft, s_itl), _dist(d_ttft, d_itl)
+    # agreement gate over BOTH modes on the p50s (p99 is a ~single-sample
+    # max statistic that the two clocks punctuate differently around long
+    # stalls; p50 catches systematic drift — wrong units, a mis-stamped
+    # handoff submitted_at, double-counted ITLs). Telemetry stamps a token
+    # when the consumer can actually see it (out_queue.put at drain); the
+    # stopwatch stamps at step return — expect telemetry <= stopwatch by
+    # up to one step of skew, inside this tolerance.
+    for mode, sw_d, tel_d in (("single_engine", single_sw, single), ("disagg_split", split_sw, split)):
+        for key in ("ttft_ms_p50", "itl_ms_p50"):
+            sw, tel = sw_d[key], tel_d[key]
+            assert sw is not None and tel is not None and abs(sw - tel) <= max(0.5 * max(sw, tel), 25.0), (
+                f"bench stopwatch and engine telemetry disagree on {mode} {key}: "
+                f"stopwatch {sw} ms vs telemetry {tel} ms"
+            )
+    single["telemetry"] = split["telemetry"] = True  # provenance
     ratio = (single["itl_ms_p99"] / split["itl_ms_p99"]) if split["itl_ms_p99"] else None
     rec = {
         "metric": "engine_disagg_ab",
@@ -737,6 +772,7 @@ def bench_disagg(cfg, prompt_len: int, gen_len: int, max_num_seqs: int = 4, n_lo
         ),
         "single_engine": single,
         "disagg_split": split,
+        "stopwatch_crosscheck": {"single_engine": single_sw, "disagg_split": split_sw},
         "decode_itl_p99_speedup": round(ratio, 2) if ratio else None,
         "batch": max_num_seqs,
     }

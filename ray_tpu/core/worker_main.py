@@ -924,4 +924,21 @@ def worker_entry(conn, worker_id: str, node_id: str, env: dict | None = None):
         serve=True,
         exec_handler=client._direct_exec_handler,
     )
-    client.run()
+    try:
+        client.run()
+    finally:
+        # final observability flush: the worker's last spans (e.g. a
+        # decode replica's finish span) and its last second of metric
+        # increments must not die with the process
+        try:
+            from ray_tpu.util import tracing as _tracing
+
+            _tracing.shutdown()
+        except Exception:
+            pass
+        try:
+            from ray_tpu.util.metrics import _registry as _metrics_registry
+
+            _metrics_registry.flush_once()
+        except Exception:
+            pass

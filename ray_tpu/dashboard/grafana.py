@@ -19,6 +19,21 @@ from __future__ import annotations
 
 import json
 
+# the hand-built core panels' series (refreshed per scrape by
+# util/metrics.update_core_metrics); scripts/lint_gate.py's dashboard
+# smoke checks every panel expr against CORE_SERIES + the serving
+# telemetry catalog + the live registry
+CORE_SERIES = (
+    "rt_tasks_finished_total",
+    "rt_tasks_submitted_total",
+    "rt_tasks_running",
+    "rt_tasks_pending",
+    "rt_object_store_bytes",
+    "rt_object_store_spilled_bytes",
+    "rt_transfer_pull_bytes_total",
+    "rt_transfer_serve_bytes_total",
+)
+
 
 def _panel(pid: int, title: str, exprs: list[tuple[str, str]], *, y: int, x: int = 0, w: int = 12, h: int = 8, unit: str = "short", datasource: str = "Prometheus") -> dict:
     return {
@@ -56,6 +71,44 @@ def grafana_dashboard_json(client=None, *, datasource: str = "Prometheus", title
     add("Tasks in flight", [("rt_tasks_running", "running"), ("rt_tasks_pending", "pending")], w=12, x=12)
     add("Object store", [("rt_object_store_bytes", "shm bytes"), ("rt_object_store_spilled_bytes", "spilled")], unit="bytes", w=12, x=0)
     add("Object transfers", [("rate(rt_transfer_pull_bytes_total[1m])", "pull B/s"), ("rate(rt_transfer_serve_bytes_total[1m])", "serve B/s")], unit="Bps", w=12, x=12)
+
+    # -- Serving row: the LLM hot path's SLOs (llm/telemetry.py catalog;
+    # series tagged by model/replica/stage, so legends stay per-replica) --
+    add("Serving: time to first token", [
+        ("histogram_quantile(0.5, rate(rt_llm_ttft_s_bucket[5m]))", "p50"),
+        ("histogram_quantile(0.99, rate(rt_llm_ttft_s_bucket[5m]))", "p99"),
+    ], unit="s", w=12, x=0)
+    add("Serving: inter-token latency", [
+        ("histogram_quantile(0.5, rate(rt_llm_itl_s_bucket[5m]))", "p50"),
+        ("histogram_quantile(0.99, rate(rt_llm_itl_s_bucket[5m]))", "p99"),
+    ], unit="s", w=12, x=12)
+    add("Serving: admission queue", [
+        ("histogram_quantile(0.99, rate(rt_llm_queue_wait_s_bucket[5m]))", "queue wait p99"),
+        ("rt_llm_queue_depth", "depth"),
+    ], w=12, x=0)
+    add("Serving: token throughput", [
+        ("rate(rt_llm_tokens_total[1m])", "decode tok/s"),
+        ("rate(rt_llm_prefill_tokens_total[1m])", "prefill tok/s"),
+    ], w=12, x=12)
+    add("Serving: KV occupancy", [
+        ("rt_llm_kv_occupancy", "occupied fraction"),
+        ("rt_llm_slots_in_use", "slots in use"),
+    ], w=12, x=0)
+    add("Serving: KV HBM bytes", [("rt_llm_kv_hbm_bytes", "occupied bytes")], unit="bytes", w=12, x=12)
+    add("Serving: speculation & preemption", [
+        ("rt_llm_spec_acceptance", "spec acceptance"),
+        ("rate(rt_llm_preemptions_total[5m])", "preemptions/s"),
+    ], w=12, x=0)
+    add("Serving: recompile sentinel", [
+        ("increase(rt_llm_recompiles_total[5m])", "recompiles (5m)"),
+    ], w=12, x=12)
+    add("Serving: collective wire", [
+        ("rate(rt_llm_collective_wire_bytes_total[1m])", "ICI B/s"),
+    ], unit="Bps", w=12, x=0)
+    add("Serving: disagg handoffs", [
+        ("rate(rt_llm_handoff_bytes_total[1m])", "handoff B/s"),
+        ("rate(rt_llm_handoffs_total[1m])", "events/s"),
+    ], w=12, x=12)
 
     # -- one panel per registered metric (user Counters/Gauges/Histograms) --
     try:

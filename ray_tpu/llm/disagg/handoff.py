@@ -41,6 +41,22 @@ class HandoffLostError(RuntimeError):
     after exhausting their budget; the router reacts by re-prefilling."""
 
 
+def _handoff_span(name: str, payload: dict, t0: float, **attrs) -> None:
+    """Record a handoff data-plane span under the trace context the wire
+    dict carries (no-op unless RT_TRACING=1 and the producer traced)."""
+    from ray_tpu.util import tracing
+
+    tr = payload.get("trace")
+    if not tracing.enabled() or not isinstance(tr, dict):
+        return
+    import uuid
+
+    tracing.record_span(
+        name, "internal", str(tr["trace_id"]), uuid.uuid4().hex[:16], tr.get("parent_id"),
+        int(t0 * 1e9), time.time_ns(), dict(attrs),
+    )
+
+
 def _scale_shape(shape: tuple) -> tuple:
     """Expected wire scale shape [L, kv, T_pad] for a k block [L, T_pad,
     kv, hd] — one f32 per (layer, head, position), position axis last
@@ -75,6 +91,14 @@ def encode(kv: dict) -> dict:
         "v": v,
         "logits": logits,
     }
+    # telemetry plumbing (llm/telemetry.py): the producer's trace context
+    # and original submit stamp ride the wire so the decode replica's
+    # spans join the SAME trace id and TTFT spans the whole pipeline
+    if isinstance(kv.get("trace"), dict) and kv["trace"].get("trace_id"):
+        wire["trace"] = {"trace_id": str(kv["trace"]["trace_id"]),
+                         "parent_id": kv["trace"].get("parent_id")}
+    if kv.get("submitted_at") is not None:
+        wire["submitted_at"] = float(kv["submitted_at"])
     if (kv.get("k_scale") is not None) != (kv.get("v_scale") is not None):
         raise HandoffError("k_scale and v_scale must be supplied together")
     if kv.get("k_scale") is not None:
@@ -115,6 +139,10 @@ def decode(payload: dict) -> dict:
     if not 0 < n <= shape[1] or n != len(prompt):
         raise HandoffError(f"length {n} inconsistent with block width {shape[1]} / prompt {len(prompt)}")
     out = {"k": k, "v": v, "n": n, "logits": payload["logits"], "prompt_token_ids": list(prompt)}
+    if isinstance(payload.get("trace"), dict) and payload["trace"].get("trace_id"):
+        out["trace"] = dict(payload["trace"])
+    if payload.get("submitted_at") is not None:
+        out["submitted_at"] = float(payload["submitted_at"])
     if payload["dtype"] == "int8":
         k_sc, v_sc = payload.get("k_scale"), payload.get("v_scale")
         if k_sc is None or v_sc is None:
@@ -155,7 +183,9 @@ def publish(kv: dict):
     from ray_tpu.core import direct as _direct
 
     payload = encode(kv)
+    t0 = time.time()
     ref = _direct.put_owned(payload)
+    _handoff_span("llm.handoff.put", payload, t0, nbytes=meta_of(payload)["nbytes"])
     return meta_of(payload), ref
 
 
@@ -175,10 +205,12 @@ def fetch(ref, meta: dict | None = None, *, timeout_s: float = 30.0, retries: in
     last: BaseException | None = None
     for attempt in range(retries + 1):
         try:
+            t0 = time.time()
             value = _direct.get_owned_view(ref.id, timeout=timeout_s)
             payload = decode(value)
             if meta is not None and tuple(meta.get("shape", payload["k"].shape)) != tuple(payload["k"].shape):
                 raise HandoffError(f"fetched block {payload['k'].shape} does not match routed meta {meta['shape']}")
+            _handoff_span("llm.handoff.fetch", payload, t0, attempts=attempt + 1)
             return payload
         except (ObjectLostError, GetTimeoutError, ConnectionError, FileNotFoundError) as e:
             last = e

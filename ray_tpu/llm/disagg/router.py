@@ -59,7 +59,9 @@ class DisaggRouter:
     are injected (under Serve: deployment-handle calls; in tests: engine
     closures), so the policy is testable without a cluster."""
 
-    def __init__(self, prefill, decode, *, max_attempts: int = 3):
+    def __init__(self, prefill, decode, *, max_attempts: int = 3, telemetry_tags: dict | None = None):
+        from ray_tpu.llm.telemetry import RouterTelemetry
+
         self._prefill = prefill
         self._decode = decode
         self.max_attempts = max(1, int(max_attempts))
@@ -70,6 +72,10 @@ class DisaggRouter:
             "handoffs_lost": 0, "failed": 0, "handoff_bytes": 0,
         }
         self._seq = 0
+        # control-plane events also flow into the live serving metrics
+        # (llm/telemetry.py catalog) so a /metrics scrape sees the split's
+        # health, not just callers polling stats()
+        self._tel = RouterTelemetry(telemetry_tags)
 
     def stats(self) -> dict:
         with self._lock:
@@ -98,6 +104,7 @@ class DisaggRouter:
                         continue
                     self._bump("prefills")
                     self._bump("handoff_bytes", int(meta.get("nbytes", 0)))
+                    self._tel.on_published(int(meta.get("nbytes", 0)))
                     with self._lock:
                         self._inflight[key] = ref
                 try:
@@ -109,6 +116,7 @@ class DisaggRouter:
                         # in the task layer's TaskError): this ref is
                         # dead weight — drop it and re-prefill
                         self._bump("handoffs_lost")
+                        self._tel.on_lost()
                         self._drop(key)
                         meta = ref = None
                     else:
@@ -117,7 +125,9 @@ class DisaggRouter:
                         # PREFILL replica, so a surviving owner lets the
                         # retry skip the re-prefill entirely
                         self._bump("decode_retries")
+                        self._tel.on_reused()
             self._bump("failed")
+            self._tel.on_failed()
             raise DisaggRequestError(
                 f"request failed after {self.max_attempts} attempts "
                 f"(last: {type(last).__name__}: {last})"

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -70,6 +71,15 @@ class RequestState:
     # preemption count (observability)
     admit_seq: int = -1
     preemptions: int = 0
+    # telemetry lifecycle stamps (llm/telemetry.py; host wall clocks only)
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first: float = 0.0
+    t_last: float = 0.0
+    itls: list = field(default_factory=list)
+    # (trace_id, root_span_id, parent_span_id) when RT_TRACING=1; the
+    # disagg handoff carries (trace_id, root_span_id) across replicas
+    trace: tuple | None = None
 
 
 @dataclass
@@ -229,6 +239,8 @@ class LLMEngine:
         device_resident: bool | None = None,
         batch_prefill: bool | None = None,
         speculative=None,
+        telemetry: bool = True,
+        telemetry_tags: dict | None = None,
     ):
         """kv_layout: "slots" (static per-sequence rows; llm/kv_cache.py)
         or "paged" (block-table page pool; llm/paged_kv.py — concurrency
@@ -469,6 +481,18 @@ class LLMEngine:
                     f"(a pure tp>=2 mesh); got axes {getattr(mesh, 'axis_names', None)}"
                 )
             self._init_spec(speculative, _put)
+        # serving telemetry plane (llm/telemetry.py): flight recorder +
+        # live SLO metrics + request-lifecycle tracing. Host-side only —
+        # never forces a device readback (the zero-sync rule, gated at
+        # <= 1.05x the uninstrumented step in tests/test_perf_smoke.py).
+        # telemetry=False opts the whole plane out (A/B baselines).
+        self._last_spec_drain = None
+        self._tel = None
+        if telemetry:
+            from ray_tpu.llm.telemetry import EngineTelemetry
+
+            self._tel = EngineTelemetry(self, telemetry_tags)
+            self._tel.register_fused_entries()
 
     def _init_spec(self, spec_cfg, _put):
         """Speculative decoding state: drafter, adaptive-k controller,
@@ -551,6 +575,16 @@ class LLMEngine:
                     rid: kk for rid, kk in self._controller.current().items() if rid in self._requests
                 },
             }
+
+    def telemetry(self) -> dict:
+        """Flight-recorder snapshot (llm/telemetry.py): per-step ring
+        (phase, wall ms, occupancy, queue depth, spec accounting,
+        recompile sentinel), finished-request lifecycle records (TTFT /
+        queue-wait / per-token ITL samples), recompile counts, tags.
+        Empty dict when the engine was built with telemetry=False."""
+        if self._tel is None:
+            return {}
+        return self._tel.snapshot()
 
     def kv_cache_stats(self) -> dict:
         """KV-cache accounting (the HBM side of serving capacity): cache
@@ -658,11 +692,14 @@ class LLMEngine:
         request_id: str | None = None,
         stream: bool = False,
         out_queue=None,
+        submitted_at: float | None = None,
     ) -> str:
         """``out_queue`` lets a streaming caller supply its own queue and
         hold a reference BEFORE admission — the request may finish (and be
         dropped from the registry) before add_request even returns to a
-        caller racing the stepping thread."""
+        caller racing the stepping thread. ``submitted_at`` (time.time())
+        backdates the telemetry clock to the true ingress arrival when a
+        front-end queued the request before admitting it here."""
         params = params or SamplingParams()
         with self._lock:
             if request_id is None:
@@ -684,6 +721,8 @@ class LLMEngine:
             st = RequestState(request_id, list(prompt_token_ids), params)
             if stream or out_queue is not None:
                 st.out_queue = out_queue if out_queue is not None else queue.SimpleQueue()
+            if self._tel is not None:
+                self._tel.on_submit(st, submitted_at)
             self._requests[request_id] = st
             self._waiting.append(st)
             return request_id
@@ -694,7 +733,9 @@ class LLMEngine:
 
     # ------------------------------------------- prefill/decode disaggregation
 
-    def add_prefill_request(self, prompt_token_ids, request_id: str | None = None) -> str:
+    def add_prefill_request(
+        self, prompt_token_ids, request_id: str | None = None, submitted_at: float | None = None
+    ) -> str:
         """PREFILL-ONLY admission (disaggregated serving, llm/disagg/).
 
         The request rides the normal admission + prefill stages — batching
@@ -719,6 +760,8 @@ class LLMEngine:
                         f"{self._pcfg.num_pages - 1}; raise num_pages"
                     )
             st = RequestState(request_id, list(prompt_token_ids), SamplingParams(max_tokens=1), prefill_only=True)
+            if self._tel is not None:
+                self._tel.on_submit(st, submitted_at)
             self._requests[request_id] = st
             self._waiting.append(st)
             return request_id
@@ -731,10 +774,13 @@ class LLMEngine:
         with self._lock:
             return self._handoffs.pop(request_id, None)
 
-    def prefill_handoff(self, prompt_token_ids) -> dict:
+    def prefill_handoff(self, prompt_token_ids, submitted_at: float | None = None) -> dict:
         """Blocking convenience (single-threaded drivers: tests, bench):
-        admit a prefill-only request and step until its handoff is ready."""
-        rid = self.add_prefill_request(prompt_token_ids)
+        admit a prefill-only request and step until its handoff is ready.
+        ``submitted_at`` backdates the telemetry clock to the true ingress
+        arrival (it rides the handoff, so the decode side's TTFT spans
+        the whole pipeline)."""
+        rid = self.add_prefill_request(prompt_token_ids, submitted_at=submitted_at)
         while True:
             outs = self.step()
             kv = self.pop_handoff(rid)
@@ -789,6 +835,16 @@ class LLMEngine:
             st = RequestState(request_id, prompt, params, prefilled=kv)
             if stream or out_queue is not None:
                 st.out_queue = out_queue if out_queue is not None else queue.SimpleQueue()
+            if self._tel is not None:
+                # a handoff payload carries the ORIGINAL submit stamp and
+                # trace context, so TTFT spans the whole pipeline and one
+                # trace id stitches prefill and decode replicas
+                tr = kv.get("trace")
+                self._tel.on_submit(
+                    st,
+                    kv.get("submitted_at"),
+                    parent_trace=(tr["trace_id"], tr.get("parent_id")) if isinstance(tr, dict) else None,
+                )
             self._requests[request_id] = st
             self._waiting.append(st)
             return request_id
@@ -818,6 +874,8 @@ class LLMEngine:
     def _finish(self, st: RequestState, reason: str):
         st.finished = True
         st.finish_reason = reason
+        if self._tel is not None:
+            self._tel.on_finish(st, reason)
         if st.prefill_only and reason != "handoff":
             # aborted/errored prefill-only request: drop any stashed block
             # (nobody will ever pop it)
@@ -1020,6 +1078,7 @@ class LLMEngine:
         admitted: list[RequestState] = []
         if not wave:
             return admitted
+        self._t_prefill_start = time.time()  # telemetry: wave prefill span start
         plains: list[tuple] = []
         for st, slot, pref, pages, prompt in wave:
             if self.kv_layout == "paged":
@@ -1094,6 +1153,7 @@ class LLMEngine:
         if st.prefilled is not None:
             kv = st.prefilled
             st.prefilled = None
+            t_scatter = time.time()
             kn, vn, n_real = kv["k"], kv["v"], int(kv["n"])
             T_pad = -(-int(kn.shape[1]) // page) * page
             k_pad = np.zeros((kn.shape[0], T_pad) + tuple(kn.shape[2:]), kn.dtype)
@@ -1118,6 +1178,8 @@ class LLMEngine:
                     table_row, jnp.asarray(k_pad), jnp.asarray(v_pad), np.int32(n_real), *scales,
                 )
                 self._lengths[slot] = n_real
+                if self._tel is not None:
+                    self._tel.on_scatter_in(st, t_scatter)
                 self._bind_slot(st, slot, jnp.asarray(kv["logits"])[None])
                 return
             self.pool = self._insert(
@@ -1125,6 +1187,8 @@ class LLMEngine:
             )
             logits = jnp.asarray(kv["logits"])[None]
             self._lengths[slot] = n_real
+            if self._tel is not None:
+                self._tel.on_scatter_in(st, t_scatter)
         else:
             k_p, v_p, n_p = pref
             m = n - n_p
@@ -1160,6 +1224,7 @@ class LLMEngine:
             # dtype mismatches requant transparently inside the program.
             kv = st.prefilled
             st.prefilled = None
+            t_scatter = time.time()
             k_sc, v_sc = kv.get("k_scale"), kv.get("v_scale")
             scales = (jnp.asarray(k_sc), jnp.asarray(v_sc)) if k_sc is not None else ()
             if self._device_resident:
@@ -1171,6 +1236,8 @@ class LLMEngine:
                 self.cache = self._insert(
                     self.cache, slot, jnp.asarray(kv["k"]), jnp.asarray(kv["v"]), int(kv["n"]), *scales
                 )
+            if self._tel is not None:
+                self._tel.on_scatter_in(st, t_scatter)
             logits = jnp.asarray(kv["logits"])[None]
         else:
             # reuse the cached prefix KV; re-attend only the suffix
@@ -1194,6 +1261,8 @@ class LLMEngine:
         st.slot = slot
         st.admit_seq = self._admit_counter = getattr(self, "_admit_counter", 0) + 1
         self._slots[slot] = st
+        if self._tel is not None:
+            self._tel.on_bind(st, getattr(self, "_t_prefill_start", st.t_submit))
         if st.prefill_only:
             # prefill replica path: the block leaves, the slot recycles,
             # decode never sees this request
@@ -1254,6 +1323,7 @@ class LLMEngine:
         the object-plane bytes of a bf16 block."""
         import jax.numpy as jnp
 
+        t_extract = time.time()
         prompt = st.prompt_token_ids
         n = len(prompt)
         T = _bucket(n, self.prefill_buckets)
@@ -1273,6 +1343,10 @@ class LLMEngine:
         if len(out) == 4:
             payload["k_scale"] = np.asarray(out[2])
             payload["v_scale"] = np.asarray(out[3])
+        if self._tel is not None:
+            # stamps trace context + original submit time into the payload
+            # (handoff.py carries them on the wire) and accounts the bytes
+            self._tel.on_handoff_extract(st, payload, t_extract)
         self._handoffs[st.request_id] = payload
         self._finish(st, "handoff")
 
@@ -1302,6 +1376,8 @@ class LLMEngine:
     def _emit(self, st: RequestState, token: int, logp: float):
         st.token_ids.append(token)
         st.logprobs.append(logp)
+        if self._tel is not None:
+            self._tel.on_emit(st)
         if st.out_queue is not None:
             st.out_queue.put(token)
         if st.slot >= 0:
@@ -1327,13 +1403,28 @@ class LLMEngine:
         finished lane never enters another round — at most ONE drafter
         round ever runs past a request's finish detection.
         """
-        with self._lock:
-            wave = self._stage_admission()
-            admitted = self._stage_prefill(wave)
-            if self.kv_layout == "paged":
-                self._paged_grow()
-            reported = self._stage_decode(admitted)
-            return self._build_outputs(reported)
+        tel = self._tel
+        t0 = time.perf_counter() if tel is not None else 0.0
+        try:
+            with self._lock:
+                self._last_spec_drain = None
+                self._step_emitted = 0
+                wave = self._stage_admission()
+                admitted = self._stage_prefill(wave)
+                if self.kv_layout == "paged":
+                    self._paged_grow()
+                reported = self._stage_decode(admitted)
+                outs = self._build_outputs(reported)
+                if tel is not None:
+                    tel.on_step(t0, len(admitted), self._step_emitted, self._last_spec_drain)
+                return outs
+        except BaseException as exc:
+            # postmortem: persist the flight ring as JSONL in the session
+            # dir before the error surfaces (serve marks the replica
+            # unhealthy; the ring is the step history that led here)
+            if tel is not None:
+                tel.dump_on_error(exc)
+            raise
 
     def _stage_decode(self, admitted: list) -> list:
         """DECODE stage: advance every occupied slot one tick. Device-
@@ -1350,8 +1441,13 @@ class LLMEngine:
             else:
                 self._dispatch_fused()
                 emitted = self._drain(prev)
+            self._step_emitted = len(emitted)
             return admitted + emitted
-        return self._sync_decode()
+        # sync mode: every active lane (just-admitted ones included)
+        # emitted a token this step — the returned list IS the emit set
+        reported = self._sync_decode()
+        self._step_emitted = len(reported)
+        return reported
 
     def _dispatch_fused(self):
         """Launch the fused device step for the current occupancy; never
@@ -1516,6 +1612,13 @@ class LLMEngine:
                     self._lane_k[slot] = new_k
                     self._dspec_k = self._set_slot_scalar(self._dspec_k, np.int32(slot), np.int32(new_k))
             emitted.append(st)
+        if emitted and self._tel is not None:
+            # per-round accounting for the flight record (host ints only:
+            # acc was already read back as part of this drain)
+            self._last_spec_drain = (
+                int(sum(entry[2] for entry in lanes)),
+                int(sum(int(acc[entry[1]]) for entry in lanes)),
+            )
         return emitted
 
     def _sync_decode(self) -> list:

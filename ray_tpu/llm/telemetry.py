@@ -1,0 +1,630 @@
+"""Serving telemetry plane: flight recorder, live SLO metrics, tracing.
+
+The serving stack (engine.py, llm/disagg/, serve/llm.py) reports through
+this module into the runtime's existing observability substrate —
+util/metrics (worker→GCS flush, /metrics exposition), util/tracing
+(JSONL spans under the session dir), dashboard/grafana.py (a "Serving"
+panel row) — instead of ad-hoc ``*_stats()`` dicts only a caller who
+knows to poll can see.
+
+Hard rule: ZERO device synchronization. Every sample here is host-side
+scheduler state (shadow lengths, queue depths, wall clocks at the
+one-step-delayed drain); instrumentation never reads a device array and
+never injects a host callback into a fused program (jaxcheck JXC002
+keeps that honest). The cost of being observed is a few dict updates per
+step, gated in tests/test_perf_smoke.py at ≤1.05x the uninstrumented
+step.
+
+Three pieces:
+
+- **Flight recorder** — a fixed-size ring of per-step records (phase,
+  host wall ms, occupancy, queue depth, spec round accounting, handoff
+  events, recompile sentinel) plus a ring of finished-request lifecycle
+  records (submit/admit/first-token/finish stamps, per-token ITL
+  samples). ``LLMEngine.telemetry()`` returns the snapshot; on an engine
+  error the ring is dumped as JSONL into the session dir for
+  postmortems. The recompile sentinel watches each registered
+  fixed-shape fused entry's jit cache: the serving hot path compiles
+  ONCE per entry, so any growth after the first program is a bug
+  (a varying static arg, a dtype drifting per step) and gets its own
+  counter instead of a silent 100x step.
+- **Live SLO metrics** — the catalog in ``METRICS`` (TTFT/ITL/queue-wait
+  histograms, token/preemption/recompile counters, KV-occupancy /
+  HBM-bytes / spec-acceptance / collective-wire-bytes gauges), tagged by
+  model/replica/stage so a fleet's series stay separable in one scrape.
+- **Request-lifecycle tracing** — spans for admission → prefill →
+  handoff(put/fetch/scatter-in) → decode → first-token → finish when
+  RT_TRACING=1. The trace context rides INSIDE the disagg handoff wire
+  dict, so one trace id stitches a request across the prefill and
+  decode replicas.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+
+from ray_tpu.util import tracing
+
+# SLO histogram boundaries (seconds): decode steps are single-digit ms on
+# chip, prefill stalls are tens-to-hundreds of ms, a cold compile is
+# seconds — the buckets must resolve all three regimes.
+_LATENCY_BOUNDARIES = [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0]
+
+_SERVE_TAGS = ("model", "replica", "stage")
+
+# The serving metric catalog: name -> {kind, desc, tags[, boundaries]}.
+# scripts/lint_gate.py's telemetry gate validates every name is legal
+# Prometheus, unique across kinds (including histogram-derived
+# _bucket/_count/_sum names), and that the Grafana "Serving" panels
+# reference only names registered here.
+METRICS: dict[str, dict] = {
+    "rt_llm_ttft_s": {
+        "kind": "histogram", "tags": _SERVE_TAGS, "boundaries": _LATENCY_BOUNDARIES,
+        "desc": "time to first token: request submit -> first emitted token",
+    },
+    "rt_llm_itl_s": {
+        "kind": "histogram", "tags": _SERVE_TAGS, "boundaries": _LATENCY_BOUNDARIES,
+        "desc": "inter-token latency between consecutive emitted tokens",
+    },
+    "rt_llm_queue_wait_s": {
+        "kind": "histogram", "tags": _SERVE_TAGS, "boundaries": _LATENCY_BOUNDARIES,
+        "desc": "admission queue wait: request submit -> prefill-wave start",
+    },
+    "rt_llm_tokens_total": {
+        "kind": "counter", "tags": _SERVE_TAGS,
+        "desc": "generated tokens emitted to consumers",
+    },
+    "rt_llm_prefill_tokens_total": {
+        "kind": "counter", "tags": _SERVE_TAGS,
+        "desc": "prompt tokens prefilled (transferred-KV admissions count 0)",
+    },
+    "rt_llm_requests_finished_total": {
+        "kind": "counter", "tags": _SERVE_TAGS + ("reason",),
+        "desc": "finished requests by finish reason",
+    },
+    "rt_llm_preemptions_total": {
+        "kind": "counter", "tags": _SERVE_TAGS,
+        "desc": "recompute preemptions (paged pool pressure)",
+    },
+    "rt_llm_recompiles_total": {
+        "kind": "counter", "tags": _SERVE_TAGS,
+        "desc": "fused-entry recompiles after warmup (serving-path bug sentinel)",
+    },
+    "rt_llm_kv_occupancy": {
+        "kind": "gauge", "tags": _SERVE_TAGS,
+        "desc": "occupied fraction of KV-cache token capacity",
+    },
+    "rt_llm_kv_hbm_bytes": {
+        "kind": "gauge", "tags": _SERVE_TAGS,
+        "desc": "occupied KV bytes (scale-inclusive for int8 caches)",
+    },
+    "rt_llm_queue_depth": {
+        "kind": "gauge", "tags": _SERVE_TAGS,
+        "desc": "requests waiting for a slot",
+    },
+    "rt_llm_slots_in_use": {
+        "kind": "gauge", "tags": _SERVE_TAGS,
+        "desc": "KV slots bound to live sequences",
+    },
+    "rt_llm_spec_acceptance": {
+        "kind": "gauge", "tags": _SERVE_TAGS,
+        "desc": "speculative acceptance rate over drained rounds (lifetime mean)",
+    },
+    "rt_llm_collective_wire_bytes_total": {
+        "kind": "counter", "tags": _SERVE_TAGS,
+        "desc": "estimated ICI bytes shipped by the fused step's collectives (jaxpr-accounted per step)",
+    },
+    "rt_llm_handoff_bytes_total": {
+        "kind": "counter", "tags": _SERVE_TAGS,
+        "desc": "disagg KV handoff bytes leaving prefill replicas",
+    },
+    "rt_llm_handoffs_total": {
+        "kind": "counter", "tags": _SERVE_TAGS + ("event",),
+        "desc": "disagg handoff events (published/scattered/lost/reused)",
+    },
+}
+
+_instruments: dict = {}
+_instr_lock = threading.Lock()
+
+
+def instruments() -> dict:
+    """Instantiate (once per process) and return the catalog's util.metrics
+    instruments, name -> Counter/Gauge/Histogram. Registration is shared
+    across engines in the process; per-engine separation rides the tags."""
+    from ray_tpu.util import metrics as m
+
+    with _instr_lock:
+        if _instruments:
+            return _instruments
+        ctor = {"counter": m.Counter, "gauge": m.Gauge, "histogram": m.Histogram}
+        for name, spec in METRICS.items():
+            kw = {"description": spec["desc"], "tag_keys": tuple(spec["tags"])}
+            if spec["kind"] == "histogram":
+                kw["boundaries"] = list(spec["boundaries"])
+            _instruments[name] = ctor[spec["kind"]](name, **kw)
+        return _instruments
+
+
+def default_tags(stage: str, model: str | None = None, replica: str | None = None) -> dict:
+    """The model/replica/stage tag triple every serving series carries.
+    Replica defaults to the worker id (the same key the metrics flusher
+    uses) so a fleet's series stay separable after the GCS merge."""
+    return {
+        "model": model or "default",
+        "replica": replica or os.environ.get("RT_WORKER_ID", str(os.getpid())),
+        "stage": stage,
+    }
+
+
+# ----------------------------------------------------------------------
+# flight recorder
+# ----------------------------------------------------------------------
+class FlightRecorder:
+    """Fixed-size ring of per-step records + finished-request lifecycle
+    records, all host-side. Thread-safe against concurrent readers
+    (``snapshot`` under the engine lock vs. a stats scrape).
+
+    The step ring stores flat TUPLES (schema ``STEP_FIELDS``) and only
+    expands them to dicts in ``snapshot()``: record_step runs on every
+    serving step, so it allocates one small tuple instead of a 12-slot
+    dict, keeping the hot path inside the zero-overhead gate; snapshot
+    and the JSONL dump are cold paths."""
+
+    STEP_FIELDS = (
+        "step", "t", "phase", "wall_ms", "admitted", "emitted", "batch", "waiting",
+        "occupied_tokens", "capacity_tokens", "pages_free", "pages_total",
+        "recompiled", "spec_k", "spec_accepted",
+    )
+
+    def __init__(self, max_steps: int = 512, max_requests: int = 256):
+        self.steps: deque = deque(maxlen=max_steps)
+        self.requests: deque = deque(maxlen=max_requests)
+        self._lock = threading.Lock()
+        self._entries: dict[str, tuple] = {}  # name -> (fn, warm_size or None)
+        self.recompiles: dict[str, int] = {}
+        self.step_count = 0
+
+    # -- recompile sentinel --
+    def register_entry(self, name: str, fn) -> None:
+        """Register a FIXED-SHAPE fused entry (the decode hot path's jit
+        handles: fused step, delta scatters, spec verify). These compile
+        exactly once per engine config; cache growth after the first
+        observed program is counted as a recompile — the bug class where
+        a drifting static arg or dtype silently mints a program per step."""
+        if fn is not None and hasattr(fn, "_cache_size"):
+            self._entries[name] = (fn, None)
+
+    def check_recompiles(self) -> list[str]:
+        """Poll every registered entry's jit cache size (a host attribute
+        read — no device work). Returns the entries that recompiled since
+        the last check."""
+        hits: list[str] = []
+        for name, (fn, warm) in list(self._entries.items()):
+            try:
+                size = fn._cache_size()
+            except Exception:
+                continue
+            if warm is None:
+                if size > 0:  # first program = warm baseline
+                    self._entries[name] = (fn, size)
+                continue
+            if size > warm:
+                self.recompiles[name] = self.recompiles.get(name, 0) + (size - warm)
+                self._entries[name] = (fn, size)
+                hits.append(name)
+        return hits
+
+    def record_step(self, row: tuple) -> None:
+        """``row`` = STEP_FIELDS[1:] values (the step counter is
+        prepended here)."""
+        with self._lock:
+            self.step_count += 1
+            self.steps.append((self.step_count,) + row)
+
+    def record_request(self, rec: dict) -> None:
+        with self._lock:
+            self.requests.append(rec)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            rows = list(self.steps)
+            reqs = [dict(r) for r in self.requests]
+            count = self.step_count
+            recs = dict(self.recompiles)
+        steps = []
+        for row in rows:
+            d = dict(zip(self.STEP_FIELDS, row))
+            # drop layout-/mode-inapplicable fields (None) for readability
+            steps.append({k: v for k, v in d.items() if v is not None})
+        return {"step_count": count, "steps": steps, "requests": reqs, "recompiles": recs}
+
+    def dump_jsonl(self, path: str, header: dict | None = None) -> str:
+        """Write the ring as JSONL (one header line, then one line per
+        step record, then one per request record) for postmortems."""
+        snap = self.snapshot()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(json.dumps({"kind": "flight_header", "ts": time.time(),
+                                "recompiles": snap["recompiles"], **(header or {})}) + "\n")
+            for rec in snap["steps"]:
+                f.write(json.dumps({"kind": "step", **rec}) + "\n")
+            for rec in snap["requests"]:
+                f.write(json.dumps({"kind": "request", **rec}) + "\n")
+        return path
+
+
+# ----------------------------------------------------------------------
+# engine-facing facade
+# ----------------------------------------------------------------------
+class EngineTelemetry:
+    """Everything LLMEngine calls, one object. All entry points are
+    host-only and cheap; the engine holds its own lock while calling in,
+    so internal state needs no second lock beyond the recorder's."""
+
+    def __init__(self, engine, tags: dict | None = None):
+        self.engine = engine
+        base = default_tags("engine")
+        base.update(tags or {})
+        self.tags = {k: str(v) for k, v in base.items() if k in _SERVE_TAGS}
+        self.m = instruments()
+        self.recorder = FlightRecorder(
+            max_steps=int(os.environ.get("RT_LLM_FLIGHT_STEPS", "512")),
+            max_requests=int(os.environ.get("RT_LLM_FLIGHT_REQUESTS", "256")),
+        )
+        # hot-path handles: tags resolved ONCE (util.metrics bind); the
+        # per-step/per-token calls below must stay in single-digit
+        # microseconds each to hold the 1.05x zero-overhead gate
+        self._b_ttft = self.m["rt_llm_ttft_s"].bind(self.tags)
+        self._b_itl = self.m["rt_llm_itl_s"].bind(self.tags)
+        self._b_qwait = self.m["rt_llm_queue_wait_s"].bind(self.tags)
+        self._b_tokens = self.m["rt_llm_tokens_total"].bind(self.tags)
+        self._b_pf_tokens = self.m["rt_llm_prefill_tokens_total"].bind(self.tags)
+        self._b_preempt = self.m["rt_llm_preemptions_total"].bind(self.tags)
+        self._b_recompiles = self.m["rt_llm_recompiles_total"].bind(self.tags)
+        self._b_wire = self.m["rt_llm_collective_wire_bytes_total"].bind(self.tags)
+        self._b_qdepth = self.m["rt_llm_queue_depth"].bind(self.tags)
+        self._b_slots = self.m["rt_llm_slots_in_use"].bind(self.tags)
+        self._b_occ = self.m["rt_llm_kv_occupancy"].bind(self.tags)
+        self._b_hbm = self.m["rt_llm_kv_hbm_bytes"].bind(self.tags)
+        self._b_spec = self.m["rt_llm_spec_acceptance"].bind(self.tags)
+        # materialize the sentinel series at 0 so a dashboard can alert
+        # on ANY increase (a series that only appears on the first
+        # recompile is invisible to a rate()/increase() alert rule)
+        self._b_recompiles.inc(0.0)
+        self._b_preempt.inc(0.0)
+        # per-step constants, computed once (the on_step path must stay
+        # in the tens-of-microseconds)
+        from ray_tpu.llm.kv_quant import bytes_per_token
+
+        cfg = engine.config
+        self._bytes_per_token = int(bytes_per_token(cfg.num_layers, cfg.num_kv_heads, cfg.hd, engine.kv_dtype))
+        if engine.kv_layout == "paged":
+            self._capacity_tokens = (engine._pcfg.num_pages - 1) * engine._pcfg.page_size
+        else:
+            self._capacity_tokens = engine.max_num_seqs * engine.max_seq_len
+        # gauges + the recompile poll refresh every SAMPLE_EVERY steps:
+        # scrapes run at >= 1s cadence, so per-step gauge precision buys
+        # nothing and the saved metric ops keep on_step inside the
+        # zero-overhead gate (the flight RECORD still lands every step)
+        self.SAMPLE_EVERY = 16
+        self._nstep = 0
+        self._wire_accum = 0.0
+        self._tok_accum = 0.0
+        # cumulative spec accounting mirrors (deltas per step go into the
+        # flight record; the gauge shows the lifetime mean)
+        self._last_preemptions = 0
+        self._dumped = False
+        # per-step ICI wire bytes of the fused step's collectives: a
+        # one-shot jaxpr accounting turned into a LIVE series (counter
+        # advanced every dispatched step). 0 on tp=1 engines; computed
+        # lazily so engine construction never pays an extra trace.
+        self._wire_bytes_per_step: float | None = None
+
+    # -- registration -----------------------------------------------------
+    def register_fused_entries(self) -> None:
+        """Pick up the engine's fixed-shape jit handles for the recompile
+        sentinel (called after the engine finished building them)."""
+        eng = self.engine
+        for name in ("_fused_step", "_fused_attn", "_fused_append",
+                     "_set_lane", "_set_table", "_set_table_cell",
+                     "_verify_step", "_verify_attn", "_verify_append"):
+            self.recorder.register_entry(name.lstrip("_"), getattr(eng, name, None))
+        if getattr(eng, "_tp_fused", False):
+            # pay the one-shot wire-bytes jaxpr trace HERE, at engine
+            # construction (which already compiles these programs), never
+            # inside a live serving step under the engine lock
+            self._wire_bytes()
+
+    # -- wire-bytes accounting -------------------------------------------
+    def _wire_bytes(self) -> float:
+        """Per-step collective wire bytes, computed once from the fused
+        program's jaxpr (collective/ici.collective_wire_report) for tp>=2
+        shard_map engines; 0 elsewhere. Abstract tracing only — no
+        compile, no device work — and any failure degrades to 0 rather
+        than touching the hot path."""
+        if self._wire_bytes_per_step is not None:
+            return self._wire_bytes_per_step
+        eng = self.engine
+        bytes_per_step = 0.0
+        if getattr(eng, "_tp_fused", False):
+            try:
+                import jax
+
+                from ray_tpu.collective.ici import collective_wire_report
+                from ray_tpu.parallel.mesh import axis_size
+
+                sds = lambda t: jax.tree.map(  # noqa: E731
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t
+                )
+                tp = axis_size(eng.mesh, "tp")
+                if eng.kv_layout == "paged":
+                    from ray_tpu.llm.model_runner import _sharded_fused_paged
+
+                    fn = _sharded_fused_paged(eng.config, eng.mesh, eng.tp_collective, eng.kv_quant)
+                    args = (sds(eng.params), sds(eng.pool), sds(eng._dtables), sds(eng._dlengths),
+                            sds(eng._dtokens), sds(eng._dkeys), sds(eng._dtemps), sds(eng._dtopk),
+                            sds(eng._dtopp))
+                else:
+                    from ray_tpu.llm.model_runner import _sharded_fused_slots
+
+                    fn = _sharded_fused_slots(eng.config, eng.mesh, eng.tp_collective, eng.kv_quant)
+                    args = (sds(eng.params), sds(eng.cache), sds(eng._dtokens), sds(eng._dkeys),
+                            sds(eng._dtemps), sds(eng._dtopk), sds(eng._dtopp))
+                rep = collective_wire_report(jax.make_jaxpr(fn)(*args), axis_size=tp)
+                bytes_per_step = float(rep["total_bytes"])
+            except Exception:
+                bytes_per_step = 0.0
+        self._wire_bytes_per_step = bytes_per_step
+        return bytes_per_step
+
+    # -- request lifecycle ------------------------------------------------
+    def on_submit(self, st, submitted_at: float | None = None, parent_trace: tuple | None = None) -> None:
+        """Stamp admission-queue entry. ``parent_trace`` (trace_id,
+        span_id) joins an existing trace — the disagg decode side passes
+        the context the handoff carried so ONE trace id spans replicas."""
+        st.t_submit = float(submitted_at) if submitted_at is not None else time.time()
+        # latched HERE: the prefill stage consumes st.prefilled (sets it
+        # None) before the slot binds, so on_bind can't tell a transferred
+        # block from a local prefill anymore
+        st.kv_transferred = st.prefilled is not None
+        if tracing.enabled():
+            if parent_trace is not None:
+                trace_id, parent_id = parent_trace[0], parent_trace[1]
+            else:
+                trace_id, parent_id = tracing.child_context()
+            st.trace = (trace_id, uuid.uuid4().hex[:16], parent_id)  # (trace, root span, parent)
+
+    def on_bind(self, st, t_prefill_start: float) -> None:
+        """Slot bound + prefill executed: close the admission and prefill
+        spans, observe queue wait. FIRST bind only — a recompute-preempted
+        request re-binds through here, but its queue wait was already
+        observed (re-measuring from t_submit would report the request's
+        whole lifetime) and a second admission/prefill span pair would
+        show the one request admitted twice; preemptions have their own
+        counter and flight-record field."""
+        now = time.time()
+        if st.t_admit != 0.0:
+            return
+        st.t_admit = now
+        # one queue-wait definition everywhere: submit -> prefill-wave
+        # start (the moment the request stops WAITING and starts being
+        # worked on); the finish record reuses this exact value so a
+        # postmortem dump can never disagree with the live histogram
+        st.queue_wait = max(t_prefill_start - st.t_submit, 0.0)
+        self._b_qwait.observe(st.queue_wait)
+        if not getattr(st, "kv_transferred", False) and not st.token_ids:
+            self._b_pf_tokens.inc(float(len(st.prompt_token_ids)))
+        if st.trace is not None:
+            self._span(st, "llm.admission", st.t_submit, t_prefill_start)
+            self._span(st, "llm.prefill", t_prefill_start, now)
+
+    def on_emit(self, st, now: float | None = None) -> None:
+        """One token reached the host (the one-step-delayed drain, or the
+        sync oracle's readback — either way this is when a consumer could
+        see it). First token observes TTFT; later ones observe ITL."""
+        now = time.time() if now is None else now
+        if st.t_first == 0.0:
+            st.t_first = now
+            self._b_ttft.observe(max(now - st.t_submit, 0.0))
+            if st.trace is not None:
+                self._span(st, "llm.first_token", st.t_admit or st.t_submit, now)
+        else:
+            gap = now - st.t_last
+            st.itls.append(gap)
+            self._b_itl.observe(max(gap, 0.0))
+        st.t_last = now
+        self._tok_accum += 1.0  # flushed into the counter on sample ticks
+
+    def on_finish(self, st, reason: str) -> None:
+        now = time.time()
+        self.m["rt_llm_requests_finished_total"].inc(1.0, tags={**self.tags, "reason": reason.split(":")[0]})
+        self.recorder.record_request({
+            "request_id": st.request_id,
+            "reason": reason,
+            "submit_t": st.t_submit,
+            "admit_t": st.t_admit,
+            "first_token_t": st.t_first,
+            "finish_t": now,
+            "ttft_s": (st.t_first - st.t_submit) if st.t_first else None,
+            "queue_wait_s": getattr(st, "queue_wait", None),
+            "itl_s": list(st.itls),
+            "tokens": len(st.token_ids),
+            "prompt_tokens": len(st.prompt_token_ids),
+            "preemptions": st.preemptions,
+            "trace_id": st.trace[0] if st.trace else None,
+        })
+        if st.trace is not None:
+            if st.t_first:
+                self._span(st, "llm.decode", st.t_first, now)
+            # the root span: the whole request, recorded last so child
+            # spans exist when a viewer walks the tree
+            trace_id, span_id, parent_id = st.trace
+            tracing.record_span(
+                "llm.request", "server", trace_id, span_id, parent_id,
+                int(st.t_submit * 1e9), int(now * 1e9),
+                {"request_id": st.request_id, "reason": reason,
+                 "tokens": len(st.token_ids), "stage": self.tags["stage"]},
+            )
+
+    def on_handoff_extract(self, st, payload: dict, t_start: float) -> None:
+        """Prefill side: the KV block left the cache into a handoff stash.
+        Plants the trace context + original submit stamp in the payload so
+        the decode replica's telemetry continues the same request."""
+        # same accounting as handoff.meta_of (k + v + logits + scales):
+        # the prefill-stage and router-stage series must agree byte for
+        # byte so extracted-vs-published comparisons can detect drops
+        nbytes = int(payload["k"].nbytes + payload["v"].nbytes + payload["logits"].nbytes)
+        if payload.get("k_scale") is not None:
+            nbytes += int(payload["k_scale"].nbytes + payload["v_scale"].nbytes)
+        self.m["rt_llm_handoff_bytes_total"].inc(float(nbytes), tags=self.tags)
+        self.m["rt_llm_handoffs_total"].inc(1.0, tags={**self.tags, "event": "extracted"})
+        payload["submitted_at"] = st.t_submit
+        if st.trace is not None:
+            payload["trace"] = {"trace_id": st.trace[0], "parent_id": st.trace[1]}
+            self._span(st, "llm.handoff", t_start, time.time(), nbytes=nbytes)
+
+    def on_scatter_in(self, st, t_start: float) -> None:
+        """Decode side: a transferred KV block scattered into the live
+        cache/pool."""
+        self.m["rt_llm_handoffs_total"].inc(1.0, tags={**self.tags, "event": "scattered"})
+        if st.trace is not None:
+            self._span(st, "llm.handoff.scatter_in", t_start, time.time())
+
+    def _span(self, st, name: str, t0: float, t1: float, **attrs) -> None:
+        trace_id, root_id, _ = st.trace
+        tracing.record_span(
+            name, "internal", trace_id, uuid.uuid4().hex[:16], root_id,
+            int(t0 * 1e9), int(t1 * 1e9),
+            {"request_id": st.request_id, "stage": self.tags["stage"], **attrs},
+        )
+
+    # -- per-step ----------------------------------------------------------
+    def on_step(self, t0: float, n_admitted: int, n_emitted: int, spec_drained: tuple | None) -> None:
+        """Called at the tail of engine.step() under the engine lock.
+        Everything read here is host shadow state."""
+        eng = self.engine
+        now = time.time()
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        slots_in_use = sum(1 for s in eng._slots if s is not None)
+        waiting = len(eng._waiting)
+        phase = (
+            "idle" if not n_admitted and not slots_in_use and not n_emitted
+            else "mixed" if n_admitted and (slots_in_use or n_emitted)
+            else "prefill" if n_admitted
+            else "decode"
+        )
+        if eng.kv_layout == "paged":
+            occupied = int(eng._lengths.sum())
+        else:
+            occupied = sum(
+                len(s.prompt_token_ids) + len(s.token_ids) for s in eng._slots if s is not None
+            )
+        capacity = self._capacity_tokens
+        per_tok = self._bytes_per_token
+        self._nstep += 1
+        # first step always samples; a drained engine (no bound slots)
+        # samples too, so the token/wire accumulators flush when traffic
+        # stops instead of waiting for a tick that never comes
+        sample = self._nstep % self.SAMPLE_EVERY == 1 or slots_in_use == 0
+        recompiled = self.recorder.check_recompiles() if sample else []
+        if recompiled:
+            self._b_recompiles.inc(float(len(recompiled)))
+        preempt_delta = eng.preemption_count - self._last_preemptions
+        if preempt_delta > 0:
+            self._b_preempt.inc(float(preempt_delta))
+        self._last_preemptions = eng.preemption_count
+
+        paged = eng.kv_layout == "paged"
+        sd = spec_drained or (None, None)
+        self.recorder.record_step((
+            now, phase, round(wall_ms, 4), n_admitted, n_emitted, slots_in_use, waiting,
+            occupied, capacity,
+            eng._page_alloc.free_pages if paged else None,
+            eng._pcfg.num_pages - 1 if paged else None,
+            recompiled or None, sd[0], sd[1],
+        ))
+
+        if slots_in_use and eng._device_resident and self._wire_bytes_per_step:
+            # accumulate locally (one float add), flush on sample ticks
+            self._wire_accum += self._wire_bytes_per_step
+        if not sample:
+            return
+        if self._tok_accum:
+            self._b_tokens.inc(self._tok_accum)
+            self._tok_accum = 0.0
+        self._b_qdepth.set(float(waiting))
+        self._b_slots.set(float(slots_in_use))
+        self._b_occ.set(occupied / max(capacity, 1))
+        self._b_hbm.set(float(occupied * per_tok))
+        if eng._spec_cfg is not None:
+            prop = eng._spec_proposed
+            if prop:
+                self._b_spec.set(eng._spec_accepted / prop)
+        if self._wire_accum:
+            self._b_wire.inc(self._wire_accum)
+            self._wire_accum = 0.0
+
+    # -- postmortem --------------------------------------------------------
+    def dump_on_error(self, exc: BaseException) -> str | None:
+        """Engine died mid-step: persist the flight ring as JSONL under
+        the session dir (once — the serve stepper surfaces the SAME
+        exception to every waiter). Returns the path, or None if dumping
+        itself failed (a dying engine must still raise its real error)."""
+        if self._dumped:
+            return None
+        self._dumped = True
+        try:
+            from ray_tpu.util.state import session_dir
+
+            d = os.path.join(session_dir(), "llm_flight")
+            path = os.path.join(d, f"flight-{os.getpid()}-{int(time.time() * 1e3)}.jsonl")
+            eng = self.engine
+            return self.recorder.dump_jsonl(path, header={
+                "error": f"{type(exc).__name__}: {exc}",
+                "tags": self.tags,
+                "kv_layout": eng.kv_layout,
+                "kv_dtype": str(eng.kv_dtype),
+                "max_num_seqs": eng.max_num_seqs,
+                "device_resident": eng._device_resident,
+            })
+        except Exception:
+            return None
+
+    def snapshot(self) -> dict:
+        snap = self.recorder.snapshot()
+        snap["tags"] = dict(self.tags)
+        snap["wire_bytes_per_step"] = self._wire_bytes_per_step or 0.0
+        return snap
+
+
+# ----------------------------------------------------------------------
+# router-facing metrics (control plane: no engine, no recorder)
+# ----------------------------------------------------------------------
+class RouterTelemetry:
+    """Counters for the disagg router's control-plane events, sharing the
+    serving catalog so one scrape covers the whole split."""
+
+    def __init__(self, tags: dict | None = None):
+        base = default_tags("router")
+        base.update(tags or {})
+        self.tags = {k: str(v) for k, v in base.items() if k in _SERVE_TAGS}
+        self.m = instruments()
+
+    def on_published(self, nbytes: int) -> None:
+        self.m["rt_llm_handoff_bytes_total"].inc(float(nbytes), tags=self.tags)
+        self.m["rt_llm_handoffs_total"].inc(1.0, tags={**self.tags, "event": "published"})
+
+    def on_lost(self) -> None:
+        self.m["rt_llm_handoffs_total"].inc(1.0, tags={**self.tags, "event": "lost"})
+
+    def on_reused(self) -> None:
+        self.m["rt_llm_handoffs_total"].inc(1.0, tags={**self.tags, "event": "reused"})
+
+    def on_failed(self) -> None:
+        self.m["rt_llm_requests_finished_total"].inc(1.0, tags={**self.tags, "reason": "error"})

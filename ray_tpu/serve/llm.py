@@ -60,8 +60,13 @@ class LLMConfig:
 class LLMServer:
     """Deployment class: continuous batching across concurrent callers."""
 
+    # stamped into every telemetry series/span this replica emits (the
+    # model/replica/stage tag triple; llm/telemetry.py)
+    telemetry_stage = "serve"
+
     def __init__(self, llm_config: LLMConfig):
         from ray_tpu.llm import LLMEngine
+        from ray_tpu.llm.telemetry import default_tags
 
         cfg = llm_config.model_config
         if cfg is None:
@@ -69,6 +74,9 @@ class LLMServer:
 
             cfg = LlamaConfig.tiny(dtype="float32")
         engine_kwargs = dict(llm_config.engine_kwargs)
+        engine_kwargs.setdefault(
+            "telemetry_tags", default_tags(self.telemetry_stage, model=llm_config.model_id)
+        )
         if llm_config.speculative is not None:
             engine_kwargs.setdefault("speculative", llm_config.speculative)
         tp = int(llm_config.tensor_parallel_size or 1)
@@ -213,6 +221,12 @@ class LLMServer:
         scales included), allocated vs occupied HBM, slot/page occupancy."""
         return self.engine.kv_cache_stats()
 
+    def telemetry(self) -> dict:
+        """Flight-recorder snapshot (llm/telemetry.py): per-step ring,
+        finished-request TTFT/ITL/queue-wait lifecycle records, recompile
+        sentinel counts, and this replica's model/replica/stage tags."""
+        return self.engine.telemetry()
+
     def __call__(self, request):
         """HTTP entry: POST {"prompt_token_ids": [...], "sampling_params": {...}}."""
         body = request.json() if hasattr(request, "json") else dict(request)
@@ -351,6 +365,8 @@ class PrefillServer(LLMServer):
     replica's process — the replica is the block's owner for its whole
     life — and only the tiny (meta, ref) pair travels back."""
 
+    telemetry_stage = "prefill"
+
     def __init__(self, llm_config: LLMConfig):
         from dataclasses import replace as _replace
 
@@ -398,6 +414,8 @@ class DecodeServer(LLMServer):
     draft/verify exactly as local admissions do. Recompute-preemption
     re-prefills LOCALLY (vLLM semantics: the preempted sequence's
     prompt+generated re-admits on this replica, not through the router)."""
+
+    telemetry_stage = "decode"
 
     def __init__(self, llm_config: LLMConfig, prefill_handle=None):
         super().__init__(llm_config)
@@ -469,7 +487,10 @@ class DisaggRouterServer:
         def _decode(meta, ref, prompt, sp):
             return decode_handle.generate_from_handoff.remote(meta, ref, sp).result(timeout_s=600.0)
 
-        self.router = DisaggRouter(_prefill, _decode, max_attempts=max_attempts)
+        self.router = DisaggRouter(
+            _prefill, _decode, max_attempts=max_attempts,
+            telemetry_tags={"model": llm_config.model_id},
+        )
 
     def generate(self, prompt_token_ids, sampling_params: dict | None = None) -> dict:
         return self.router.generate(list(prompt_token_ids), sampling_params)

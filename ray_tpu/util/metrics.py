@@ -53,20 +53,68 @@ class _Registry:
         self._flusher = threading.Thread(target=self._flush_loop, daemon=True, name="rt-metrics-flush")
         self._flusher.start()
 
-    def _flush_loop(self):
+    def flush_once(self):
+        """Push this process's registry into the head's GCS KV, stamped
+        with a wall-clock timestamp so the merge side can expire gauges
+        from dead workers (counters/histograms still fold in — they are
+        lifetime totals, valid forever)."""
         from ray_tpu.core import context
 
         wid = os.environ.get("RT_WORKER_ID", str(os.getpid()))
+        try:
+            client = context.get_client()
+            client.kv(
+                "put",
+                key=f"proc::{wid}",
+                value={"ts": time.time(), "metrics": self.snapshot()},
+                namespace="_metrics",
+            )
+        except Exception:
+            pass
+
+    def _flush_loop(self):
         while True:
             time.sleep(1.0)
-            try:
-                client = context.get_client()
-                client.kv("put", key=f"proc::{wid}", value=self.snapshot(), namespace="_metrics")
-            except Exception:
-                pass
+            self.flush_once()
 
 
 _registry = _Registry()
+
+
+class _BoundSeries:
+    """Pre-resolved (metric, series-key) handle for hot paths — the
+    reference prometheus-client's ``.labels(...)`` pattern. Skips the
+    per-call tag merge/validation of inc/set/observe; the caller promises
+    the values it passes are sane (e.g. no negative counter incs). Used
+    by the serving telemetry plane, whose per-step budget is tens of
+    microseconds (llm/telemetry.py)."""
+
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric: "Metric", key: tuple):
+        self._metric = metric
+        self._key = key
+
+    def inc(self, value: float = 1.0):
+        m = self._metric
+        with m._lock:
+            m._series[self._key] = float(m._series.get(self._key, 0.0)) + value
+
+    def set(self, value: float):
+        m = self._metric
+        with m._lock:
+            m._series[self._key] = float(value)
+
+    def observe(self, value: float):
+        m = self._metric
+        with m._lock:
+            buckets = m._series.get(self._key)
+            if not isinstance(buckets, list):
+                buckets = [0.0, 0.0] + [0.0] * (len(m.boundaries) + 1)
+                m._series[self._key] = buckets
+            buckets[0] += 1
+            buckets[1] += value
+            buckets[2 + bisect.bisect_left(m.boundaries, value)] += 1
 
 
 class Metric:
@@ -90,6 +138,11 @@ class Metric:
     def set_default_tags(self, tags: dict):
         self._default_tags = dict(tags)
         return self
+
+    def bind(self, tags: dict | None = None) -> _BoundSeries:
+        """Resolve ``tags`` once and return a hot-path handle whose
+        inc/set/observe skip the per-call merge/validation."""
+        return _BoundSeries(self, self._key(tags))
 
     def _key(self, tags: dict | None) -> tuple:
         merged = {**self._default_tags, **(tags or {})}
@@ -155,13 +208,24 @@ class Histogram(Metric):
 # ----------------------------------------------------------------------
 # aggregation / export (driver side)
 # ----------------------------------------------------------------------
+# A worker's flushed snapshot outlives the worker in the GCS KV: without
+# an expiry, a dead replica's last gauge values (queue depth, occupancy)
+# freeze into the merged view forever. Snapshots older than this window
+# drop their GAUGE series; counters/histograms are lifetime totals and
+# keep folding in (workers re-flush every 1s, so live ones never expire).
+STALE_SNAPSHOT_S = float(os.environ.get("RT_METRICS_STALE_S", "15"))
+
+
 def get_metrics_snapshot(client=None) -> dict:
-    """Merged view: local registry + every worker's flushed registry."""
+    """Merged view: local registry + every worker's flushed registry.
+    Worker snapshots carry a flush timestamp; ones older than
+    ``STALE_SNAPSHOT_S`` contribute counters/histograms only (gauges
+    expire with their writer)."""
     from ray_tpu.core import context
 
     merged: dict = {}
 
-    def fold(proc_snap: dict):
+    def fold(proc_snap: dict, stale: bool = False):
         for name, m in proc_snap.items():
             agg = merged.setdefault(
                 name,
@@ -169,6 +233,8 @@ def get_metrics_snapshot(client=None) -> dict:
             )
             if "boundaries" in m:
                 agg["boundaries"] = m["boundaries"]
+            if m["kind"] == "gauge" and stale:
+                continue  # dead writer: its point-in-time values expired
             for key, val in m["series"].items():
                 cur = agg["series"].get(key)
                 if isinstance(val, list):
@@ -183,8 +249,13 @@ def get_metrics_snapshot(client=None) -> dict:
         c = client or context.get_client()
         for key in c.kv("keys", prefix="proc::", namespace="_metrics"):
             snap = c.kv("get", key=key, namespace="_metrics")
-            if snap:
-                fold(snap)
+            if not snap:
+                continue
+            stale = False
+            if isinstance(snap, dict) and "metrics" in snap and "ts" in snap:
+                stale = (time.time() - float(snap["ts"])) > STALE_SNAPSHOT_S
+                snap = snap["metrics"]
+            fold(snap, stale=stale)
     except Exception:
         pass
     return merged
@@ -236,19 +307,31 @@ def _bump_counter(name: str, desc: str, absolute: float) -> None:
         c.inc(delta)
 
 
+def _escape_label(v: str) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, newline
+    (exposition format spec). Without it a tag like model="a\"b" corrupts
+    the whole scrape."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping: backslash and newline only (quotes are legal)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def export_prometheus(client=None) -> str:
     """Prometheus text exposition of the merged snapshot."""
     if client is not None:
         update_core_metrics(client)
     lines = []
     for name, m in sorted(get_metrics_snapshot(client).items()):
-        lines.append(f"# HELP {name} {m['description']}")
+        lines.append(f"# HELP {name} {_escape_help(m['description'])}")
         lines.append(f"# TYPE {name} {m['kind']}")
         for key, val in m["series"].items():
             tags = ""
             if m["tag_keys"]:
                 vals = key.split(",")
-                tags = "{" + ",".join(f'{k}="{v}"' for k, v in zip(m["tag_keys"], vals)) + "}"
+                tags = "{" + ",".join(f'{k}="{_escape_label(v)}"' for k, v in zip(m["tag_keys"], vals)) + "}"
             if isinstance(val, list):
                 count, total, *buckets = val
                 bounds = m.get("boundaries", _DEFAULT_HIST_BOUNDARIES)

@@ -66,12 +66,35 @@ def _span_file():
     global _file
     with _file_lock:
         if _file is None:
+            import atexit
+
             from ray_tpu.util.state import session_dir
 
             d = os.path.join(session_dir(), "spans")
             os.makedirs(d, exist_ok=True)
             _file = open(os.path.join(d, f"spans-{os.getpid()}.jsonl"), "a", buffering=1)
+            # flush-close at interpreter exit: a process's final spans
+            # (e.g. the decode replica's finish span) must reach disk
+            # even when nobody calls shutdown() explicitly
+            atexit.register(shutdown)
         return _file
+
+
+def shutdown():
+    """Flush and close this process's span file. Idempotent; recording a
+    span afterwards transparently reopens the same per-pid file (append
+    mode), so late stragglers are kept rather than crashing. Called from
+    atexit and from the worker exit path (core/worker_main.py) so a
+    worker's final spans are never lost to a dangling file handle."""
+    global _file
+    with _file_lock:
+        f, _file = _file, None
+    if f is not None:
+        try:
+            f.flush()
+            f.close()
+        except (OSError, ValueError):
+            pass
 
 
 def record_span(name: str, kind: str, trace_id: str, span_id: str, parent_id, start_ns: int, end_ns: int, attrs: dict):

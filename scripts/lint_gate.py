@@ -60,6 +60,84 @@ def _changed_files(base: str | None) -> list[str]:
     return []
 
 
+def check_telemetry() -> list[str]:
+    """Telemetry gate: the serving metric catalog (ray_tpu/llm/telemetry.py)
+    must register cleanly — every name valid Prometheus, unique across
+    kinds (including histogram-derived _bucket/_count/_sum exposition
+    names), legal tag keys — and the Grafana dashboard must parse with
+    every panel expr referencing a registered metric. Import-time checks
+    only (no jax, no cluster); returns a list of problems (empty = pass)."""
+    import importlib.util
+    import json as _json
+    import re
+
+    problems: list[str] = []
+    sys.path.insert(0, ROOT)
+    try:
+        # reuse an already-imported catalog module (so an in-process
+        # caller, e.g. the tier-1 test, sees one shared object);
+        # otherwise load telemetry.py by PATH, not via the ray_tpu.llm
+        # package — the package __init__ pulls the engine (and thus jax)
+        # while the catalog module itself is jax-free, and the gate must
+        # work on jax-less boxes without paying a multi-second jax
+        # import on every push
+        telemetry = sys.modules.get("ray_tpu.llm.telemetry")
+        if telemetry is None:
+            _tpath = os.path.join(ROOT, "ray_tpu", "llm", "telemetry.py")
+            _spec = importlib.util.spec_from_file_location("_rt_telemetry_gate", _tpath)
+            telemetry = importlib.util.module_from_spec(_spec)
+            _spec.loader.exec_module(telemetry)
+    except Exception as e:  # noqa: BLE001
+        return [f"telemetry: catalog module failed to import: {type(e).__name__}: {e}"]
+
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    tag_re = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+    exposition: dict[str, str] = {}  # exposition name -> owning metric
+    for name, spec in telemetry.METRICS.items():
+        kind = spec.get("kind")
+        if not name_re.match(name):
+            problems.append(f"telemetry: metric name {name!r} is not valid Prometheus")
+        if kind not in ("counter", "gauge", "histogram"):
+            problems.append(f"telemetry: metric {name!r} has unknown kind {kind!r}")
+        if not spec.get("desc"):
+            problems.append(f"telemetry: metric {name!r} has no description")
+        for t in spec.get("tags", ()):
+            if not tag_re.match(t):
+                problems.append(f"telemetry: metric {name!r} tag key {t!r} is not a valid label name")
+        derived = (
+            [name + s for s in ("_bucket", "_count", "_sum")] if kind == "histogram" else [name]
+        )
+        for n in derived:
+            if n in exposition:
+                problems.append(
+                    f"telemetry: exposition name {n!r} emitted by both {exposition[n]!r} and {name!r}"
+                )
+            exposition[n] = name
+    try:
+        telemetry.instruments()  # cross-kind re-registration raises here
+    except Exception as e:  # noqa: BLE001
+        problems.append(f"telemetry: catalog failed to register: {type(e).__name__}: {e}")
+
+    # dashboard smoke: the provisioning JSON must parse and every panel
+    # must query a metric someone actually registers
+    try:
+        from ray_tpu.dashboard import grafana
+        from ray_tpu.util.metrics import get_metrics_snapshot
+
+        dash = _json.loads(grafana.grafana_dashboard_json())
+        known = set(telemetry.METRICS) | set(grafana.CORE_SERIES) | set(get_metrics_snapshot())
+        for p in dash.get("panels", []):
+            for t in p.get("targets", []):
+                expr = t.get("expr", "")
+                if not any(k in expr for k in known):
+                    problems.append(
+                        f"telemetry: panel {p.get('title')!r} expr {expr!r} references no registered metric"
+                    )
+    except Exception as e:  # noqa: BLE001
+        problems.append(f"telemetry: dashboard smoke failed: {type(e).__name__}: {e}")
+    return problems
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--base", default=None, help="git ref to diff against (default: origin/main, main, HEAD~1)")
@@ -68,6 +146,13 @@ def main(argv: list[str] | None = None) -> int:
     # ignore those positionals so the documented symlink install works
     p.add_argument("git_hook_args", nargs="*", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
+
+    # the telemetry gate is import-time cheap: run it unconditionally (a
+    # broken metric catalog or dashboard panel fails the push regardless
+    # of which file introduced it)
+    telemetry_problems = check_telemetry()
+    for prob in telemetry_problems:
+        print(f"lint_gate: {prob}", file=sys.stderr)
 
     if args.all:
         targets = ["ray_tpu"]
@@ -78,6 +163,8 @@ def main(argv: list[str] | None = None) -> int:
             if f.endswith(".py") and f.startswith("ray_tpu/") and os.path.exists(os.path.join(ROOT, f))
         ]
         if not targets:
+            if telemetry_problems:
+                return 1
             print("lint_gate: no changed ray_tpu/*.py files — nothing to check")
             return 0
 
@@ -92,7 +179,7 @@ def main(argv: list[str] | None = None) -> int:
             "`python -m ray_tpu.lint ray_tpu --jax --update-baseline`.",
             file=sys.stderr,
         )
-    return rc
+    return rc or (1 if telemetry_problems else 0)
 
 
 if __name__ == "__main__":

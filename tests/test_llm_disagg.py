@@ -188,6 +188,46 @@ def test_handoff_codec_rejects_inconsistent_payloads(params, prefill_eng):
         decode_handoff(trunc)
 
 
+def test_disagg_one_trace_id_stitches_replicas(params, prefill_eng):
+    """ISSUE 10 acceptance: one disagg request yields ONE trace id
+    spanning admission -> prefill -> handoff -> scatter-in -> decode ->
+    first-token across BOTH replicas — the trace context rides inside
+    the handoff wire dict, and the decode-side root span parents back
+    into the prefill-side request's root."""
+    from ray_tpu.util import tracing
+
+    tracing.configure(True)
+    try:
+        dec = LLMEngine(CFG, params=params, max_num_seqs=2, max_seq_len=128, enable_prefix_caching=False)
+        kv = _ship(prefill_eng, [5, 6, 7, 8, 9])
+        assert kv.get("trace", {}).get("trace_id"), "trace context missing from the handoff wire dict"
+        assert kv.get("submitted_at"), "submit stamp missing from the handoff wire dict"
+        rid = dec.add_prefilled(kv, SamplingParams(max_tokens=4))
+        while dec.has_unfinished():
+            dec.step()
+        tracing.shutdown()  # flush-close before reading (satellite: final spans never lost)
+        tid = kv["trace"]["trace_id"]
+        spans = [s for s in tracing.load_spans() if s["trace_id"] == tid]
+        names = {s["name"] for s in spans}
+        assert {
+            "llm.admission", "llm.prefill", "llm.handoff",
+            "llm.handoff.scatter_in", "llm.first_token", "llm.decode", "llm.request",
+        } <= names, f"missing lifecycle spans: {sorted(names)}"
+        # both replicas contributed admissions to the one trace
+        assert len([s for s in spans if s["name"] == "llm.admission"]) >= 2
+        roots = [s for s in spans if s["name"] == "llm.request"]
+        assert len(roots) == 2  # prefill-side + decode-side request roots
+        pre_root = next(s for s in roots if s["attrs"]["reason"] == "handoff")
+        dec_root = next(s for s in roots if s is not pre_root)
+        assert dec_root["attrs"]["request_id"] == rid
+        assert dec_root["parent_id"] == pre_root["span_id"], "decode root must parent into the prefill root"
+        # the scatter-in span belongs to the decode-side request
+        scat = next(s for s in spans if s["name"] == "llm.handoff.scatter_in")
+        assert scat["attrs"]["request_id"] == rid
+    finally:
+        tracing.configure(False)
+
+
 # ----------------------------------------------- int8 (quantized) handoffs
 
 

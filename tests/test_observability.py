@@ -285,7 +285,10 @@ def test_tracing_spans_propagate_across_nested_remote_calls(tmp_path):
         deadline = time.time() + 20
         while True:
             spans = tracing.load_spans()
-            tasks = [s for s in spans if s["kind"] == "server"]
+            # only remote-call task spans count: other subsystems (e.g.
+            # the LLM serving plane) also write server-kind roots into
+            # this process's span file
+            tasks = [s for s in spans if s["kind"] == "server" and s["name"].startswith("task::")]
             if len(tasks) >= 2 or time.time() > deadline:
                 break
             time.sleep(0.2)
@@ -304,6 +307,74 @@ def test_tracing_spans_propagate_across_nested_remote_calls(tmp_path):
         os.environ.pop("RT_TRACING", None)
         tracing.configure(False)
         ray_tpu.shutdown()
+
+
+def test_tracing_shutdown_flushes_and_closes():
+    """Regression (ISSUE 10 satellite): span files used to be opened
+    line-buffered and NEVER closed — shutdown() must flush-close the
+    per-process file (atexit + worker-exit call it), keep the spans
+    readable, and transparently reopen if anything records afterwards."""
+    from ray_tpu.util import tracing
+
+    tracing.configure(True)
+    try:
+        with tracing.span("shutdown-test-span"):
+            pass
+        f = tracing._file
+        assert f is not None and not f.closed
+        tracing.shutdown()
+        assert tracing._file is None and f.closed
+        tracing.shutdown()  # idempotent
+        assert any(s["name"] == "shutdown-test-span" for s in tracing.load_spans())
+        # a straggler span after shutdown reopens the same file (append):
+        # kept, not crashed — and a second shutdown closes that handle too
+        with tracing.span("post-shutdown-span"):
+            pass
+        assert tracing._file is not None
+        tracing.shutdown()
+        names = {s["name"] for s in tracing.load_spans()}
+        assert {"shutdown-test-span", "post-shutdown-span"} <= names
+    finally:
+        tracing.configure(False)
+
+
+def test_stale_worker_gauges_expire_counters_fold(rt_start):
+    """Regression (ISSUE 10 satellite): a dead worker's flushed snapshot
+    used to freeze its gauges into the merged view forever. Flushes are
+    now timestamped; past the staleness window the snapshot's GAUGES
+    expire while its counters/histograms (lifetime totals) still fold."""
+    from ray_tpu.core import context
+    from ray_tpu.util import metrics
+
+    client = context.get_client()
+
+    def snap_of(gauge_v, counter_v, hist):
+        return {
+            "stale_t_gauge": {"kind": "gauge", "description": "", "tag_keys": (), "series": {"": gauge_v}},
+            "stale_t_counter": {"kind": "counter", "description": "", "tag_keys": (), "series": {"": counter_v}},
+            "stale_t_hist": {
+                "kind": "histogram", "description": "", "tag_keys": (),
+                "boundaries": [1.0], "series": {"": list(hist)},
+            },
+        }
+
+    now = time.time()
+    client.kv("put", key="proc::t-live", namespace="_metrics",
+              value={"ts": now, "metrics": snap_of(5.0, 3.0, [1.0, 0.5, 1.0, 0.0])})
+    client.kv("put", key="proc::t-dead", namespace="_metrics",
+              value={"ts": now - 10 * metrics.STALE_SNAPSHOT_S, "metrics": snap_of(7.0, 4.0, [2.0, 9.0, 0.0, 2.0])})
+    merged = metrics.get_metrics_snapshot(client)
+    # counters and histograms fold from BOTH (dead worker's work happened)
+    assert merged["stale_t_counter"]["series"][""] == 7.0
+    assert merged["stale_t_hist"]["series"][""] == [3.0, 9.5, 1.0, 2.0]
+    # the dead worker's gauge expired: only the live writer's value shows
+    assert merged["stale_t_gauge"]["series"][""] == 5.0
+    # pre-timestamp (legacy) snapshots still fold wholesale
+    client.kv("put", key="proc::t-legacy", namespace="_metrics",
+              value=snap_of(9.0, 1.0, [0.0, 0.0, 0.0, 0.0]))
+    merged = metrics.get_metrics_snapshot(client)
+    assert merged["stale_t_counter"]["series"][""] == 8.0
+    assert merged["stale_t_gauge"]["series"][""] in (5.0, 9.0)  # both live; either may win
 
 
 def test_live_worker_stack_dump(rt_start):

@@ -153,6 +153,70 @@ def test_llm_int8_decode_step_floor():
     )
 
 
+def test_llm_telemetry_zero_overhead_gate():
+    """ISSUE 10 acceptance: the instrumented device-resident decode step
+    stays <= 1.05x the uninstrumented one (interleaved rounds, >= the
+    gate's best-of-3, so load jitter degrades both modes alike).
+    Telemetry is host-side only — a tuple append into the flight ring,
+    pre-bound metric handles, gauges sampled every 16th step — and must
+    never force a device readback; a regression here means
+    instrumentation leaked into the hot path (a per-step sync, a
+    per-token device->host pull, an unbounded per-step allocation).
+
+    Methodology notes, learned the hard way on a loaded 2-core CI box:
+    ONE engine with `_tel` toggled between rounds (two engines compare
+    independent jit caches, whose layout luck alone exceeds 5%), a
+    SERVING-SCALE model (~tens of ms/step, the regime the claim is
+    about: the fixed ~0.1 ms host cost must be small RELATIVE to a real
+    step — on the micro tiny-model step the same cost is ~4% and the
+    gate measures box noise instead), and per-mode BEST (min) over the
+    interleaved rounds — each mode's least-contended pass; medians drag
+    in whole-round scheduler/memory-pressure swings that dwarf 5%."""
+    pytest.importorskip("jax")
+    from ray_tpu.llm import LLMEngine, SamplingParams
+    from ray_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=2048, hidden_size=512, intermediate_size=1024, num_layers=4,
+        num_heads=8, num_kv_heads=4, max_seq_len=256, dtype="float32", remat=False,
+    )
+    B, P, G = 4, 32, 24
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, cfg.vocab_size - 1, size=P)) for _ in range(B)]
+    eng = LLMEngine(cfg, max_num_seqs=B, max_seq_len=128, enable_prefix_caching=False)
+    eng.generate(prompts, SamplingParams(max_tokens=2))  # compile everything
+    tel = eng._tel
+    rounds = {True: [], False: []}
+    # >= best-of-3 interleaved pairs, extending adaptively: under heavy
+    # box contention (full-suite runs swing a round 2.5x) six draws may
+    # not give BOTH modes a clean slice, so keep drawing until the
+    # best-vs-best comparison clears the gate or the round budget is
+    # spent — more data can only make a true regression MORE damning
+    for r in range(18):
+        for instrumented in ([True, False] if r % 2 == 0 else [False, True]):
+            eng._tel = tel if instrumented else None
+            for p in prompts:
+                eng.add_request(p, SamplingParams(max_tokens=G))
+            while eng.num_waiting:
+                eng.step()
+            t0 = time.perf_counter()
+            steps = 0
+            while eng.has_unfinished():
+                eng.step()
+                steps += 1
+            rounds[instrumented].append((time.perf_counter() - t0) / max(steps, 1))
+        if r >= 2 and min(rounds[True]) <= 1.05 * min(rounds[False]):
+            break
+    eng._tel = tel
+    best = {m: min(v) for m, v in rounds.items()}
+    assert best[True] <= 1.05 * best[False], (
+        f"telemetry overhead breached the 1.05x gate: instrumented "
+        f"{best[True] * 1e3:.3f} ms/step vs plain {best[False] * 1e3:.3f} ms/step "
+        f"({best[True] / best[False]:.3f}x; rounds tel={[round(x * 1e3, 2) for x in rounds[True]]} "
+        f"plain={[round(x * 1e3, 2) for x in rounds[False]]})"
+    )
+
+
 def test_actor_call_floor(rt):
     @ray_tpu.remote
     class A:
