@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import itertools
 import json
 import threading
 import time
@@ -785,6 +786,199 @@ def bench_disagg(cfg, prompt_len: int, gen_len: int, max_num_seqs: int = 4, n_lo
     return rec
 
 
+def bench_kvplane(cfg, prompt_len: int, gen_len: int, n_replicas: int = 2,
+                  n_prefixes: int = 4, reqs_per_prefix: int = 4) -> dict:
+    """Cluster KV plane A/B (llm/kvplane/): shared-system-prompt traffic
+    over a 2-replica deployment, cache-aware routing + cluster prefix
+    reuse vs the replica-local baseline.
+
+    Workload: ``n_prefixes`` distinct long system prompts, each hit by
+    ``reqs_per_prefix`` CONCURRENT requests with short unique suffixes —
+    the millions-of-users shape where every request repeats a long shared
+    prefix. Baseline: the same engines, prefix caching ON but replica-
+    LOCAL, round-robin routing (each replica pays its own prefill of
+    every prefix). Plane: shared PrefixIndex + cache-aware router —
+    shared-prefix traffic lands on the holder (local tier), load spills
+    fetch the block over the object plane instead of re-prefilling
+    (remote tier).
+
+    TTFT comes from each ENGINE'S FLIGHT RECORDER (telemetry-sourced,
+    the same samples the live rt_llm_ttft_s series aggregates); the
+    record carries cluster hit-rate and per-tier hit counts."""
+    import queue as _queue
+    import threading as _threading
+
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu.llm.kvplane import CacheAwareRouter, PrefixIndex
+    from ray_tpu.llm.sampling import SamplingParams
+    from ray_tpu.serve.llm import KVPlaneServer, LLMConfig, LLMServer
+
+    prefix_len = max(128, prompt_len)  # the stall source must be LONG
+    suffix_len, gen = 8, min(gen_len, 8)
+    max_seq = 1 << (prefix_len + suffix_len + gen + 16 - 1).bit_length()
+    rng = np.random.default_rng(3)
+    prefixes = [
+        [int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=prefix_len)]
+        for _ in range(n_prefixes)
+    ]
+    prompts = [
+        [p + [int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=suffix_len)]
+         for _ in range(reqs_per_prefix)]
+        for p in prefixes
+    ]
+    sp = {"max_tokens": gen, "temperature": 0.0}
+
+    def _servers(plane_index):
+        """Replica surfaces, not bare engines: concurrent callers batch
+        through each replica's stepping thread exactly as under Serve
+        (KVPlaneServer joins the cluster plane; LLMServer = the
+        replica-local baseline)."""
+        llm_cfg = lambda: LLMConfig(  # noqa: E731
+            model_config=cfg, prewarm=False,
+            engine_kwargs={"max_num_seqs": reqs_per_prefix + 1, "max_seq_len": max_seq},
+        )
+        servers = {}
+        for i in range(n_replicas):
+            rid = f"r{i}"
+            if plane_index is not None:
+                servers[rid] = KVPlaneServer(llm_cfg(), plane_index, rid)
+            else:
+                servers[rid] = LLMServer(llm_cfg())
+        # compile every measured program outside the timed region: both
+        # prefill buckets AND the prefix-hit admission (insert + suffix
+        # extend at the measured suffix bucket). Warm prompts are DISTINCT
+        # per replica and one token longer than the measured ones, so
+        # they can never register as cluster hits or pollute the
+        # flight-recorder TTFT filter below.
+        for srv in servers.values():
+            warm = [int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=prefix_len + suffix_len + 1)]
+            srv.generate(warm, {"max_tokens": 2, "temperature": 0.0}, timeout_s=600.0)
+            srv.generate(warm[:8], {"max_tokens": 2, "temperature": 0.0}, timeout_s=600.0)
+            # the hit warm must reproduce the MEASURED hit shape: matched
+            # boundary at prefix_len, so the suffix extend compiles at the
+            # same small bucket the followers use
+            hitter = warm[:prefix_len] + [int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=suffix_len + 1)]
+            srv.generate(hitter, {"max_tokens": 2, "temperature": 0.0}, timeout_s=600.0)
+        # post-warm stat baseline: _drive reports DELTAS, so the warm
+        # phase's own hits never inflate the measured hit-rate
+        return servers, {rid: srv.engine.prefix_cache_stats() for rid, srv in servers.items()}
+
+    def _drive(servers, s0, router_generate):
+        """Per prefix: ONE sequential leader (somebody must prefill and
+        publish the shared prompt), then the remaining requests
+        CONCURRENTLY — the follower traffic cache-aware routing exists
+        for, with enough simultaneous load to spill some of it off the
+        holder (the remote tier)."""
+        errs: _queue.Queue = _queue.Queue()
+
+        def one(prompt):
+            try:
+                router_generate(prompt, sp)
+            except BaseException as e:  # noqa: BLE001
+                errs.put(repr(e))
+
+        for group in prompts:
+            one(group[0])  # leader: the cold prefill that seeds the prefix
+            threads = [_threading.Thread(target=one, args=(p,)) for p in group[1:]]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        if not errs.empty():
+            raise RuntimeError(f"bench request failed: {errs.get()}")
+        ttfts = []
+        for srv in servers.values():
+            for rec in srv.engine.telemetry().get("requests", []):
+                # measured requests only (warmups are one token longer)
+                if rec["prompt_tokens"] == prefix_len + suffix_len and rec["ttft_s"] is not None:
+                    ttfts.append(rec["ttft_s"])
+        n_req = n_prefixes * reqs_per_prefix
+        stats = [srv.engine.prefix_cache_stats() for srv in servers.values()]
+        base = list(s0.values())
+        local = sum(s["local"]["hits"] - b["local"]["hits"] for s, b in zip(stats, base))
+        remote = sum(
+            s.get("remote", {}).get("hits", 0) - b.get("remote", {}).get("hits", 0)
+            for s, b in zip(stats, base)
+        )
+        fetched = sum(
+            s.get("remote", {}).get("fetched_bytes", 0) - b.get("remote", {}).get("fetched_bytes", 0)
+            for s, b in zip(stats, base)
+        )
+        return {
+            "ttft_ms_p50": _pct(ttfts, 0.50),
+            "ttft_ms_p99": _pct(ttfts, 0.99),
+            "requests": n_req,
+            "local_hits": local,
+            "remote_hits": remote,
+            "cluster_hit_rate": round((local + remote) / n_req, 3),
+            "remote_fetched_mb": round(fetched / 2**20, 2),
+            "telemetry": True,  # provenance: flight-recorder-sourced
+        }
+
+    rt.init(num_cpus=2)
+    base_servers = plane_servers = {}
+    try:
+        # baseline: replica-local caches, round-robin routing
+        base_servers, base_s0 = _servers(None)
+        rr = itertools.count()
+
+        def rr_generate(prompt, sp_):
+            rid = f"r{next(rr) % n_replicas}"
+            return base_servers[rid].generate(prompt, sp_, timeout_s=600.0)
+
+        base = _drive(base_servers, base_s0, rr_generate)
+
+        # cluster plane: shared index + cache-aware router
+        index = PrefixIndex()
+        plane_servers, plane_s0 = _servers(index)
+
+        def submit(rid, prompt, sp_):
+            return plane_servers[rid].generate(prompt, sp_, timeout_s=600.0)
+
+        # block derived from the replicas' own prefix cache: a mismatched
+        # hardcode would hash different boundaries than they publish and
+        # silently report an all-cold A/B
+        blk = next(iter(plane_servers.values())).engine._prefix_cache.block
+        router = CacheAwareRouter(index, submit, list(plane_servers), block=blk, load_weight=0.5)
+        plane = _drive(plane_servers, plane_s0, router.generate)
+        plane["router"] = {
+            k: router.stats()[k]
+            for k in ("routed_to_holder", "routed_off_holder", "cold", "matched_tokens")
+        }
+    finally:
+        # both pools share replica ids — stop them individually (a merged
+        # dict would silently drop the baseline pool's steppers)
+        for srv in list(base_servers.values()) + list(plane_servers.values()):
+            srv._stopped = True
+        rt.shutdown()
+    speed = (base["ttft_ms_p50"] / plane["ttft_ms_p50"]) if plane["ttft_ms_p50"] else None
+    rec = {
+        "metric": "engine_kvplane_ab",
+        **_device_info(),
+        "kv_dtype": cfg.dtype,
+        "tp": 1,
+        "tp_collective": "fp",
+        "kvplane": True,  # provenance: cluster-plane A/B
+        "workload": (
+            f"{n_prefixes} shared system prompts (len {prefix_len}) x {reqs_per_prefix} concurrent "
+            f"requests (suffix {suffix_len}, gen {gen}) over {n_replicas} replicas"
+        ),
+        "replica_local_baseline": base,
+        "kvplane_cache_aware": plane,
+        "ttft_p50_speedup": round(speed, 2) if speed else None,
+    }
+    print(
+        f"  baseline hit-rate {base['cluster_hit_rate']} TTFT p50/p99 "
+        f"{base['ttft_ms_p50']}/{base['ttft_ms_p99']} ms -> kvplane hit-rate "
+        f"{plane['cluster_hit_rate']} ({plane['local_hits']}L+{plane['remote_hits']}R) TTFT p50/p99 "
+        f"{plane['ttft_ms_p50']}/{plane['ttft_ms_p99']} ms ({rec['ttft_p50_speedup']}x p50)",
+        flush=True,
+    )
+    return rec
+
+
 def bench_full_stack(cfg, prompt_len: int, gen_len: int, concurrency: int, tiny: bool) -> dict:
     """proxy -> router -> replica -> engine with N concurrent callers."""
     import numpy as np
@@ -916,6 +1110,7 @@ def main(argv=None):
     benches.append(("engine_kv_int8_ab", lambda: bench_kv_int8(cfg, prompt_len, gen_len, repeats=args.repeats)))
     benches.append(("engine_tp_ab", lambda: bench_tp(cfg, prompt_len, gen_len, repeats=args.repeats)))
     benches.append(("engine_disagg_ab", lambda: bench_disagg(cfg, prompt_len, gen_len)))
+    benches.append(("engine_kvplane_ab", lambda: bench_kvplane(cfg, prompt_len, gen_len)))
     benches.append(("full_stack", lambda: bench_full_stack(cfg, prompt_len, gen_len, args.concurrency, args.tiny or args.small)))
     for name, fn in benches:
         if args.only and args.only not in name:

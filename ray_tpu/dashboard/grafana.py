@@ -109,6 +109,13 @@ def grafana_dashboard_json(client=None, *, datasource: str = "Prometheus", title
         ("rate(rt_llm_handoff_bytes_total[1m])", "handoff B/s"),
         ("rate(rt_llm_handoffs_total[1m])", "events/s"),
     ], w=12, x=12)
+    add("Serving: cluster prefix reuse", [
+        # cluster hit-rate: hits (both tiers, all replicas) per admitted
+        # request — shared-prefix traffic converging on warm replicas
+        ("sum by (tier) (rate(rt_llm_prefix_hits_total[5m]))", "hits/s {{tier}}"),
+        ("sum(rate(rt_llm_prefix_hits_total[5m])) / sum(rate(rt_llm_requests_finished_total[5m]))", "cluster hit-rate"),
+        ("rate(rt_llm_prefix_fetch_bytes_total[1m])", "remote fetch B/s"),
+    ], w=12, x=0)
 
     # -- one panel per registered metric (user Counters/Gauges/Histograms) --
     try:

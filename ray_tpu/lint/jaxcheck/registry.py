@@ -35,6 +35,7 @@ from typing import Callable
 ENTRY_MODULES = (
     "ray_tpu.llm.model_runner",
     "ray_tpu.llm.disagg.scatter",
+    "ray_tpu.llm.kvplane.quant",
     "ray_tpu.llm.spec.drafter",
     "ray_tpu.llm.spec.verify",
     "ray_tpu.parallel.train_step",

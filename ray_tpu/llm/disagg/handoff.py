@@ -64,16 +64,22 @@ def _scale_shape(shape: tuple) -> tuple:
     return (shape[0], shape[2], shape[1])
 
 
-def encode(kv: dict) -> dict:
+PREFIX_KIND = "kv_prefix"  # cluster KV plane (llm/kvplane/): a published
+# prefix block — same wire validation, no first-token logits (the
+# consumer re-attends the prompt's remaining suffix itself)
+
+
+def encode(kv: dict, *, kind: str = "kv_handoff") -> dict:
     """Engine handoff payload -> self-describing wire dict.
 
     ``kv`` is the engine's prefill-extract product: k/v [L, T_pad, kv_h,
     hd] numpy, logits [vocab] f32, n real tokens, prompt_token_ids — and
     for an int8 producer cache also k_scale/v_scale [L, kv_h, T_pad] f32
     per-head scales; the wire then carries int8 values + scales (~half
-    the bytes of a bf16 block)."""
+    the bytes of a bf16 block). ``kind=PREFIX_KIND`` encodes a cluster
+    prefix block instead: identical layout/validation, but logits are
+    absent (a prefix is strictly shorter than any prompt it serves)."""
     k, v = np.asarray(kv["k"]), np.asarray(kv["v"])
-    logits = np.asarray(kv["logits"], np.float32)
     n = int(kv["n"])
     if k.ndim != 4 or k.shape != v.shape:
         raise HandoffError(f"KV block must be [L, T_pad, kv, hd] twins, got k{k.shape} v{v.shape}")
@@ -81,7 +87,7 @@ def encode(kv: dict) -> dict:
         raise HandoffError(f"real length {n} outside block width {k.shape[1]}")
     wire = {
         "version": HANDOFF_VERSION,
-        "kind": "kv_handoff",
+        "kind": kind,
         "n": n,
         "t_pad": int(k.shape[1]),
         "shape": tuple(int(d) for d in k.shape),
@@ -89,8 +95,9 @@ def encode(kv: dict) -> dict:
         "prompt_token_ids": [int(t) for t in kv["prompt_token_ids"]],
         "k": k,
         "v": v,
-        "logits": logits,
     }
+    if kind != PREFIX_KIND:
+        wire["logits"] = np.asarray(kv["logits"], np.float32)
     # telemetry plumbing (llm/telemetry.py): the producer's trace context
     # and original submit stamp ride the wire so the decode replica's
     # spans join the SAME trace id and TTFT spans the whole pipeline
@@ -117,15 +124,16 @@ def encode(kv: dict) -> dict:
     return wire
 
 
-def decode(payload: dict) -> dict:
+def decode(payload: dict, *, kind: str = "kv_handoff") -> dict:
     """Wire dict -> validated engine admission payload (add_prefilled
     format). Raises HandoffError on anything inconsistent — a truncated
     or foreign object must never scatter garbage into a live pool. For
     an int8 block the per-head scale tensors are validated (shape
     [L, kv, T_pad], float32) with the same severity: a garbage scale
-    would silently re-scale every attended position."""
-    if not isinstance(payload, dict) or payload.get("kind") != "kv_handoff":
-        raise HandoffError(f"not a kv_handoff payload: {type(payload).__name__}")
+    would silently re-scale every attended position. ``kind=PREFIX_KIND``
+    decodes a cluster prefix block (no logits on the wire)."""
+    if not isinstance(payload, dict) or payload.get("kind") != kind:
+        raise HandoffError(f"not a {kind} payload: {type(payload).__name__}")
     if payload.get("version") != HANDOFF_VERSION:
         raise HandoffError(f"handoff version {payload.get('version')} != {HANDOFF_VERSION}")
     k, v = payload["k"], payload["v"]
@@ -138,7 +146,9 @@ def decode(payload: dict) -> dict:
     prompt = payload["prompt_token_ids"]
     if not 0 < n <= shape[1] or n != len(prompt):
         raise HandoffError(f"length {n} inconsistent with block width {shape[1]} / prompt {len(prompt)}")
-    out = {"k": k, "v": v, "n": n, "logits": payload["logits"], "prompt_token_ids": list(prompt)}
+    out = {"k": k, "v": v, "n": n, "prompt_token_ids": list(prompt)}
+    if kind != PREFIX_KIND:
+        out["logits"] = payload["logits"]
     if isinstance(payload.get("trace"), dict) and payload["trace"].get("trace_id"):
         out["trace"] = dict(payload["trace"])
     if payload.get("submitted_at") is not None:
@@ -160,8 +170,12 @@ def decode(payload: dict) -> dict:
 
 
 def meta_of(payload: dict) -> dict:
-    """Small router-facing summary (no arrays): what travels with the ref."""
-    nbytes = int(payload["k"].nbytes + payload["v"].nbytes + payload["logits"].nbytes)
+    """Small router-facing summary (no arrays): what travels with the ref.
+    Prefix blocks (PREFIX_KIND) carry no logits; everything else is the
+    same accounting."""
+    nbytes = int(payload["k"].nbytes + payload["v"].nbytes)
+    if payload.get("logits") is not None:
+        nbytes += int(payload["logits"].nbytes)
     if payload.get("k_scale") is not None:
         nbytes += int(payload["k_scale"].nbytes + payload["v_scale"].nbytes)
     return {
@@ -189,7 +203,10 @@ def publish(kv: dict):
     return meta_of(payload), ref
 
 
-def fetch(ref, meta: dict | None = None, *, timeout_s: float = 30.0, retries: int = 2, retry_wait_s: float = 0.2) -> dict:
+def fetch(
+    ref, meta: dict | None = None, *, timeout_s: float = 30.0, retries: int = 2,
+    retry_wait_s: float = 0.2, kind: str = "kv_handoff",
+) -> dict:
     """Borrow-get a published handoff with a bounded retry budget.
 
     The get decodes zero-copy (arrays are read-only views into the mapped
@@ -198,7 +215,9 @@ def fetch(ref, meta: dict | None = None, *, timeout_s: float = 30.0, retries: in
     attempts absorb transient owner-side races; a handoff that is GONE
     (owner freed/evicted it, owner process died) raises HandoffLostError
     immediately on the loss signal after the final attempt — callers must
-    never hang on a dead handoff."""
+    never hang on a dead handoff. ``kind=PREFIX_KIND`` fetches a cluster
+    prefix block under the same retry contract (the kvplane client maps
+    the loss into its local-prefill fallback)."""
     from ray_tpu.core import direct as _direct
     from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
 
@@ -207,7 +226,7 @@ def fetch(ref, meta: dict | None = None, *, timeout_s: float = 30.0, retries: in
         try:
             t0 = time.time()
             value = _direct.get_owned_view(ref.id, timeout=timeout_s)
-            payload = decode(value)
+            payload = decode(value, kind=kind)
             if meta is not None and tuple(meta.get("shape", payload["k"].shape)) != tuple(payload["k"].shape):
                 raise HandoffError(f"fetched block {payload['k'].shape} does not match routed meta {meta['shape']}")
             _handoff_span("llm.handoff.fetch", payload, t0, attempts=attempt + 1)

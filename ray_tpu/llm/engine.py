@@ -47,6 +47,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ray_tpu.llm.kvplane.index import prefix_key, token_bytes
 from ray_tpu.llm.sampling import SamplingParams
 
 
@@ -80,6 +81,11 @@ class RequestState:
     # (trace_id, root_span_id, parent_span_id) when RT_TRACING=1; the
     # disagg handoff carries (trace_id, root_span_id) across replicas
     trace: tuple | None = None
+    # prefix resolution cached across steps while the request is
+    # head-of-line blocked (paged pool full): the lookup/fetch and its
+    # hit accounting (cache counters, telemetry tiers, any object-plane
+    # transfer) happen ONCE per request, never once per blocked step
+    cached_pref: tuple | None = None
 
 
 @dataclass
@@ -101,6 +107,20 @@ def _bucket(n: int, buckets) -> int:
     raise ValueError(f"prompt length {n} exceeds the largest prefill bucket {buckets[-1]}")
 
 
+# RequestState.cached_pref miss marker: prefix resolution ran and MISSED
+# (distinct from None = not yet resolved). Cached as (_PREF_MISS, gen,
+# expires_at) where gen is the local PrefixCache's store generation at
+# resolution time: a blocked request must not re-pay the lookup/fetch
+# every step, but a SAME-WAVE leader's store (admitted just before the
+# block hit pool pressure) mints the prefix after the miss resolved — the
+# generation mismatch re-resolves exactly then, so the follower still
+# gets its hit when pages free. expires_at additionally time-bounds the
+# miss on cluster-plane engines (another REPLICA's publish can't bump the
+# local generation); local-only engines never expire it (nothing external
+# can mint their keys).
+_PREF_MISS = object()
+
+
 class PrefixCache:
     """Hash-prefix KV reuse across requests (reference capability:
     enable_prefix_caching, python/ray/llm/_internal/serve/engines/vllm/
@@ -109,13 +129,27 @@ class PrefixCache:
     the slot cache's contiguous layout, and admission re-attends the
     remaining suffix with model_runner.extend).
 
-    Entries: hash(tokens[:n]) -> (k [L, n, kv, hd], v, n) on device.
-    LRU-evicted under a byte budget. Stats drive tests and metrics.
+    Entries: stable_hash(tokens[:n]) -> (k [L, n, kv, hd], v, n) on
+    device. Keys are CONTENT-STABLE blake2b digests over the token bytes
+    (kvplane/index.py) — never Python's process-salted ``hash()``, whose
+    PYTHONHASHSEED made the same prefix key out differently on every
+    replica — so the local cache and the cluster KV plane index
+    (ray_tpu/llm/kvplane/) speak one key space. LRU-evicted under a byte
+    budget; ``evict_hook`` (set by the plane client) hears each evicted
+    group's keys so published copies deregister-then-free before the
+    bytes die. Stats drive tests and metrics.
     """
 
     def __init__(self, block: int = 64, max_bytes: int = 256 << 20):
         self.block = block
         self.max_bytes = max_bytes
+        # called with the evicted group's key list (cluster KV plane:
+        # unregister + free the published block); None = local-only cache
+        self.evict_hook = None
+        # store generation: bumped whenever new boundary keys mint, so a
+        # cached resolution MISS (engine _PREF_MISS) knows when the cache
+        # gained entries that could turn it into a hit
+        self.gen = 0
         # one GROUP per stored prompt: shared (k, v) device arrays; every
         # block boundary of the prompt aliases into the group with its own
         # valid length (insert masks the padded tail, so no slicing)
@@ -129,16 +163,24 @@ class PrefixCache:
         self.tokens_saved = 0
         self.evictions = 0
 
-    def lookup(self, prompt_token_ids):
+    def lookup(self, prompt_token_ids, admissible=None):
         """Longest block-aligned cached prefix STRICTLY shorter than the
         prompt (at least one token must remain to produce logits). Hits
         are verified token-for-token — a hash collision must never serve
         a foreign prompt's KV (the reference block cache exact-matches
-        too)."""
+        too). ``admissible(n) -> bool`` filters boundaries BEFORE they
+        can match (the engine's suffix-overrun guard): a rejected longer
+        boundary falls through to the next shorter one instead of
+        discarding the whole lookup — and never inflates the hit
+        counters on its way out."""
         ids = tuple(int(t) for t in prompt_token_ids)  # tuple ONCE, slice per boundary
+        buf = token_bytes(ids)  # packed ONCE; each boundary hashes a slice
         n = ((len(ids) - 1) // self.block) * self.block
         while n >= self.block:
-            hit = self._keys.get(hash(ids[:n]))
+            if admissible is not None and not admissible(n):
+                n -= self.block
+                continue
+            hit = self._keys.get(prefix_key(buf, n))
             if hit is not None:
                 gid, n_valid = hit
                 k, v, _, _, group_ids = self._groups[gid]
@@ -161,27 +203,31 @@ class PrefixCache:
         block boundary. ks/vs: [L, T_pad, kv, hd] device arrays, stored
         padded to the prefix's PREFILL BUCKET so re-insert reuses the
         already-compiled insert program (a raw per-length shape would mint
-        one XLA program per distinct n)."""
+        one XLA program per distinct n). Returns ``(new_keys, pad)`` —
+        the freshly minted (key, n) boundary pairs and the stored block
+        width — so a cluster KV plane client can publish exactly what was
+        stored (None when nothing new was cached)."""
         n_max = (len(prompt_token_ids) // self.block) * self.block
         if n_max < self.block:
-            return
+            return None
         # ONE token tuple per group; boundary keys alias into it with
         # their valid length (no O(n^2/block) host tuples — lookup
         # verifies against slices of this single tuple)
         ids = tuple(int(t) for t in prompt_token_ids[:n_max])
+        buf = token_bytes(ids)
         new_keys = []
         for n in range(self.block, n_max + 1, self.block):
-            key = hash(ids[:n])
+            key = prefix_key(buf, n)
             if key not in self._keys:
                 new_keys.append((key, n))
         if not new_keys:
-            return
+            return None
         pad = _bucket(n_max, buckets)
         k = ks[:, :pad]
         v = vs[:, :pad]
         nbytes = int(k.nbytes) + int(v.nbytes)
         if nbytes > self.max_bytes:
-            return
+            return None
         while self._bytes + nbytes > self.max_bytes and self._order:
             self._evict_one()
         gid = self._next_gid
@@ -191,6 +237,8 @@ class PrefixCache:
             self._keys[key] = (gid, n)
         self._order.append(gid)
         self._bytes += nbytes
+        self.gen += 1
+        return new_keys, pad
 
     def _evict_one(self):
         gid = self._order.popleft()
@@ -199,6 +247,13 @@ class PrefixCache:
             self._keys.pop(key, None)
         self._bytes -= nbytes
         self.evictions += 1
+        if self.evict_hook is not None:
+            # the route must die before the bytes: the hook unregisters
+            # the published copy's keys and frees the owned block
+            try:
+                self.evict_hook(keys)
+            except Exception:  # noqa: BLE001 — plane trouble never breaks eviction
+                pass
 
     def stats(self) -> dict:
         return {
@@ -233,6 +288,7 @@ class LLMEngine:
         enable_prefix_caching: bool = True,
         prefix_cache_bytes: int = 256 << 20,
         prefix_block: int = 64,
+        kv_plane=None,
         kv_layout: str = "slots",
         num_pages: int | None = None,
         page_size: int = 64,
@@ -279,7 +335,18 @@ class LLMEngine:
         dtype; "int8" quantizes the all-reduce payload to int8 with f32
         amax scales (EQuARX, arxiv 2506.17615) — ~1/2 the ICI bytes per
         layer at bf16 operands, with the fp-collective engine as the
-        accuracy oracle (tests/test_llm_tp.py)."""
+        accuracy oracle (tests/test_llm_tp.py).
+
+        kv_plane (llm.kvplane.KVPlaneClient | None): joins this engine to
+        the CLUSTER prefix tier (ray_tpu/llm/kvplane/). Freshly cached
+        prefixes publish as owned objects on the direct plane; a local
+        prefix-cache miss consults the cluster index, fetches the longest
+        live remote block (bounded retry — an evicted/lost block degrades
+        to local prefill, never a hang), scatter-ins through the existing
+        fused insert/transparent-requant path, and re-stores + republishes
+        locally so the next hit is local-tier. Requires
+        enable_prefix_caching=True (the plane IS the cache's cluster
+        tier). prefix_cache_stats() grows local/remote hit tiers."""
         import jax
         import jax.numpy as jnp
 
@@ -409,6 +476,22 @@ class LLMEngine:
         self._prefix_cache = (
             PrefixCache(block=prefix_block, max_bytes=prefix_cache_bytes) if enable_prefix_caching else None
         )
+        # cluster KV plane (llm/kvplane/): publish stored prefixes, fetch
+        # remote hits, deregister on eviction. Remote-tier counters live
+        # here (the PrefixCache keeps its local-tier ones).
+        self._kv_plane = kv_plane
+        self._plane_stats = {
+            "hits": 0, "tokens_saved": 0, "fetched_bytes": 0,
+            "lost": 0, "published_blocks": 0, "published_bytes": 0,
+        }
+        if kv_plane is not None:
+            if self._prefix_cache is None:
+                raise ValueError(
+                    "kv_plane is the prefix cache's cluster tier and needs "
+                    "enable_prefix_caching=True (remote hits re-store locally)"
+                )
+            kv_plane.attach(self)
+            self._prefix_cache.evict_hook = kv_plane.on_evict
         self.preemption_count = 0
 
         from ray_tpu._config import get_config
@@ -728,8 +811,22 @@ class LLMEngine:
             return request_id
 
     def prefix_cache_stats(self) -> dict:
+        """Prefix-reuse accounting. Flat keys are the LOCAL cache's
+        legacy counters (hits/misses/tokens_saved/evictions/entries/
+        bytes); with a cluster KV plane attached the dict grows hit
+        TIERS — ``local`` (this replica's cache) and ``remote`` (blocks
+        fetched over the object plane: hits, tokens_saved, fetched_bytes,
+        lost, published_*) — plus the plane client's own counters under
+        ``plane``. Empty dict when prefix caching is off."""
         with self._lock:
-            return self._prefix_cache.stats() if self._prefix_cache else {}
+            if self._prefix_cache is None:
+                return {}
+            out = self._prefix_cache.stats()
+            out["local"] = {"hits": out["hits"], "tokens_saved": out["tokens_saved"]}
+            if self._kv_plane is not None:
+                out["remote"] = dict(self._plane_stats)
+                out["plane"] = self._kv_plane.stats()
+            return out
 
     # ------------------------------------------- prefill/decode disaggregation
 
@@ -1041,17 +1138,53 @@ class LLMEngine:
             slot = self._slots.index(None)
             # preempted sequences resume with generated tokens as prompt tail
             prompt = st.prompt_token_ids + st.token_ids
+            # pref: (k, v, n_valid, k_scale, v_scale) — scales None except
+            # for an int8-wire block fetched over the cluster KV plane
+            # (the fused insert requants transparently either way). The
+            # resolution caches on the request so a head-of-line wait
+            # (paged pool full -> break below) never re-looks-up, never
+            # refetches, and counts its hit exactly once per request
             pref = None
             if st.prefilled is None and self._prefix_cache is not None and not st.token_ids:
-                pref = self._prefix_cache.lookup(prompt)
-                if pref is not None:
-                    n_p = pref[2]
-                    Tm = _bucket(len(prompt) - n_p, self.prefill_buckets)
-                    if n_p + Tm > self.max_seq_len:
-                        # the bucket-padded suffix would overrun the cache
-                        # row (dynamic_update_slice would CLAMP the start
-                        # and silently corrupt the prefix) — full prefill
-                        pref = None
+                cached = st.cached_pref
+                if cached is not None and cached[0] is _PREF_MISS and (
+                    cached[1] != self._prefix_cache.gen or time.time() >= cached[2]
+                ):
+                    cached = None  # keys minted / miss lease lapsed: re-resolve
+                if cached is not None:
+                    pref = None if cached[0] is _PREF_MISS else cached
+                else:
+                    # suffix-overrun guard, applied INSIDE the lookup so a
+                    # rejected longest boundary falls through to the next
+                    # shorter LOCAL one (never off to a remote fetch of
+                    # bytes this replica already holds)
+                    local = self._prefix_cache.lookup(
+                        prompt, admissible=lambda n_p: self._prefix_fits(n_p, len(prompt))
+                    )
+                    if local is not None:
+                        pref = local + (None, None)
+                        if self._tel is not None:
+                            self._tel.on_prefix_hit("local", local[2])
+                        if self._kv_plane is not None:
+                            # publish self-heal: a boundary whose original
+                            # publish failed transiently would otherwise
+                            # stay cluster-invisible forever (store never
+                            # re-mints cached keys) — the client filters
+                            # already-published bounds, so this is a cheap
+                            # no-op in steady state
+                            self._plane_publish(prompt[: local[2]], local[0], local[1])
+                    elif self._kv_plane is not None:
+                        # cluster tier: longest live remote block, fetched
+                        # over the object plane; any failure inside
+                        # degrades to a plain local prefill (pref = None)
+                        pref = self._fetch_remote_prefix(prompt)
+                    if pref is None:
+                        # plane engines re-check after a short lease: a
+                        # PEER's publish can't bump the local generation
+                        exp = (time.time() + 1.0) if self._kv_plane is not None else float("inf")
+                        st.cached_pref = (_PREF_MISS, self._prefix_cache.gen, exp)
+                    else:
+                        st.cached_pref = pref
             pages = None
             if self.kv_layout == "paged":
                 need = self._pages_needed(st, pref, prompt)
@@ -1064,9 +1197,126 @@ class LLMEngine:
                 if pages is None:
                     break
             self._waiting.popleft()
+            st.cached_pref = None  # admission consumes the cached resolution
             self._slots[slot] = st  # reserve; _bind_slot fills the rest
             wave.append((st, slot, pref, pages, prompt))
         return wave
+
+    def _prefix_fits(self, n_p: int, prompt_len: int) -> bool:
+        """Suffix-overrun admissibility for a prefix boundary: the
+        bucket-padded remaining suffix must fit the cache row, or the
+        extend's dynamic_update_slice would CLAMP the start and silently
+        corrupt the prefix. The ONE predicate both the local lookup and
+        the remote candidate filter apply — the two tiers can never
+        disagree on admissibility."""
+        return n_p + _bucket(prompt_len - n_p, self.prefill_buckets) <= self.max_seq_len
+
+    def _fetch_remote_prefix(self, prompt):
+        """Cluster-tier prefix resolution (llm/kvplane/): longest live
+        remote block for this prompt's boundary keys, fetched over the
+        object plane with a bounded retry budget. Returns a pref tuple
+        ``(k, v, n_valid, k_scale, v_scale)`` ready for the existing
+        fused insert/transparent-requant admission path, or None — EVERY
+        failure mode (index down, block evicted, owner dead, token
+        mismatch, a dequant/re-store error post-fetch) degrades to a
+        plain local prefill, never an engine error or a hang.
+
+        On success the block is also RE-STORED into the local PrefixCache
+        and republished under this replica (when the wire dtype
+        round-trips byte-identically), so the next shared-prefix request
+        here is a local-tier hit."""
+        try:
+            return self._fetch_remote_prefix_inner(prompt)
+        except Exception:  # noqa: BLE001 — the plane is an accelerator, never a dependency
+            self._plane_stats["errors"] = self._plane_stats.get("errors", 0) + 1
+            return None
+
+    def _fetch_remote_prefix_inner(self, prompt):
+        from ray_tpu.llm.kvplane.index import boundary_keys
+
+        block = self._prefix_cache.block
+        # candidate boundaries whose bucket-padded suffix still fits the
+        # cache row (the SAME _prefix_fits guard as the local-hit path)
+        cands = [
+            (n, key) for n, key in boundary_keys(prompt, block)
+            if self._prefix_fits(n, len(prompt))
+        ]
+        if not cands:
+            return None
+        hit = self._kv_plane.lookup(cands)
+        if hit is None:
+            return None
+        # producer-bucket width gate BEFORE the transfer: the routed
+        # meta already carries the block shape, so a producer whose
+        # bucket ladder is narrower than our pad for this boundary
+        # (heterogeneous fleet config) costs nothing, not a multi-MB
+        # fetch discarded post-hoc
+        shape = tuple(hit.get("meta", {}).get("shape") or ())
+        if len(shape) > 1 and shape[1] < _bucket(int(hit["n"]), self.prefill_buckets):
+            return None
+        payload = self._kv_plane.fetch(hit)
+        if payload is None:
+            # evicted/lost remote block after the bounded retries: the
+            # client already reported the dead route to the index
+            self._plane_stats["lost"] += 1
+            return None
+        n_p = int(hit["n"])
+        # token-for-token verification — the same collision guarantee the
+        # local cache keeps: a hash collision (or a stale publish) must
+        # never serve a foreign prompt's KV
+        if payload["n"] < n_p or payload["prompt_token_ids"][:n_p] != [int(t) for t in prompt[:n_p]]:
+            return None
+        pad = _bucket(n_p, self.prefill_buckets)
+        if payload["k"].shape[1] < pad:
+            return None  # producer's bucket ladder narrower than ours
+        k_w, v_w = payload["k"][:, :pad], payload["v"][:, :pad]
+        k_sc, v_sc = payload.get("k_scale"), payload.get("v_scale")
+        if k_sc is not None:
+            k_sc, v_sc = k_sc[:, :, :pad], v_sc[:, :, :pad]
+        wire_int8 = str(k_w.dtype) == "int8"
+        nbytes = int(hit.get("meta", {}).get("nbytes") or (k_w.nbytes + v_w.nbytes))
+        self._plane_stats["hits"] += 1
+        self._plane_stats["tokens_saved"] += n_p
+        self._plane_stats["fetched_bytes"] += nbytes
+        if self._tel is not None:
+            self._tel.on_prefix_hit("remote", n_p, nbytes)
+        # local re-store + republish, but only when a later local hit
+        # reproduces the same cache bytes: fp wire re-inserts exactly;
+        # int8 wire dequantized re-quantizes byte-identically into an
+        # int8 cache (kv_quant idempotence) — an fp cache re-storing a
+        # dequantized int8 block would drift from its own prefill oracle
+        if wire_int8 == self.kv_quant:
+            import jax.numpy as jnp
+
+            if wire_int8:
+                k_fp, v_fp = self._kv_plane.dequantize_wire(k_w, v_w, k_sc, v_sc)
+            else:
+                k_fp, v_fp = jnp.asarray(k_w), jnp.asarray(v_w)
+            stored = self._prefix_cache.store(prompt[:n_p], k_fp, v_fp, self.prefill_buckets)
+            if stored is not None:
+                self._plane_publish(prompt[:n_p], k_fp, v_fp, *stored)
+        return (k_w, v_w, n_p, k_sc, v_sc)
+
+    def _plane_publish(self, prompt, ks, vs, new_keys=None, pad=None):
+        """Publish a prefix block to the cluster plane (owned object +
+        index registration). ``new_keys`` scopes registration to the
+        boundaries the local cache just minted (the store path); None
+        lets the client cover every still-unpublished boundary (the
+        local-hit self-heal after a transient publish failure). Failures
+        degrade silently — the client counts them; serving never depends
+        on the plane."""
+        block = self._prefix_cache.block
+        n_max = (len(prompt) // block) * block
+        if n_max < block:
+            return
+        pad = int(ks.shape[1]) if pad is None else pad
+        nbytes = self._kv_plane.publish(
+            [int(t) for t in prompt[:n_max]], ks[:, :pad], vs[:, :pad],
+            bounds=None if new_keys is None else [(n, key) for key, n in new_keys],
+        )
+        if nbytes:
+            self._plane_stats["published_blocks"] += 1
+            self._plane_stats["published_bytes"] += nbytes
 
     def _stage_prefill(self, wave: list) -> list:
         """PREFILL stage (execution): run the admission wave's forwards.
@@ -1130,7 +1380,11 @@ class LLMEngine:
         for i, (st, slot, prompt) in enumerate(group):
             n = len(prompt)
             if self._prefix_cache is not None and not st.token_ids:
-                self._prefix_cache.store(prompt, ks[:, i], vs[:, i], self.prefill_buckets)
+                stored = self._prefix_cache.store(prompt, ks[:, i], vs[:, i], self.prefill_buckets)
+                if stored is not None and self._kv_plane is not None:
+                    # the block every other replica would re-prefill —
+                    # publish it to the cluster tier (llm/kvplane/)
+                    self._plane_publish(prompt, ks[:, i], vs[:, i], *stored)
             if self.kv_layout == "paged":
                 page = self._pcfg.page_size
                 table_row = jnp.asarray(self._tables[slot])
@@ -1190,14 +1444,19 @@ class LLMEngine:
             if self._tel is not None:
                 self._tel.on_scatter_in(st, t_scatter)
         else:
-            k_p, v_p, n_p = pref
+            k_p, v_p, n_p, k_sc, v_sc = pref
             m = n - n_p
             Tm = _bucket(m, self.prefill_buckets)
             # the cache stores K/V at the ORIGINAL prompt's bucket width;
             # the hit may be any block-aligned prefix of it — slice to the
-            # matched length (page-aligned: page_size divides prefix_block)
+            # matched length (page-aligned: page_size divides prefix_block).
+            # A cluster-plane remote hit arrives with wire-layout scales
+            # when the producer cache was int8; insert_pages requants
+            # transparently exactly like the disagg scatter-in.
+            scales = () if k_sc is None else (jnp.asarray(k_sc[:, :, :n_p]), jnp.asarray(v_sc[:, :, :n_p]))
             self.pool = self._insert(
-                self.pool, table_row[: n_p // page], jnp.asarray(k_p)[:, :n_p], jnp.asarray(v_p)[:, :n_p]
+                self.pool, table_row[: n_p // page], jnp.asarray(k_p)[:, :n_p], jnp.asarray(v_p)[:, :n_p],
+                *scales,
             )
             toks = np.zeros((Tm,), np.int32)
             toks[:m] = prompt[n_p:]
@@ -1240,11 +1499,15 @@ class LLMEngine:
                 self._tel.on_scatter_in(st, t_scatter)
             logits = jnp.asarray(kv["logits"])[None]
         else:
-            # reuse the cached prefix KV; re-attend only the suffix
-            k_p, v_p, n_p = pref
+            # reuse the cached prefix KV; re-attend only the suffix. A
+            # cluster-plane remote hit carries wire-layout scales when the
+            # producer cache was int8 — insert_sequence requants
+            # transparently, same contract as the disagg scatter-in.
+            k_p, v_p, n_p, k_sc, v_sc = pref
             m = n - n_p
             Tm = _bucket(m, self.prefill_buckets)
-            self.cache = self._insert(self.cache, slot, k_p, v_p, n_p)
+            scales = () if k_sc is None else (jnp.asarray(k_sc), jnp.asarray(v_sc))
+            self.cache = self._insert(self.cache, slot, jnp.asarray(k_p), jnp.asarray(v_p), n_p, *scales)
             toks = np.zeros((Tm,), np.int32)
             toks[:m] = prompt[n_p:]
             logits, self.cache = self._extend(
@@ -1417,7 +1680,11 @@ class LLMEngine:
                 outs = self._build_outputs(reported)
                 if tel is not None:
                     tel.on_step(t0, len(admitted), self._step_emitted, self._last_spec_drain)
-                return outs
+            if self._kv_plane is not None:
+                # refresh the cluster-index lease (throttled, outside the
+                # engine lock — a slow index can never stall admissions)
+                self._kv_plane.maybe_heartbeat()
+            return outs
         except BaseException as exc:
             # postmortem: persist the flight ring as JSONL in the session
             # dir before the error surfaces (serve marks the replica

@@ -1,0 +1,44 @@
+"""ray_tpu.llm.kvplane — cluster-wide prefix/KV reuse over the object plane.
+
+Each engine's ``PrefixCache`` dies with its replica; at fleet scale with
+shared system prompts every replica re-prefills the same prefix. This
+package turns those private caches into one cluster tier:
+
+- **index.py** — content-stable blake2b prefix keys at block boundaries
+  (the SAME keying the local cache uses, so a key computed on any
+  replica matches every other) and ``PrefixIndex``, the cluster map of
+  key -> {replica -> (n_valid, meta, ref)} with lease-based staleness;
+- **client.py** — ``KVPlaneClient``: replicas publish freshly cached
+  prefix blocks as OWNED objects (direct.put_owned, the disagg handoff
+  codec with ``kind=kv_prefix`` — int8 wire for int8 caches) and fetch
+  remote hits zero-copy with a bounded retry budget; every failure
+  degrades to local prefill, never an error;
+- **routing.py** — ``CacheAwareRouter``: scores replicas by longest
+  cached prefix blended with load (local tier beats remote tier beats
+  cold), so shared-prefix traffic lands where its KV already lives;
+- **quant.py** — fused wire quantize/dequantize programs (jaxcheck
+  entries) bridging fp PrefixCache entries and the int8 wire format.
+
+Engine integration: ``LLMEngine(kv_plane=KVPlaneClient(...))`` — a local
+prefix-cache miss consults the index, fetches the longest live remote
+block, scatter-ins through the existing fused insert/transparent-requant
+path, and re-publishes locally. Serve integration (KVIndexServer /
+KVPlaneServer / KVRouterServer, build_kvplane_deployment) lives in
+ray_tpu.serve.llm. Tests: tests/test_llm_kvplane.py.
+"""
+
+from ray_tpu.llm.kvplane.client import KVPlaneClient
+from ray_tpu.llm.kvplane.index import PrefixIndex, boundary_keys, stable_hash, token_bytes
+from ray_tpu.llm.kvplane.routing import CacheAwareRouter, KVRouteError, rank_replicas, score_replica
+
+__all__ = [
+    "CacheAwareRouter",
+    "KVPlaneClient",
+    "KVRouteError",
+    "PrefixIndex",
+    "boundary_keys",
+    "rank_replicas",
+    "score_replica",
+    "stable_hash",
+    "token_bytes",
+]

@@ -1,0 +1,339 @@
+"""Replica-side client of the cluster KV plane.
+
+One ``KVPlaneClient`` per engine glues three planes together:
+
+- **data plane**: published prefix blocks are OWNED objects in THIS
+  process (core/direct.put_owned — bytes in shm, descriptor in the
+  replica's OwnedStore; the replica owns each block for its whole life,
+  same lifecycle as a disagg handoff). Remote hits borrow-get them
+  zero-copy (``get_owned_view``) with a bounded retry budget.
+- **control plane**: the cluster ``PrefixIndex`` (index.py) — in-process
+  object in tests/benches, a Serve deployment handle in a fleet (duck-
+  typed on ``.remote``: the SAME client code drives both).
+- **wire format**: the disagg handoff codec with ``kind=PREFIX_KIND``
+  (llm/disagg/handoff.py) — shape/dtype/scale validation, int8 wire for
+  int8-cache engines (wire blocks quantized by the fused
+  ``kvplane.quant`` program so remote scatter-ins are byte-identical to
+  local prefill).
+
+Failure policy: the plane is an ACCELERATOR, never a dependency. Every
+index RPC and every fetch is bounded and caught — a dead index, a dead
+holder, or an evicted block degrades to "local prefill", counted in
+``stats()``, and can never wedge or crash the serving engine. Local
+eviction of a published block first unregisters its keys (the route dies
+before the bytes) and then frees the owned object; the leak backstop
+(RT_OWNED_OBJECT_LEAK_BACKSTOP_S) covers borrowers that died mid-fetch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ray_tpu.llm.kvplane.index import boundary_keys, stable_hash  # noqa: F401 (re-export for engines)
+
+
+def index_call(index, name: str, *args, timeout_s: float = 10.0):
+    """Dispatch one index method against either transport: a Serve
+    deployment handle (``.remote(...).result(timeout_s)``) or an
+    in-process PrefixIndex (direct call). The ONE copy of this duck-type
+    — the client and the cache-aware router both route through it, so
+    transport semantics can never diverge between them. Raises on
+    transport failure; callers own their degrade policy."""
+    method = getattr(index, name)
+    remote = getattr(method, "remote", None)
+    if remote is not None:
+        return remote(*args).result(timeout_s=timeout_s)
+    return method(*args)
+
+
+class KVPlaneClient:
+    """Publish / lookup / fetch / evict against a cluster prefix index.
+
+    ``index``: a PrefixIndex or a Serve deployment handle exposing the
+    same methods. ``replica_id`` defaults to the telemetry replica tag
+    (worker id / pid) so index entries, metrics and flight records all
+    name the replica identically."""
+
+    def __init__(
+        self,
+        index,
+        replica_id: str | None = None,
+        *,
+        fetch_timeout_s: float = 5.0,
+        fetch_retries: int = 1,
+        retry_wait_s: float = 0.1,
+        heartbeat_every_s: float = 5.0,
+        index_timeout_s: float = 10.0,
+        index_down_cooldown_s: float = 30.0,
+        publish: bool = True,
+    ):
+        import os
+
+        self._index = index
+        self.replica_id = str(replica_id or os.environ.get("RT_WORKER_ID", str(os.getpid())))
+        self.fetch_timeout_s = float(fetch_timeout_s)
+        self.fetch_retries = int(fetch_retries)
+        self.retry_wait_s = float(retry_wait_s)
+        self.heartbeat_every_s = float(heartbeat_every_s)
+        self.index_timeout_s = float(index_timeout_s)
+        self.index_down_cooldown_s = float(index_down_cooldown_s)
+        self._publish_enabled = bool(publish)
+        # circuit breaker: repeated index failures open it for a cooldown
+        # so a DEAD index costs one timeout, not one per admission under
+        # the engine lock (heartbeats keep probing and close it on success)
+        self._consec_errors = 0
+        self._down_until = 0.0
+        self._lock = threading.Lock()
+        self._published: dict[bytes, tuple] = {}  # boundary key -> (n, meta, ref)
+        self._ref_keys: dict[bytes, set] = {}  # ref id -> live boundary keys
+        self._evict_q = None  # lazy: SimpleQueue + daemon worker on first evict
+        self._last_heartbeat = 0.0
+        # attach() fills these from the engine's config
+        self._wire_int8 = False
+        self._compute_dtype = "float32"
+        self._block = 64
+        self._quantize = self._dequantize = None
+        self.counts = {
+            "published_blocks": 0, "published_bytes": 0, "unpublished_blocks": 0,
+            "fetches": 0, "fetched_bytes": 0, "fetch_lost": 0,
+            "index_errors": 0, "publish_errors": 0,
+        }
+
+    # -- engine wiring -----------------------------------------------------
+    def attach(self, engine) -> None:
+        """Bind the client to its engine's cache format: int8-cache
+        engines publish int8 wire blocks (fused quantize, ~half the
+        bytes); fp engines publish at the block's own dtype."""
+        self._wire_int8 = bool(engine.kv_quant)
+        self._compute_dtype = str(engine.config.dtype)
+        if engine._prefix_cache is not None:
+            self._block = int(engine._prefix_cache.block)
+        if self._wire_int8 and self._quantize is None:
+            from ray_tpu.llm.kvplane.quant import make_wire_fns
+
+            self._quantize, self._dequantize = make_wire_fns()
+
+    # -- index transport ---------------------------------------------------
+    def _safe_call(self, name: str, *args, default=None):
+        try:
+            out = index_call(self._index, name, *args, timeout_s=self.index_timeout_s)
+        except BaseException:  # noqa: BLE001 — a dead index must degrade, never propagate
+            self.counts["index_errors"] += 1
+            self._consec_errors += 1
+            if self._consec_errors >= 2:
+                self._down_until = time.time() + self.index_down_cooldown_s
+            return default
+        self._consec_errors = 0
+        self._down_until = 0.0
+        return out
+
+    def index_down(self) -> bool:
+        """Circuit-breaker state: True while recent consecutive index
+        failures have the plane opened (lookups/publishes short-circuit
+        instead of paying a timeout per admission)."""
+        return time.time() < self._down_until
+
+    def maybe_heartbeat(self) -> None:
+        """Refresh this replica's index lease, throttled (host wall clock
+        only; called from the engine's step tail and the serve stepper's
+        idle wait). The heartbeat reply carries the index's key count for
+        this replica: fewer than we hold published means the index pruned
+        us (partition outliving the lease) — re-register every live block
+        so pruned entries can never stay unroutable forever."""
+        now = time.time()
+        if now - self._last_heartbeat < self.heartbeat_every_s:
+            return
+        self._last_heartbeat = now
+        known = self._safe_call("heartbeat", self.replica_id)
+        if known is None:
+            return
+        with self._lock:
+            entries = (
+                [(key, n, meta, ref) for key, (n, meta, ref) in self._published.items()]
+                if int(known) < len(self._published) else None
+            )
+        if entries:
+            self._safe_call("register", self.replica_id, entries)
+
+    # -- publish -----------------------------------------------------------
+    def publish(self, prefix_ids, k_blk, v_blk, bounds: list | None = None) -> int:
+        """Publish one prefix block (fp device/host arrays [L, T_pad, kv,
+        hd], T_pad >= len(prefix_ids)) as an owned object and register
+        its block boundaries against the one ref. ``bounds`` ([(n, key)])
+        restricts registration to boundaries the local cache just minted
+        (already-published boundaries keep their existing block); default
+        is every boundary of ``prefix_ids``. Returns published bytes
+        (0 = skipped/failed; the plane degrades, it never raises into the
+        prefill stage)."""
+        if not self._publish_enabled or self.index_down():
+            return 0
+        from ray_tpu.core import direct as _direct
+        from ray_tpu.llm.disagg import handoff
+
+        if bounds is None:
+            bounds = boundary_keys(prefix_ids, self._block, strict=False)
+        with self._lock:
+            bounds = [(bn, key) for bn, key in bounds if bytes(key) not in self._published]
+        if not bounds:
+            return 0
+        n = len(prefix_ids)
+        try:
+            payload = {"n": n, "prompt_token_ids": [int(t) for t in prefix_ids]}
+            if self._wire_int8:
+                kq, vq, ks, vs = self._quantize(k_blk, v_blk)
+                payload.update(k=np.asarray(kq), v=np.asarray(vq),
+                               k_scale=np.asarray(ks), v_scale=np.asarray(vs))
+            else:
+                payload.update(k=np.asarray(k_blk), v=np.asarray(v_blk))
+            wire = handoff.encode(payload, kind=handoff.PREFIX_KIND)
+            meta = handoff.meta_of(wire)
+        except BaseException:  # noqa: BLE001 — transient (XLA/codec): next store retries
+            self.counts["publish_errors"] += 1
+            return 0
+        try:
+            ref = _direct.put_owned(wire)
+        except RuntimeError as e:
+            self.counts["publish_errors"] += 1
+            if "direct plane" in str(e):
+                # no direct plane in this process (ray_tpu.init never
+                # ran): publishing is PERMANENTLY impossible — stop
+                # serializing blocks. Any other RuntimeError is transient
+                # and must not disable the tier for the engine's life.
+                self._publish_enabled = False
+            return 0
+        except BaseException:  # noqa: BLE001
+            self.counts["publish_errors"] += 1
+            return 0
+        # every covered boundary aliases the ONE ref with its own valid
+        # length — a shorter-prefix lookup slices the same block
+        entries = [(key, bn, meta, ref) for bn, key in bounds]
+        if self._safe_call("register", self.replica_id, entries) is None:
+            # index unreachable: nobody can ever route to this block —
+            # free it now instead of stranding owner-side bytes
+            try:
+                _direct.free_owned([ref.id])
+            except BaseException:  # noqa: BLE001
+                pass
+            return 0
+        with self._lock:
+            for bn, key in bounds:
+                self._published[bytes(key)] = (bn, meta, ref)
+            self._ref_keys[ref.id.binary()] = {bytes(key) for _, key in bounds}
+        self.counts["published_blocks"] += 1
+        self.counts["published_bytes"] += int(meta["nbytes"])
+        return int(meta["nbytes"])
+
+    # -- lookup / fetch ----------------------------------------------------
+    def lookup(self, keys: list):
+        """Longest live remote match for ``[(n, key)]`` boundary keys
+        (excluding this replica's own entries — its local cache already
+        missed). None on miss, index failure, or while the breaker is
+        open (a dead index must not cost a timeout per admission)."""
+        if self.index_down():
+            return None
+        return self._safe_call("lookup", keys, self.replica_id, self.replica_id, default=None)
+
+    def fetch(self, hit: dict):
+        """Borrow-get a remote prefix block (bounded retry, zero-copy
+        decode + full codec validation). Returns the decoded payload, or
+        None when the block is gone/corrupt — the dead route is reported
+        back to the index so nobody retries it."""
+        from ray_tpu.llm.disagg import handoff
+
+        self.counts["fetches"] += 1
+        try:
+            payload = handoff.fetch(
+                hit["ref"], hit.get("meta"), kind=handoff.PREFIX_KIND,
+                timeout_s=self.fetch_timeout_s, retries=self.fetch_retries,
+                retry_wait_s=self.retry_wait_s,
+            )
+        except (handoff.HandoffLostError, handoff.HandoffError):
+            self.counts["fetch_lost"] += 1
+            self._safe_call("report_lost", hit.get("replica"), hit.get("key"))
+            return None
+        nbytes = int(hit.get("meta", {}).get("nbytes") or (payload["k"].nbytes + payload["v"].nbytes))
+        self.counts["fetched_bytes"] += nbytes
+        return payload
+
+    def dequantize_wire(self, k_blk, v_blk, k_scale, v_scale):
+        """Int8 wire block -> fp device twins at the engine's compute
+        dtype (fused program; see kvplane/quant.py), for the local
+        re-store of a fetched remote prefix."""
+        if self._dequantize is None:
+            from ray_tpu.llm.kvplane.quant import make_wire_fns
+
+            self._quantize, self._dequantize = make_wire_fns()
+        import jax.numpy as jnp
+
+        return self._dequantize(
+            jnp.asarray(k_blk), jnp.asarray(v_blk),
+            jnp.asarray(k_scale), jnp.asarray(v_scale), self._compute_dtype,
+        )
+
+    # -- eviction ----------------------------------------------------------
+    def on_evict(self, keys: list) -> None:
+        """PrefixCache eviction hook: the local block is dying, so (1)
+        unregister its boundary keys — the route dies first — and (2)
+        free the owned object once no boundary still references it.
+        Fetches racing this see ObjectLostError and fall back to local
+        prefill (the bounded-retry contract).
+
+        Runs under the ENGINE lock (store -> LRU evict), so the index RPC
+        and the free are handed to a worker thread — a slow or dead index
+        must never stall the serving loop through the eviction path (the
+        same rule the heartbeat already follows). The worker preserves
+        unregister-then-free order per eviction."""
+        dead_refs = []
+        with self._lock:
+            for key in keys:
+                entry = self._published.pop(bytes(key), None)
+                if entry is None:
+                    continue
+                ref = entry[2]
+                rk = ref.id.binary()
+                alive = self._ref_keys.get(rk)
+                if alive is not None:
+                    alive.discard(bytes(key))
+                    if not alive:
+                        del self._ref_keys[rk]
+                        dead_refs.append(ref)
+            if not keys:
+                return
+            if self._evict_q is None:
+                import queue as _queue
+
+                self._evict_q = _queue.SimpleQueue()
+                t = threading.Thread(target=self._evict_worker, daemon=True, name="kvplane-evict")
+                t.start()
+        self._evict_q.put(([bytes(k) for k in keys], dead_refs))
+
+    def _evict_worker(self):
+        """Drains eviction work off the engine's hot path: unregister the
+        route first, then free the bytes."""
+        from ray_tpu.core import direct as _direct
+
+        while True:
+            keys, dead_refs = self._evict_q.get()
+            if not self.index_down():
+                # skipped while the breaker is open: fetchers hitting the
+                # stale route get ObjectLostError and report_lost cleans it
+                self._safe_call("unregister", self.replica_id, keys)
+            for ref in dead_refs:
+                try:
+                    _direct.free_owned([ref.id])
+                    self.counts["unpublished_blocks"] += 1
+                except BaseException:  # noqa: BLE001
+                    pass  # the leak backstop reclaims what an errored free leaves
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                **self.counts,
+                "replica_id": self.replica_id,
+                "live_published_keys": len(self._published),
+                "wire_dtype": "int8" if self._wire_int8 else self._compute_dtype,
+                "index_down": self.index_down(),
+            }

@@ -1,0 +1,256 @@
+"""Cluster prefix index: stable token-hash keys + a replica-entry map.
+
+The cluster KV plane (ray_tpu/llm/kvplane/) turns each engine's private
+``PrefixCache`` into a fleet-wide tier. The glue is a CONTENT-STABLE key:
+``stable_hash`` is blake2b over the prefix's token bytes, so every
+replica — and the index actor — derives the identical key for the same
+tokens. (Python's builtin ``hash()`` over a token tuple is salted per
+process by PYTHONHASHSEED: two replicas disagree on every key, which is
+exactly why the local cache used to be un-shareable.) Keys exist only at
+prefix-block boundaries, mirroring the local cache's block-aligned
+keying: the set of boundary keys of a prompt is what both the local
+lookup and the cluster lookup walk.
+
+``PrefixIndex`` is the cluster-side map: key -> {replica -> (n_valid,
+meta, ref)}. Replicas publish their freshly cached prefix blocks as
+OWNED objects on the direct plane (client.py) and register the (key,
+ref) pairs here; the serve router asks ``match_replicas`` to score
+candidates by longest cached prefix, and an engine that misses locally
+asks ``lookup`` for the longest live remote holder.
+
+Liveness is lease-based: every call a replica makes refreshes its
+``last_seen`` stamp, and entries of a replica silent for ``ttl_s`` stop
+matching (and are pruned opportunistically). A dead replica's owned
+blocks die with its process anyway — the index must merely stop routing
+to them, never hand out a ref whose owner is known-gone. A fetch that
+races an eviction still fails cleanly: the fetch path is bounded-retry
+and reports the loss back via ``report_lost``.
+
+The class is serve-agnostic and lock-safe: under Serve it lives inside
+the ``KVIndexServer`` deployment (serve/llm.py); tests and benches call
+it directly in-process.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+
+import numpy as np
+
+# domain salt: a kvplane key can never collide with another subsystem's
+# blake2b use of the same token bytes
+_SALT = b"rt-kvplane-v1:"
+_TOKEN_BYTES = 4  # tokens hash as little-endian int32
+
+
+def token_bytes(token_ids) -> bytes:
+    """Canonical byte encoding of a token sequence (int32 LE). The ONE
+    representation both the local cache and the cluster index hash —
+    shared here so they can never drift."""
+    return np.asarray(token_ids, dtype=np.int32).tobytes()
+
+
+def stable_hash(token_ids) -> bytes:
+    """Content-stable 128-bit key for a token prefix (blake2b digest).
+
+    Accepts a token sequence or pre-packed ``token_bytes`` output.
+    Process-independent (unlike builtin ``hash``): replica A's key for a
+    prefix equals replica B's and the index actor's. Collisions are
+    cryptographically unlikely, and consumers still verify fetched
+    blocks token-for-token before trusting them (the same guarantee the
+    local cache keeps)."""
+    buf = token_ids if isinstance(token_ids, (bytes, bytearray, memoryview)) else token_bytes(token_ids)
+    return hashlib.blake2b(_SALT + bytes(buf), digest_size=16).digest()
+
+
+def prefix_key(buf: bytes, n: int) -> bytes:
+    """Key for the first ``n`` tokens of a pre-packed ``token_bytes``
+    buffer — the per-boundary slice both PrefixCache and boundary_keys
+    hash, factored out so the byte math lives in one place."""
+    return stable_hash(buf[: _TOKEN_BYTES * n])
+
+
+def boundary_keys(token_ids, block: int, *, strict: bool = True) -> list:
+    """``[(n, key)]`` for every block boundary of the sequence (ascending
+    n). ``strict`` (the LOOKUP side) keeps boundaries STRICTLY shorter
+    than the prompt — at least one token must remain un-cached to produce
+    first-token logits, matching PrefixCache.lookup's bound.
+    ``strict=False`` (the PUBLISH side) includes the full length of an
+    already block-aligned prefix: a published block registers under every
+    boundary it covers, its own tail included."""
+    ids = list(token_ids)
+    buf = token_bytes(ids)
+    n_max = ((len(ids) - (1 if strict else 0)) // block) * block
+    return [(n, prefix_key(buf, n)) for n in range(block, n_max + 1, block)]
+
+
+class PrefixIndex:
+    """Cluster-wide prefix-block registry with lease-based liveness.
+
+    Thread-safe; all methods are cheap dict work (the index never touches
+    KV bytes — refs and small meta dicts only). ``time_fn`` is injectable
+    for staleness tests."""
+
+    def __init__(self, *, ttl_s: float = 30.0, time_fn=None):
+        self.ttl_s = float(ttl_s)
+        self._now = time_fn or time.time
+        self._lock = threading.Lock()
+        # key -> {replica -> {"n": int, "meta": dict, "ref": ObjectRef}}
+        self._entries: dict[bytes, dict[str, dict]] = {}
+        # replica -> {"last_seen": float, "keys": set[bytes]}
+        self._replicas: dict[str, dict] = {}
+        self.counts = {
+            "registered": 0, "unregistered": 0, "expired": 0,
+            "lookups": 0, "hits": 0, "lost_reports": 0,
+        }
+
+    # -- liveness ----------------------------------------------------------
+    def _touch(self, replica: str) -> None:
+        rec = self._replicas.setdefault(replica, {"last_seen": 0.0, "keys": set()})
+        rec["last_seen"] = self._now()
+
+    def _alive(self, replica: str, now: float) -> bool:
+        rec = self._replicas.get(replica)
+        return rec is not None and (now - rec["last_seen"]) <= self.ttl_s
+
+    def heartbeat(self, replica: str) -> int:
+        """Refresh the replica's lease. Returns how many keys the index
+        holds for it — a replica that was pruned (network partition
+        outliving the lease + an expire()) sees fewer than it published
+        and re-registers its live blocks (client.maybe_heartbeat)."""
+        with self._lock:
+            self._touch(replica)
+            return len(self._replicas[replica]["keys"])
+
+    def expire(self) -> int:
+        """Prune every entry belonging to a replica past its lease.
+        Matching already ignores stale replicas, so this is garbage
+        collection, not correctness; called opportunistically."""
+        with self._lock:
+            now = self._now()
+            dead = [r for r in self._replicas if not self._alive(r, now)]
+            n = 0
+            for r in dead:
+                n += self._drop_replica_locked(r)
+            self.counts["expired"] += n
+            return n
+
+    def _drop_replica_locked(self, replica: str) -> int:
+        rec = self._replicas.pop(replica, None)
+        if rec is None:
+            return 0
+        n = 0
+        for key in rec["keys"]:
+            holders = self._entries.get(key)
+            if holders and holders.pop(replica, None) is not None:
+                n += 1
+                if not holders:
+                    del self._entries[key]
+        return n
+
+    def drop_replica(self, replica: str) -> int:
+        """Remove every entry a replica registered (explicit teardown)."""
+        with self._lock:
+            return self._drop_replica_locked(replica)
+
+    # -- registration ------------------------------------------------------
+    def register(self, replica: str, entries: list) -> int:
+        """``entries``: [(key, n_valid, meta, ref)] — every block
+        boundary of one published block aliases the SAME ref with its own
+        valid length (the consumer slices). Returns how many registered."""
+        with self._lock:
+            self._touch(replica)
+            rec = self._replicas[replica]
+            for key, n, meta, ref in entries:
+                self._entries.setdefault(bytes(key), {})[replica] = {
+                    "n": int(n), "meta": dict(meta or {}), "ref": ref,
+                }
+                rec["keys"].add(bytes(key))
+            self.counts["registered"] += len(entries)
+            return len(entries)
+
+    def unregister(self, replica: str, keys: list) -> int:
+        """Drop a replica's entries for ``keys`` (local eviction: the
+        owner is about to free the block, so the route must die first)."""
+        with self._lock:
+            self._touch(replica)
+            rec = self._replicas.get(replica)
+            n = 0
+            for key in keys:
+                key = bytes(key)
+                holders = self._entries.get(key)
+                if holders and holders.pop(replica, None) is not None:
+                    n += 1
+                    if not holders:
+                        del self._entries[key]
+                if rec is not None:
+                    rec["keys"].discard(key)
+            self.counts["unregistered"] += n
+            return n
+
+    def report_lost(self, replica: str, key) -> None:
+        """A fetch found the block gone (evicted/owner died mid-race):
+        drop the dead route so nobody else burns a retry on it."""
+        with self._lock:
+            self.counts["lost_reports"] += 1
+            holders = self._entries.get(bytes(key))
+            if holders and holders.pop(replica, None) is not None:
+                rec = self._replicas.get(replica)
+                if rec is not None:
+                    rec["keys"].discard(bytes(key))
+                if not holders:
+                    del self._entries[bytes(key)]
+
+    # -- lookup ------------------------------------------------------------
+    def lookup(self, keys: list, exclude: str | None = None, requester: str | None = None):
+        """Longest live match for a prompt's boundary ``[(n, key)]`` list
+        (ascending). Returns {"key", "n", "replica", "meta", "ref"} or
+        None. ``exclude`` skips the requester's own entries (its local
+        cache already missed — its published copy is the same bytes);
+        ``requester`` refreshes the caller's lease for free."""
+        with self._lock:
+            if requester is not None:
+                self._touch(requester)
+            self.counts["lookups"] += 1
+            now = self._now()
+            for n, key in reversed(list(keys)):
+                holders = self._entries.get(bytes(key))
+                if not holders:
+                    continue
+                live = [
+                    (rep, e) for rep, e in holders.items()
+                    if rep != exclude and self._alive(rep, now)
+                ]
+                if not live:
+                    continue
+                # freshest lease wins: most-recently-seen holder is the
+                # least likely to have died since
+                rep, e = max(live, key=lambda it: self._replicas[it[0]]["last_seen"])
+                self.counts["hits"] += 1
+                return {"key": bytes(key), "n": e["n"], "replica": rep, "meta": dict(e["meta"]), "ref": e["ref"]}
+            return None
+
+    def match_replicas(self, keys: list) -> dict:
+        """{replica -> longest matched prefix length} over live replicas —
+        the router's cache-aware scoring input. Dead replicas never
+        appear (the 'router never routes to them' staleness contract)."""
+        with self._lock:
+            now = self._now()
+            out: dict[str, int] = {}
+            for n, key in keys:
+                for rep in self._entries.get(bytes(key), {}):
+                    if self._alive(rep, now) and out.get(rep, 0) < n:
+                        out[rep] = n
+            return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            now = self._now()
+            return {
+                **self.counts,
+                "keys": len(self._entries),
+                "replicas_live": sum(1 for r in self._replicas if self._alive(r, now)),
+                "replicas_known": len(self._replicas),
+            }

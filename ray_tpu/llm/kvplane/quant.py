@@ -1,0 +1,86 @@
+"""Fused wire quantize/dequantize for published prefix blocks.
+
+An int8-cache replica publishes its prefix blocks in the int8 handoff
+wire format (values + [L, kv, T] per-head scales — ~half the
+object-plane bytes of an fp block), but the local ``PrefixCache`` holds
+the fp prefill output. These two programs bridge the formats on the
+publish and remote-hit paths:
+
+- ``wire_quantize``: fp block -> (int8 values, wire-layout scales), ONE
+  program per bucket width. Uses the exact ``kv_quant.quantize_heads``
+  recipe the fused append/insert paths use, so the bytes a remote int8
+  consumer scatters in are bit-identical to what its own local prefill
+  would have written — the cross-replica token-identity guarantee rests
+  on this.
+- ``wire_dequantize``: int8 wire block -> fp block at the consumer's
+  compute dtype, for re-storing a fetched remote prefix into the LOCAL
+  PrefixCache (whose entries are fp). Quantization is idempotent at the
+  byte level (kv_quant.py), so a later local hit re-quantizing this
+  output reproduces the same cache bytes.
+
+Both are ``@jaxcheck.entry`` so donation and the JXC003 dequant trap
+stay audited on the publish path like every other serving program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.lint import jaxcheck
+from ray_tpu.llm.kv_quant import dequantize, quantize_heads
+from ray_tpu.llm.model_runner import _sds, _trace_cfg
+
+
+def _bucket_wire_quantize(T=128):
+    cfg = _trace_cfg()
+    blk = _sds((cfg.num_layers, T, cfg.num_kv_heads, cfg.hd), jnp.dtype(cfg.dtype))
+    return (blk, blk), {}
+
+
+def _bucket_wire_dequantize(T=128):
+    cfg = _trace_cfg()
+    blk = _sds((cfg.num_layers, T, cfg.num_kv_heads, cfg.hd), jnp.int8)
+    sc = _sds((cfg.num_layers, cfg.num_kv_heads, T), jnp.float32)
+    return (blk, blk, sc, sc), {"dtype": "float32"}
+
+
+@jaxcheck.entry(
+    name="llm.kvplane_wire_quantize",
+    shapes={"t128": _bucket_wire_quantize},
+    donate_bytes=0,  # publish path: dtype changes, nothing aliasable
+)
+def wire_quantize(k_blk, v_blk):
+    """[L, T, kv, hd] fp twins -> (k int8, v int8, k_scale [L, kv, T] f32,
+    v_scale) in the handoff wire layout (position axis last, kv_quant.py).
+    Same per-head amax recipe as the fused appends — byte-identical to a
+    local int8 insert of the same fp block."""
+    kq, ks = quantize_heads(k_blk)  # scales [L, T, kv]
+    vq, vs = quantize_heads(v_blk)
+    return kq, vq, ks.transpose(0, 2, 1).astype(jnp.float32), vs.transpose(0, 2, 1).astype(jnp.float32)
+
+
+@jaxcheck.entry(
+    name="llm.kvplane_wire_dequantize",
+    shapes={"t128": _bucket_wire_dequantize},
+    donate_bytes=0,
+)
+def wire_dequantize(k_blk, v_blk, k_scale, v_scale, dtype: str = "float32"):
+    """Int8 wire block + [L, kv, T] scales -> fp twins at ``dtype`` (the
+    consumer's compute dtype; static — one program per dtype). Feeds the
+    local re-store of a fetched remote prefix, never a flops-dominant
+    dot (the JXC003 trap stays off this path)."""
+    dt = jnp.dtype(dtype)
+    k = dequantize(k_blk, k_scale.transpose(0, 2, 1)).astype(dt)
+    v = dequantize(v_blk, v_scale.transpose(0, 2, 1)).astype(dt)
+    return k, v
+
+
+def make_wire_fns():
+    """Jitted (quantize, dequantize) pair for a plane client. Compile
+    per bucket width (and per dtype for the dequant), exactly like the
+    disagg extract programs."""
+    return (
+        jax.jit(wire_quantize),
+        jax.jit(wire_dequantize, static_argnums=(4,)),
+    )

@@ -127,6 +127,23 @@ METRICS: dict[str, dict] = {
         "kind": "counter", "tags": _SERVE_TAGS + ("event",),
         "desc": "disagg handoff events (published/scattered/lost/reused)",
     },
+    # cluster KV plane (llm/kvplane/): prefix reuse by tier. "local" =
+    # this replica's own PrefixCache; "remote" = a block fetched from
+    # another replica over the object plane. Cluster hit-rate =
+    # sum(rate(hits)) / rate(requests); the Grafana "cluster prefix
+    # reuse" panel plots both tiers.
+    "rt_llm_prefix_hits_total": {
+        "kind": "counter", "tags": _SERVE_TAGS + ("tier",),
+        "desc": "prefix-cache hits by tier (local replica cache vs remote cluster KV plane)",
+    },
+    "rt_llm_prefix_tokens_saved_total": {
+        "kind": "counter", "tags": _SERVE_TAGS + ("tier",),
+        "desc": "prompt tokens served from cached prefixes instead of prefill compute, by tier",
+    },
+    "rt_llm_prefix_fetch_bytes_total": {
+        "kind": "counter", "tags": _SERVE_TAGS,
+        "desc": "bytes fetched from remote replicas' published prefix blocks (cluster KV plane)",
+    },
 }
 
 _instruments: dict = {}
@@ -293,6 +310,17 @@ class EngineTelemetry:
         self._b_occ = self.m["rt_llm_kv_occupancy"].bind(self.tags)
         self._b_hbm = self.m["rt_llm_kv_hbm_bytes"].bind(self.tags)
         self._b_spec = self.m["rt_llm_spec_acceptance"].bind(self.tags)
+        # prefix-reuse tiers (cluster KV plane): per-ADMISSION events, so
+        # pre-bound handles keep them off the per-step budget entirely
+        self._b_pfx_hits = {
+            tier: self.m["rt_llm_prefix_hits_total"].bind({**self.tags, "tier": tier})
+            for tier in ("local", "remote")
+        }
+        self._b_pfx_tokens = {
+            tier: self.m["rt_llm_prefix_tokens_saved_total"].bind({**self.tags, "tier": tier})
+            for tier in ("local", "remote")
+        }
+        self._b_pfx_bytes = self.m["rt_llm_prefix_fetch_bytes_total"].bind(self.tags)
         # materialize the sentinel series at 0 so a dashboard can alert
         # on ANY increase (a series that only appears on the first
         # recompile is invisible to a rate()/increase() alert rule)
@@ -471,6 +499,16 @@ class EngineTelemetry:
                 {"request_id": st.request_id, "reason": reason,
                  "tokens": len(st.token_ids), "stage": self.tags["stage"]},
             )
+
+    def on_prefix_hit(self, tier: str, tokens: int, nbytes: int = 0) -> None:
+        """A prompt admission reused a cached prefix. ``tier``: "local"
+        (this replica's PrefixCache) or "remote" (fetched over the
+        cluster KV plane — ``nbytes`` then counts the object-plane
+        transfer). Admission-path only: never on the per-step budget."""
+        self._b_pfx_hits[tier].inc(1.0)
+        self._b_pfx_tokens[tier].inc(float(tokens))
+        if nbytes:
+            self._b_pfx_bytes.inc(float(nbytes))
 
     def on_handoff_extract(self, st, payload: dict, t_start: float) -> None:
         """Prefill side: the KV block left the cache into a handoff stash.

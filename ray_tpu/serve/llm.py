@@ -123,6 +123,14 @@ class LLMServer:
     def _step_loop(self):
         while not self._stopped:
             if not self.engine.has_unfinished():
+                # an IDLE replica must keep its cluster-index lease alive
+                # (engine.step never runs here, so its heartbeat hook
+                # never fires): a silent replica's published prefixes
+                # would stop matching after ttl_s and, once pruned, could
+                # never re-register
+                plane = getattr(self.engine, "_kv_plane", None)
+                if plane is not None:
+                    plane.maybe_heartbeat()
                 # block until a request arrives (no idle busy-poll)
                 self._work.wait(timeout=1.0)
                 self._work.clear()
@@ -543,6 +551,166 @@ def build_pd_disagg_deployment(
         **health,
     )(DisaggRouterServer)
     return router_dep.bind(llm_config, prefill_app, decode_app, max_attempts)
+
+
+class KVIndexServer:
+    """Cluster prefix-index deployment (llm/kvplane/index.py): the ONE
+    map every replica registers its published prefix blocks in and every
+    router scores against. Control plane only — refs and small meta
+    dicts, never KV bytes."""
+
+    def __init__(self, ttl_s: float = 30.0):
+        from ray_tpu.llm.kvplane import PrefixIndex
+
+        self.index = PrefixIndex(ttl_s=ttl_s)
+
+    def register(self, replica, entries):
+        return self.index.register(replica, entries)
+
+    def unregister(self, replica, keys):
+        return self.index.unregister(replica, keys)
+
+    def heartbeat(self, replica):
+        return self.index.heartbeat(replica)
+
+    def drop_replica(self, replica):
+        return self.index.drop_replica(replica)
+
+    def report_lost(self, replica, key):
+        return self.index.report_lost(replica, key)
+
+    def lookup(self, keys, exclude=None, requester=None):
+        return self.index.lookup(keys, exclude, requester)
+
+    def match_replicas(self, keys):
+        return self.index.match_replicas(keys)
+
+    def expire(self):
+        return self.index.expire()
+
+    def stats(self):
+        return self.index.stats()
+
+    def check_health(self):
+        return True
+
+
+class KVPlaneServer(LLMServer):
+    """LLM replica joined to the cluster KV plane: its engine publishes
+    freshly cached prefixes, serves remote hits over the object plane,
+    and re-publishes what it fetches (llm/kvplane/client.py). Each
+    replica registers under its deployment name so the router's
+    cache-aware scores and the index's entries name the same thing."""
+
+    def __init__(self, llm_config: LLMConfig, index_handle, replica_name: str):
+        from dataclasses import replace as _replace
+
+        from ray_tpu.llm.kvplane import KVPlaneClient
+        from ray_tpu.llm.telemetry import default_tags
+
+        self.replica_name = str(replica_name)
+        kwargs = dict(llm_config.engine_kwargs)
+        kwargs.setdefault(
+            "telemetry_tags",
+            default_tags(self.telemetry_stage, model=llm_config.model_id, replica=self.replica_name),
+        )
+        kwargs.setdefault("kv_plane", KVPlaneClient(index_handle, self.replica_name))
+        super().__init__(_replace(llm_config, engine_kwargs=kwargs))
+
+    def kvplane_stats(self) -> dict:
+        """Tiered prefix-reuse counters (prefix_cache_stats with the
+        local/remote split and the plane client's own accounting)."""
+        return self.engine.prefix_cache_stats()
+
+
+class KVRouterServer:
+    """Cache-aware ingress over a pool of KVPlaneServer replicas
+    (llm/kvplane/routing.py): scores every replica by longest cached
+    prefix (index.match_replicas) blended with live load, so
+    shared-prefix traffic lands where its KV already lives — local tier
+    beats remote tier beats cold."""
+
+    def __init__(
+        self,
+        llm_config: LLMConfig,
+        index_handle,
+        replica_names: tuple,
+        *replica_handles,
+        cache_weight: float = 1.0,
+        load_weight: float = 0.1,
+        max_attempts: int = 2,
+    ):
+        from ray_tpu.llm.kvplane import CacheAwareRouter
+
+        names = [str(n) for n in replica_names]
+        handles = dict(zip(names, replica_handles))
+        block = int(llm_config.engine_kwargs.get("prefix_block", 64))
+
+        def _submit(replica_id, prompt, sp):
+            return handles[replica_id].generate.remote(prompt, sp).result(timeout_s=600.0)
+
+        self.router = CacheAwareRouter(
+            index_handle, _submit, names, block=block,
+            cache_weight=cache_weight, load_weight=load_weight, max_attempts=max_attempts,
+        )
+
+    def generate(self, prompt_token_ids, sampling_params: dict | None = None) -> dict:
+        return self.router.generate(list(prompt_token_ids), sampling_params)
+
+    def kvplane_stats(self) -> dict:
+        return self.router.stats()
+
+    def check_health(self):
+        return True
+
+    def __call__(self, request):
+        body = request.json() if hasattr(request, "json") else dict(request)
+        return self.generate(body["prompt_token_ids"], body.get("sampling_params"))
+
+
+def build_kvplane_deployment(
+    llm_config: LLMConfig,
+    *,
+    num_replicas: int = 2,
+    name: str = "LLM",
+    index_ttl_s: float = 30.0,
+    cache_weight: float = 1.0,
+    load_weight: float = 0.1,
+    max_attempts: int = 2,
+):
+    """-> Application: cache-aware router over ``num_replicas`` engine
+    replicas sharing one cluster prefix index (llm/kvplane/). Replicas
+    are SINGLE-replica deployments (``{name}-r<i>``) so the router can
+    target the specific replica its score picked — the whole point of
+    cache-aware routing; a pow-2 pick inside one deployment would throw
+    the affinity away. Call ``.generate`` on the returned handle exactly
+    like the monolithic deployment."""
+    from ray_tpu import serve
+
+    health = {"health_check_timeout_s": 180.0, "health_check_period_s": 2.0}
+    index_app = serve.deployment(name=f"{name}-kvindex", num_replicas=1, **health)(
+        KVIndexServer
+    ).bind(index_ttl_s)
+    names, apps = [], []
+    for i in range(num_replicas):
+        rn = f"{name}-r{i}"
+        names.append(rn)
+        apps.append(
+            serve.deployment(
+                name=rn, num_replicas=1,
+                max_ongoing_requests=llm_config.max_ongoing_requests, **health,
+            )(KVPlaneServer).bind(llm_config, index_app, rn)
+        )
+    router_dep = serve.deployment(
+        name=f"{name}-router",
+        num_replicas=1,
+        max_ongoing_requests=llm_config.max_ongoing_requests * max(num_replicas, 1),
+        **health,
+    )(KVRouterServer)
+    return router_dep.bind(
+        llm_config, index_app, tuple(names), *apps,
+        cache_weight=cache_weight, load_weight=load_weight, max_attempts=max_attempts,
+    )
 
 
 def _build_app(llm_config: LLMConfig, cls, name: str):

@@ -161,7 +161,7 @@ def test_jaxcheck_self_check_runs_clean():
     )
 
 
-def test_jaxcheck_traces_at_least_twenty_eight_entries():
+def test_jaxcheck_traces_at_least_thirty_entries():
     from ray_tpu.lint.jaxcheck import import_entry_modules, registry
 
     import_entry_modules()
@@ -172,10 +172,12 @@ def test_jaxcheck_traces_at_least_twenty_eight_entries():
     # every hot-path program it touches (fused decode x2, spec verify x2,
     # disagg extract x2 + scatter x2); tensor-parallel serving adds the
     # shard_map'd fused/paged-fused/spec-verify steps over mesh buckets
-    # (where JXC005 finally audits real serving-path collectives) — any
+    # (where JXC005 finally audits real serving-path collectives); the
+    # cluster KV plane (llm/kvplane/quant.py) adds the wire
+    # quantize/dequantize pair on the publish/remote-hit paths — any
     # entry silently dropping out of the registry is an invariant check
     # that stopped running
-    assert len(entries) >= 28, [e.name for e in entries]
+    assert len(entries) >= 30, [e.name for e in entries]
     subsystems = {e.name.split(".")[0] for e in entries}
     assert {"llm", "parallel", "collective"} <= subsystems
     names = {e.name for e in entries}
@@ -194,6 +196,7 @@ def test_jaxcheck_traces_at_least_twenty_eight_entries():
         "llm.fused_step_tp", "llm.fused_step_tp_int8c", "llm.paged_fused_step_tp",
         "llm.spec_verify_tp", "llm.spec_verify_paged_tp",
     } <= names
+    assert {"llm.kvplane_wire_quantize", "llm.kvplane_wire_dequantize"} <= names
     # the tp entries declare their mesh axis, so JXC005 has teeth on them
     by_name = {e.name: e for e in entries}
     assert all(by_name[n].mesh_axes == ("tp",) for n in (
