@@ -36,6 +36,7 @@ ENTRY_MODULES = (
     "ray_tpu.llm.model_runner",
     "ray_tpu.llm.disagg.scatter",
     "ray_tpu.llm.kvplane.quant",
+    "ray_tpu.llm.pallas.paged_attn",
     "ray_tpu.llm.spec.drafter",
     "ray_tpu.llm.spec.verify",
     "ray_tpu.parallel.train_step",

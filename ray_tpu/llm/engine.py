@@ -42,6 +42,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import warnings
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -292,6 +293,7 @@ class LLMEngine:
         kv_layout: str = "slots",
         num_pages: int | None = None,
         page_size: int = 64,
+        attn_kernel: str = "xla",
         device_resident: bool | None = None,
         batch_prefill: bool | None = None,
         speculative=None,
@@ -304,6 +306,18 @@ class LLMEngine:
         ``num_pages`` sizes the pool (default: the slot-equivalent HBM,
         max_num_seqs * max_seq_len / page_size) and ``page_size`` must
         divide every prefill bucket and the prefix block.
+
+        attn_kernel: paged-attention implementation for the decode /
+        spec-verify / chunked-prefill hot path (kv_layout="paged" only).
+        "xla" (default) is the gather-then-attend page scan — the
+        token-identical oracle; "pallas" opts into the fused
+        HBM-streaming kernel (llm/pallas/paged_attn.py: page-table
+        gather, int8 dequant and flash-style attend in ONE program,
+        interpret mode off-TPU). Validated here: an unknown value or
+        "pallas" on the slot layout raises; a config/platform the kernel
+        cannot serve (kernel_supported) degrades to "xla" with a
+        one-time warning, never an error. The resolved choice is
+        ``engine.attn_kernel`` (bench provenance reads it).
 
         cache_dtype: KV-cache storage dtype, validated against
         {bfloat16/bf16, float32/f32, int8} (None = the model dtype).
@@ -365,6 +379,13 @@ class LLMEngine:
         if kv_layout not in ("slots", "paged"):
             raise ValueError(f"kv_layout must be 'slots' or 'paged', got {kv_layout!r}")
         self.kv_layout = kv_layout
+        if attn_kernel not in ("xla", "pallas"):
+            raise ValueError(f"attn_kernel must be 'xla' or 'pallas', got {attn_kernel!r}")
+        if attn_kernel == "pallas" and kv_layout != "paged":
+            raise ValueError(
+                "attn_kernel='pallas' is the paged-attention kernel and needs "
+                "kv_layout='paged' (the slot layout has no page gather to fuse)"
+            )
         from ray_tpu.llm.kv_quant import is_int8, normalize_cache_dtype
 
         # validate EARLY: an unsupported string must raise here, never
@@ -403,13 +424,38 @@ class LLMEngine:
                 head_dim=config.hd,
                 dtype=self.kv_dtype,
             )
-            self._prefill, self._insert, self._decode, self._extend = make_paged_runner_fns(config)
+            if attn_kernel == "pallas":
+                # engine-validated opt-in with a DEGRADE contract: an
+                # unsupported platform/shape (or the not-yet-kernelized
+                # shard_map tp path) falls back to the XLA oracle with a
+                # one-time warning — serving never errors over a kernel
+                from ray_tpu.llm.pallas.paged_attn import kernel_supported
+                from ray_tpu.parallel.mesh import axis_size as _tp_axis
+
+                ok, why = kernel_supported(
+                    self._pcfg.page_size, config.num_kv_heads, config.hd, quantized=self.kv_quant
+                )
+                if ok and mesh is not None and _tp_axis(mesh, "tp") > 1:
+                    ok, why = False, "the shard_map tensor-parallel path does not ride the kernel yet"
+                if not ok:
+                    warnings.warn(
+                        f"attn_kernel='pallas' unavailable ({why}); falling back to the "
+                        "XLA paged-attention path",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+                    attn_kernel = "xla"
+            self.attn_kernel = attn_kernel
+            self._prefill, self._insert, self._decode, self._extend = make_paged_runner_fns(
+                config, attn_impl=attn_kernel
+            )
             self._page_alloc = pkv.PageAllocator(self._pcfg.num_pages)
             self._tables = np.zeros((self.max_num_seqs, max_pg), np.int32)
             self._lengths = np.zeros((self.max_num_seqs,), np.int32)
             self._slot_pages: list[list[int]] = [[] for _ in range(self.max_num_seqs)]
             self._admit_counter = 0
         else:
+            self.attn_kernel = "xla"  # slot layout: no page gather to fuse
             self._prefill, self._insert, self._decode, self._extend = make_runner_fns(config)
 
         cache_cfg = (
@@ -527,7 +573,8 @@ class LLMEngine:
             tp_mesh = mesh if self._tp_fused else None
             if kv_layout == "paged":
                 self._fused_attn, self._fused_append = make_fused_paged_fns(
-                    config, mesh=tp_mesh, tp_collective=tp_collective, kv_quant=self.kv_quant
+                    config, mesh=tp_mesh, tp_collective=tp_collective, kv_quant=self.kv_quant,
+                    attn_impl=self.attn_kernel,
                 )
             else:
                 self._fused_step = make_fused_fns(
@@ -625,7 +672,8 @@ class LLMEngine:
         tp_mesh = self.mesh if self._tp_fused else None
         if self.kv_layout == "paged":
             self._verify_attn, self._verify_append = specv.make_spec_verify_paged(
-                self.config, k, mesh=tp_mesh, tp_collective=self.tp_collective, kv_quant=self.kv_quant
+                self.config, k, mesh=tp_mesh, tp_collective=self.tp_collective, kv_quant=self.kv_quant,
+                attn_impl=self.attn_kernel,
             )
         else:
             self._verify_step = specv.make_spec_verify_slots(
@@ -686,6 +734,7 @@ class LLMEngine:
                 "layout": self.kv_layout,
                 "dtype": self.kv_dtype,
                 "quantized": self.kv_quant,
+                "attn_kernel": self.attn_kernel,
                 "bytes_per_token": int(per_tok),
                 "allocated_bytes": allocated,
                 "slots_total": self.max_num_seqs,
@@ -1294,17 +1343,24 @@ class LLMEngine:
                 k_fp, v_fp = jnp.asarray(k_w), jnp.asarray(v_w)
             stored = self._prefix_cache.store(prompt[:n_p], k_fp, v_fp, self.prefill_buckets)
             if stored is not None:
-                self._plane_publish(prompt[:n_p], k_fp, v_fp, *stored)
+                # proven_reuse: THIS replica just fetched the block over
+                # the plane — the fetch itself is reuse evidence, so the
+                # republish bypasses publish_min_hits (holding it back
+                # would hide a live second holder from the index until
+                # this replica's own local hits re-prove what the
+                # cluster already demonstrated)
+                self._plane_publish(prompt[:n_p], k_fp, v_fp, *stored, proven_reuse=True)
         return (k_w, v_w, n_p, k_sc, v_sc)
 
-    def _plane_publish(self, prompt, ks, vs, new_keys=None, pad=None):
+    def _plane_publish(self, prompt, ks, vs, new_keys=None, pad=None, proven_reuse=False):
         """Publish a prefix block to the cluster plane (owned object +
         index registration). ``new_keys`` scopes registration to the
         boundaries the local cache just minted (the store path); None
         lets the client cover every still-unpublished boundary (the
-        local-hit self-heal after a transient publish failure). Failures
-        degrade silently — the client counts them; serving never depends
-        on the plane."""
+        local-hit self-heal after a transient publish failure).
+        ``proven_reuse`` bypasses the client's publish_min_hits policy
+        (the remote-fetch republish path). Failures degrade silently —
+        the client counts them; serving never depends on the plane."""
         block = self._prefix_cache.block
         n_max = (len(prompt) // block) * block
         if n_max < block:
@@ -1313,6 +1369,7 @@ class LLMEngine:
         nbytes = self._kv_plane.publish(
             [int(t) for t in prompt[:n_max]], ks[:, :pad], vs[:, :pad],
             bounds=None if new_keys is None else [(n, key) for key, n in new_keys],
+            proven_reuse=proven_reuse,
         )
         if nbytes:
             self._plane_stats["published_blocks"] += 1

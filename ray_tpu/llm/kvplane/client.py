@@ -69,7 +69,18 @@ class KVPlaneClient:
         index_timeout_s: float = 10.0,
         index_down_cooldown_s: float = 30.0,
         publish: bool = True,
+        publish_min_hits: int = 2,
     ):
+        """``publish_min_hits``: capacity-aware publication policy — a
+        boundary key is offered to ``publish()`` once per local-cache
+        event (the store that minted it, then every local hit's re-offer
+        self-heal), and only publishes once it has been seen >= this
+        many times. The default (2) keeps cold, never-reused prefixes
+        from churning the object plane with blocks nobody will fetch: a
+        once-seen prefix costs nothing; the second touch — the first
+        evidence of reuse — publishes it. 1 restores publish-on-store.
+        Skips are counted in ``stats()['published_skipped']`` (surfaced
+        through ``prefix_cache_stats()``'s plane tier)."""
         import os
 
         self._index = index
@@ -81,6 +92,10 @@ class KVPlaneClient:
         self.index_timeout_s = float(index_timeout_s)
         self.index_down_cooldown_s = float(index_down_cooldown_s)
         self._publish_enabled = bool(publish)
+        self.publish_min_hits = max(1, int(publish_min_hits))
+        # boundary key -> publish-offer count (stores + local-hit
+        # re-offers); bounded — see _note_seen
+        self._seen: dict[bytes, int] = {}
         # circuit breaker: repeated index failures open it for a cooldown
         # so a DEAD index costs one timeout, not one per admission under
         # the engine lock (heartbeats keep probing and close it on success)
@@ -98,6 +113,7 @@ class KVPlaneClient:
         self._quantize = self._dequantize = None
         self.counts = {
             "published_blocks": 0, "published_bytes": 0, "unpublished_blocks": 0,
+            "published_skipped": 0,
             "fetches": 0, "fetched_bytes": 0, "fetch_lost": 0,
             "index_errors": 0, "publish_errors": 0,
         }
@@ -159,15 +175,20 @@ class KVPlaneClient:
             self._safe_call("register", self.replica_id, entries)
 
     # -- publish -----------------------------------------------------------
-    def publish(self, prefix_ids, k_blk, v_blk, bounds: list | None = None) -> int:
+    def publish(self, prefix_ids, k_blk, v_blk, bounds: list | None = None,
+                proven_reuse: bool = False) -> int:
         """Publish one prefix block (fp device/host arrays [L, T_pad, kv,
         hd], T_pad >= len(prefix_ids)) as an owned object and register
         its block boundaries against the one ref. ``bounds`` ([(n, key)])
         restricts registration to boundaries the local cache just minted
         (already-published boundaries keep their existing block); default
-        is every boundary of ``prefix_ids``. Returns published bytes
-        (0 = skipped/failed; the plane degrades, it never raises into the
-        prefill stage)."""
+        is every boundary of ``prefix_ids``. ``proven_reuse`` bypasses
+        the publish_min_hits policy outright — set by callers whose offer
+        IS reuse evidence (the engine's republish of a block it just
+        fetched over the cluster plane: somebody else demonstrably wants
+        this prefix, so holding it back only hides a live holder from the
+        index). Returns published bytes (0 = skipped/failed; the plane
+        degrades, it never raises into the prefill stage)."""
         if not self._publish_enabled or self.index_down():
             return 0
         from ray_tpu.core import direct as _direct
@@ -176,7 +197,22 @@ class KVPlaneClient:
         if bounds is None:
             bounds = boundary_keys(prefix_ids, self._block, strict=False)
         with self._lock:
-            bounds = [(bn, key) for bn, key in bounds if bytes(key) not in self._published]
+            # publication policy: every offer of a still-unpublished key
+            # (store mint, local-hit re-offer) counts as one sighting;
+            # the key only ships once seen publish_min_hits times — cold
+            # single-use prefixes never serialize, quantize, or register
+            fresh = []
+            for bn, key in bounds:
+                kb = bytes(key)
+                if kb in self._published:
+                    continue
+                if not proven_reuse:
+                    seen = self._note_seen(kb)
+                    if seen < self.publish_min_hits:
+                        self.counts["published_skipped"] += 1
+                        continue
+                fresh.append((bn, key))
+            bounds = fresh
         if not bounds:
             return 0
         n = len(prefix_ids)
@@ -220,11 +256,28 @@ class KVPlaneClient:
             return 0
         with self._lock:
             for bn, key in bounds:
-                self._published[bytes(key)] = (bn, meta, ref)
+                kb = bytes(key)
+                self._published[kb] = (bn, meta, ref)
+                self._seen.pop(kb, None)  # published: the policy no longer needs its count
             self._ref_keys[ref.id.binary()] = {bytes(key) for _, key in bounds}
         self.counts["published_blocks"] += 1
         self.counts["published_bytes"] += int(meta["nbytes"])
         return int(meta["nbytes"])
+
+    def _note_seen(self, key: bytes) -> int:
+        """Bump and return a boundary key's sighting count (caller holds
+        the lock). The map holds only keys the policy still needs —
+        publish() drops a key's count the moment it ships — and is
+        bounded: past 64k tracked keys the OLDEST-INSERTED half is
+        dropped (plain dict insertion order; a true LRU isn't worth the
+        bookkeeping here) — losing a count only delays a cold prefix's
+        publication by one more sighting, never breaks correctness."""
+        if len(self._seen) > 65536:
+            for k in list(self._seen)[: len(self._seen) // 2]:
+                del self._seen[k]
+        n = self._seen.get(key, 0) + 1
+        self._seen[key] = n
+        return n
 
     # -- lookup / fetch ----------------------------------------------------
     def lookup(self, keys: list):

@@ -483,7 +483,8 @@ def extend(params, cache, slot, tokens, length, cfg: LlamaConfig):
     return logits, {"k": k, "v": v, "length": lens}
 
 
-def decode_attn_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig, tpc: TpSpec | None = None):
+def decode_attn_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig, tpc: TpSpec | None = None,
+                      attn_impl: str = "xla"):
     """READ-ONLY half of the paged decode step: attention over the cached
     pages plus the current token's K/V in registers. Returns
     (logits [slots, vocab] f32, k_new [L, slots, kv, hd], v_new same) —
@@ -497,6 +498,12 @@ def decode_attn_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig, t
 
     ``tpc``: shard_map body mode, exactly as on decode_step — per-shard
     cfg, explicit all-reduce of the attention/MLP partials.
+
+    ``attn_impl``: "xla" (default — the token-identical oracle) or
+    "pallas" (llm/pallas/paged_attn.py: the page gather, int8 dequant
+    and online-softmax attend fused into one HBM-streaming kernel; the
+    scatter half below is untouched, so the aliasing split holds). A
+    static string bound at jit time, engine-validated.
     """
     B = tokens.shape[0]
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
@@ -520,7 +527,7 @@ def decode_attn_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig, t
         kh = apply_rope(k_t.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)
         qg = qh[:, 0].reshape(B, nkv, rep, hd)
         o = _paged_attn_batch(qg, k_pool_l, v_pool_l, tables, lengths, scale, k_self=kh[:, 0], v_self=v_t[:, 0],
-                              k_scale_l=k_sc_l, v_scale_l=v_sc_l)
+                              k_scale_l=k_sc_l, v_scale_l=v_sc_l, impl=attn_impl)
         o = o.reshape(B, 1, nh * hd).astype(x.dtype)
         x = x + _tp_reduce(jnp.dot(o, layer["wo"]), tpc)
         x = _mlp(x, layer, cfg, tpc)
@@ -577,21 +584,24 @@ def extend_write_targets(table_row, start, T: int, page: int):
     return table_row[page_ix], positions % page
 
 
-def decode_step_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig):
+def decode_step_paged(params, pool, tables, lengths, tokens, cfg: LlamaConfig, attn_impl: str = "xla"):
     """Convenience wrapper: attention program + append program (two
     dispatches; see decode_attn_paged for why they must stay separate).
     Returns (logits, new pool, lengths+1)."""
     write_page, write_off = decode_write_targets(tables, lengths, pool["k"].shape[2])
-    logits, k_new, v_new = decode_attn_paged(params, pool, tables, lengths, tokens, cfg)
+    logits, k_new, v_new = decode_attn_paged(params, pool, tables, lengths, tokens, cfg, attn_impl=attn_impl)
     pool = append_paged(pool, write_page, write_off, k_new, v_new)
     return logits, pool, lengths + 1
 
 
-def extend_attn_paged(params, pool, table_row, start, tokens, length, cfg: LlamaConfig):
+def extend_attn_paged(params, pool, table_row, start, tokens, length, cfg: LlamaConfig,
+                      attn_impl: str = "xla"):
     """READ-ONLY half of paged chunked-prefill: the suffix attends to the
     cached prefix pages plus itself causally (in registers). Returns
     (logits [vocab] f32 at the last real token, k_chunk [L, T, kv, hd],
-    v_chunk same); the pool scatter is a separate program."""
+    v_chunk same); the pool scatter is a separate program. ``attn_impl``
+    "pallas" streams the prefix pages through the fused kernel (B=1 lane
+    batch); the causal chunk stays in registers either way."""
     T = tokens.shape[0]
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     rep = nh // nkv
@@ -602,7 +612,7 @@ def extend_attn_paged(params, pool, table_row, start, tokens, length, cfg: Llama
     x = jnp.take(params["embed"], tokens[None, :], axis=0)  # [1, T, H]
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
 
-    from ray_tpu.llm.paged_kv import _paged_attn_seq
+    from ray_tpu.llm.paged_kv import _paged_attn_seq, _paged_attn_seq_batch
 
     def layer_fn(x, xs):
         if quant:
@@ -615,8 +625,14 @@ def extend_attn_paged(params, pool, table_row, start, tokens, length, cfg: Llama
         qh = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)  # [1, nh, T, hd]
         kh = apply_rope(k_t.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)  # [1, T, nkv, hd]
         qg = qh[0].reshape(nkv, rep, T, hd)
-        o = _paged_attn_seq(qg, k_pool_l, v_pool_l, table_row, start, kh[0], v_t[0], scale,
-                            k_scale_l=k_sc_l, v_scale_l=v_sc_l)
+        if attn_impl == "pallas":
+            o = _paged_attn_seq_batch(
+                qg[None], k_pool_l, v_pool_l, table_row[None], start[None], kh, v_t, scale,
+                k_scale_l=k_sc_l, v_scale_l=v_sc_l, impl=attn_impl,
+            )[0]
+        else:
+            o = _paged_attn_seq(qg, k_pool_l, v_pool_l, table_row, start, kh[0], v_t[0], scale,
+                                k_scale_l=k_sc_l, v_scale_l=v_sc_l)
         o = o.transpose(2, 0, 1, 3).reshape(1, T, nh * hd).astype(x.dtype)
         x = x + jnp.dot(o, layer["wo"])
         x = _mlp(x, layer, cfg)
@@ -654,12 +670,13 @@ def append_chunk_paged(pool, write_page, write_off, k_chunk, v_chunk):
     }
 
 
-def extend_paged(params, pool, table_row, start, tokens, length, cfg: LlamaConfig):
+def extend_paged(params, pool, table_row, start, tokens, length, cfg: LlamaConfig, attn_impl: str = "xla"):
     """Convenience wrapper: attention program + chunk append program (two
     dispatches; see decode_attn_paged for the split rationale). Returns
     (logits [vocab] f32 at the last real token, new pool)."""
     write_page, write_off = extend_write_targets(table_row, start, tokens.shape[0], pool["k"].shape[2])
-    logits, k_chunk, v_chunk = extend_attn_paged(params, pool, table_row, start, tokens, length, cfg)
+    logits, k_chunk, v_chunk = extend_attn_paged(params, pool, table_row, start, tokens, length, cfg,
+                                                 attn_impl=attn_impl)
     pool = append_chunk_paged(pool, write_page, write_off, k_chunk, v_chunk)
     return logits, pool
 
@@ -769,17 +786,22 @@ def paged_fused_step(
     top_p,
     cfg: LlamaConfig,
     tpc: TpSpec | None = None,
+    attn_impl: str = "xla",
 ):
     """READ-ONLY half of the paged device-resident step: attention +
     sample + write-target math; the scatter-append into the pool is a
     SEPARATE program (append_paged) — see decode_attn_paged for the
     gather/scatter aliasing hazard that forbids fusing them. Sampling
     lanes are donated-and-passed-through exactly as in fused_step.
-    ``tpc``: shard_map body mode (see fused_step)."""
+    ``tpc``: shard_map body mode (see fused_step). ``attn_impl``:
+    "pallas" rides the fused HBM-streaming kernel for the page attention
+    (engine opt-in, see decode_attn_paged); the append program is
+    untouched either way."""
     from ray_tpu.llm.sampling import sample
 
     write_page, write_off = decode_write_targets(tables, lengths, pool["k"].shape[2])
-    logits, k_new, v_new = decode_attn_paged(params, pool, tables, lengths, tokens, cfg, tpc)
+    logits, k_new, v_new = decode_attn_paged(params, pool, tables, lengths, tokens, cfg, tpc,
+                                             attn_impl=attn_impl)
     toks, logps, new_keys = sample(logits, keys, temps, top_k, top_p)
     return toks, logps, new_keys, k_new, v_new, write_page, write_off, lengths + 1, temps, top_k, top_p
 
@@ -815,7 +837,8 @@ def _sharded_fused_paged(cfg: LlamaConfig, mesh, tp_collective: str, kv_quant: b
     )
 
 
-def make_fused_paged_fns(cfg: LlamaConfig, mesh=None, tp_collective: str = "fp", kv_quant: bool = False):
+def make_fused_paged_fns(cfg: LlamaConfig, mesh=None, tp_collective: str = "fp", kv_quant: bool = False,
+                         attn_impl: str = "xla"):
     """Device-resident decode step for the paged layout: TWO programs
     (attention+sample, then scatter-append), neither of which ever syncs
     with the host. tables is read every step and mutated only by
@@ -823,13 +846,16 @@ def make_fused_paged_fns(cfg: LlamaConfig, mesh=None, tp_collective: str = "fp",
     shard_map (explicit per-layer all-reduce, optionally int8 on the
     wire); the append half stays a plain GSPMD jit — its scatter is
     elementwise per kv-head, so partitioning it needs no collectives and
-    the documented gather/scatter program split is untouched."""
+    the documented gather/scatter program split is untouched.
+    ``attn_impl="pallas"``: the attention half's page loop runs as the
+    fused HBM-streaming kernel (single-device path only — the engine
+    degrades to "xla" on tp meshes)."""
     from ray_tpu.parallel.mesh import axis_size
 
     if mesh is not None and axis_size(mesh, "tp") > 1:
         attn_fn = jax.jit(_sharded_fused_paged(cfg, mesh, tp_collective, kv_quant), donate_argnums=(3, 5, 6, 7, 8))
     else:
-        attn_fn = jax.jit(partial(paged_fused_step, cfg=cfg), donate_argnums=(3, 5, 6, 7, 8))
+        attn_fn = jax.jit(partial(paged_fused_step, cfg=cfg, attn_impl=attn_impl), donate_argnums=(3, 5, 6, 7, 8))
     append_fn = jax.jit(append_paged, donate_argnums=(0,))
     return attn_fn, append_fn
 
@@ -985,20 +1011,21 @@ def make_runner_fns(cfg: LlamaConfig):
     return prefill_fn, insert_fn, decode_fn, extend_fn
 
 
-def make_paged_runner_fns(cfg: LlamaConfig):
+def make_paged_runner_fns(cfg: LlamaConfig, attn_impl: str = "xla"):
     """Jitted (prefill, insert_pages, decode, extend) for a paged engine.
 
     Decode/extend each compile as TWO programs — read-only attention and
     scatter-only append — never fused (jitting the combined wrapper would
     reintroduce the same-program gather+scatter aliasing hazard; see
-    decode_attn_paged)."""
+    decode_attn_paged). ``attn_impl`` selects the page-attention body of
+    both read-only halves ("xla" oracle / "pallas" fused kernel)."""
     from ray_tpu.llm import paged_kv as pkv
 
     prefill_fn = jax.jit(partial(prefill, cfg=cfg))
     insert_fn = jax.jit(pkv.insert_pages, donate_argnums=(0,))
-    attn_fn = jax.jit(partial(decode_attn_paged, cfg=cfg))
+    attn_fn = jax.jit(partial(decode_attn_paged, cfg=cfg, attn_impl=attn_impl))
     append_fn = jax.jit(append_paged, donate_argnums=(0,))
-    ext_attn_fn = jax.jit(partial(extend_attn_paged, cfg=cfg))
+    ext_attn_fn = jax.jit(partial(extend_attn_paged, cfg=cfg, attn_impl=attn_impl))
     ext_append_fn = jax.jit(append_chunk_paged, donate_argnums=(0,))
 
     def decode_fn(params, pool, tables, lengths, tokens):

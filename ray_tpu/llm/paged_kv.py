@@ -168,7 +168,7 @@ def _combine(m1, l1, a1, m2, l2, a2):
 
 
 def _paged_attn_batch(qg, pool_k_l, pool_v_l, table, lengths, scale, k_self=None, v_self=None,
-                      k_scale_l=None, v_scale_l=None):
+                      k_scale_l=None, v_scale_l=None, impl="xla"):
     """Online-softmax attention of one query token per slot over paged KV.
 
     qg: [B, nkv, rep, hd]; pool_*_l: [P, page, kv, hd] (one layer);
@@ -179,41 +179,65 @@ def _paged_attn_batch(qg, pool_k_l, pool_v_l, table, lengths, scale, k_self=None
     a same-program scatter->gather on one buffer is exactly the in-place
     aliasing pattern XLA's CPU thunk executor was observed to mis-order
     (nondeterministic stale reads), and keeping the self term out of
-    memory sidesteps it while also saving the round trip.
+    memory sidesteps it while also saving the round trip. THREE consumers
+    rely on this in-registers split: this decode path, the speculative
+    wide-block path (`_paged_attn_seq`'s causal chunk), and the Pallas
+    kernel (llm/pallas/paged_attn.py), whose page reads are bounded by
+    ``lengths`` so the position being written this step can only reach
+    attention through the register operands — regression-locked by the
+    poisoned-write-target test in tests/test_llm_pallas.py.
 
     k_scale_l/v_scale_l ([P, kv, page], int8 pools only): gathered pages
     dequantize at the f32 compute dtype this function already uses —
     the convert stays off the flops-dominant dots (JXC003).
+
+    impl="pallas" computes the page-prefix partials with the fused
+    HBM-streaming kernel instead of the gather-materializing XLA scan;
+    the self fold and normalization below are shared, so the two impls
+    differ only in how the (m, l, acc) partials are produced.
     Returns [B, nkv, rep, hd] float32.
     """
     B, nkv, rep, hd = qg.shape
     page = pool_k_l.shape[1]
     max_pg = table.shape[1]
     qf = qg.astype(jnp.float32) * scale
+    if impl == "pallas":
+        # the kernel path REQUIRES the current token in registers: its
+        # pool reads stop strictly below `lengths`, so nothing else could
+        # supply the self term (the aliasing contract documented above)
+        assert k_self is not None and v_self is not None, (
+            "impl='pallas' needs the current token's K/V in registers (k_self/v_self)"
+        )
+        from ray_tpu.llm.pallas.paged_attn import paged_attn_partials
 
-    def body(carry, p):
-        m, l, acc = carry
-        pids = table[:, p]  # [B]
-        kp = pool_k_l[pids].astype(jnp.float32)  # [B, page, kv, hd]
-        vp = pool_v_l[pids].astype(jnp.float32)
-        if k_scale_l is not None:
-            kp = kp * k_scale_l[pids].transpose(0, 2, 1)[..., None]  # [B, page, kv, 1]
-            vp = vp * v_scale_l[pids].transpose(0, 2, 1)[..., None]
-        s = jnp.einsum("bgrh,bpgh->bgrp", qf, kp)  # [B, nkv, rep, page]
-        pos = p * page + jnp.arange(page, dtype=jnp.int32)  # [page]
-        ok = pos[None, :] < lengths[:, None]  # [B, page] cached only
-        s = jnp.where(ok[:, None, None, :], s, _NEG)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        alpha = jnp.exp(m - m_new)
-        pexp = jnp.exp(s - m_new[..., None])
-        l_new = l * alpha + pexp.sum(axis=-1)
-        acc_new = acc * alpha[..., None] + jnp.einsum("bgrp,bpgh->bgrh", pexp, vp)
-        return (m_new, l_new, acc_new), None
+        m, l, acc = paged_attn_partials(
+            qf[:, :, :, None, :], pool_k_l, pool_v_l, table, lengths, k_scale_l, v_scale_l
+        )
+        m, l, acc = m[..., 0], l[..., 0], acc[..., 0, :]
+    else:
+        def body(carry, p):
+            m, l, acc = carry
+            pids = table[:, p]  # [B]
+            kp = pool_k_l[pids].astype(jnp.float32)  # [B, page, kv, hd]
+            vp = pool_v_l[pids].astype(jnp.float32)
+            if k_scale_l is not None:
+                kp = kp * k_scale_l[pids].transpose(0, 2, 1)[..., None]  # [B, page, kv, 1]
+                vp = vp * v_scale_l[pids].transpose(0, 2, 1)[..., None]
+            s = jnp.einsum("bgrh,bpgh->bgrp", qf, kp)  # [B, nkv, rep, page]
+            pos = p * page + jnp.arange(page, dtype=jnp.int32)  # [page]
+            ok = pos[None, :] < lengths[:, None]  # [B, page] cached only
+            s = jnp.where(ok[:, None, None, :], s, _NEG)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            alpha = jnp.exp(m - m_new)
+            pexp = jnp.exp(s - m_new[..., None])
+            l_new = l * alpha + pexp.sum(axis=-1)
+            acc_new = acc * alpha[..., None] + jnp.einsum("bgrp,bpgh->bgrh", pexp, vp)
+            return (m_new, l_new, acc_new), None
 
-    m0 = jnp.full((B, nkv, rep), _NEG, jnp.float32)
-    l0 = jnp.zeros((B, nkv, rep), jnp.float32)
-    a0 = jnp.zeros((B, nkv, rep, hd), jnp.float32)
-    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, a0), jnp.arange(max_pg, dtype=jnp.int32))
+        m0 = jnp.full((B, nkv, rep), _NEG, jnp.float32)
+        l0 = jnp.zeros((B, nkv, rep), jnp.float32)
+        a0 = jnp.zeros((B, nkv, rep, hd), jnp.float32)
+        (m, l, acc), _ = jax.lax.scan(body, (m0, l0, a0), jnp.arange(max_pg, dtype=jnp.int32))
     if k_self is not None:
         # fold the current token as a one-element softmax partial:
         # m2 = s_self, l2 = exp(s_self - m2) = 1, acc2 = 1 * v_self
@@ -238,10 +262,11 @@ def _paged_attn_seq(qg, pool_k_l, pool_v_l, table_row, start, k_chunk, v_chunk, 
     at the f32 compute dtype; the in-register chunk stays fp. Returns
     [nkv, rep, T, hd] float32.
 
-    CONTRACT: this function is also vmapped over lanes by the
-    speculative verify step (llm/spec/verify.py spec_verify_paged, with
-    T = k+1) — keep it free of lane-global logic so per-sequence and
-    batched uses stay the same program.
+    CONTRACT: this function is also vmapped over lanes (through
+    `_paged_attn_seq_batch`) by the speculative verify step
+    (llm/spec/verify.py spec_verify_paged, with T = k+1) — keep it free
+    of lane-global logic so per-sequence and batched uses stay the same
+    program.
     """
     nkv, rep, T, hd = qg.shape
     page = pool_k_l.shape[1]
@@ -279,5 +304,43 @@ def _paged_attn_seq(qg, pool_k_l, pool_v_l, table_row, start, k_chunk, v_chunk, 
     pe2 = jnp.exp(s_c - m2[..., None])
     l2 = pe2.sum(axis=-1)
     a2 = jnp.einsum("grtu,ugh->grth", pe2, v_chunk.astype(jnp.float32))
+    m, l, acc = _combine(m, l, acc, m2, l2, a2)
+    return acc / jnp.maximum(l, 1e-20)[..., None]
+
+
+def _paged_attn_seq_batch(qg, pool_k_l, pool_v_l, tables, starts, k_chunk, v_chunk, scale,
+                          k_scale_l=None, v_scale_l=None, impl="xla"):
+    """Lane-batched `_paged_attn_seq`: T query tokens PER LANE against
+    each lane's own paged prefix + in-register causal chunk.
+
+    qg: [B, nkv, rep, T, hd]; tables: [B, max_pg]; starts: [B] int32;
+    k_chunk/v_chunk: [B, T, kv, hd]. impl="xla" IS the vmapped per-lane
+    program (byte-for-byte what spec_verify_paged always compiled — the
+    oracle); impl="pallas" streams every lane's prefix pages through the
+    fused kernel (llm/pallas/paged_attn.py) and folds the causal chunk
+    with the identical register math, batched. A pallas_call cannot ride
+    `jax.vmap`, which is why the kernel path enters through this batched
+    front instead of the per-lane function. Returns
+    [B, nkv, rep, T, hd] float32.
+    """
+    if impl != "pallas":
+        return jax.vmap(_paged_attn_seq, in_axes=(0, None, None, 0, 0, 0, 0, None, None, None))(
+            qg, pool_k_l, pool_v_l, tables, starts, k_chunk, v_chunk, scale, k_scale_l, v_scale_l
+        )
+    T = qg.shape[3]
+    qf = qg.astype(jnp.float32) * scale
+    from ray_tpu.llm.pallas.paged_attn import paged_attn_partials
+
+    m, l, acc = paged_attn_partials(qf, pool_k_l, pool_v_l, tables, starts, k_scale_l, v_scale_l)
+    # causal in-chunk part from registers — the batched twin of
+    # _paged_attn_seq's tail (the chunk is produced this call and never
+    # read back from the pool: the same aliasing contract)
+    s_c = jnp.einsum("bgrth,bugh->bgrtu", qf, k_chunk.astype(jnp.float32))
+    causal = jnp.arange(T, dtype=jnp.int32)[None, :] <= jnp.arange(T, dtype=jnp.int32)[:, None]
+    s_c = jnp.where(causal[None, None, None], s_c, _NEG)
+    m2 = s_c.max(axis=-1)
+    pe2 = jnp.exp(s_c - m2[..., None])
+    l2 = pe2.sum(axis=-1)
+    a2 = jnp.einsum("bgrtu,bugh->bgrth", pe2, v_chunk.astype(jnp.float32))
     m, l, acc = _combine(m, l, acc, m2, l2, a2)
     return acc / jnp.maximum(l, 1e-20)[..., None]

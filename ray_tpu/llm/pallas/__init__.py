@@ -1,0 +1,12 @@
+"""Pallas TPU kernels for the serving hot path.
+
+One module per kernel; each keeps an interpret-mode path (`pl.pallas_call
+(..., interpret=True)`) so the kernels stay testable — and token-compared
+against their XLA oracles — on CPU-only containers. The XLA programs they
+replace remain the default and the fallback: a kernel here is always an
+engine-validated opt-in, never a silent substitution.
+"""
+
+from ray_tpu.llm.pallas.paged_attn import kernel_supported, paged_attn_partials
+
+__all__ = ["kernel_supported", "paged_attn_partials"]

@@ -1,0 +1,256 @@
+"""Pallas paged-attention decode kernel: gather -> dequant -> attend fused.
+
+The XLA paged path (paged_kv._paged_attn_batch/_paged_attn_seq) scans the
+page axis and each step GATHERS one page per lane into a fresh buffer
+before attending — on a real chip that materialization is an extra
+HBM round trip per page (read pool -> write gathered copy -> read copy
+into the attention dot), and the int8 cache adds a separate dequant pass
+over the gathered pages. This kernel deletes the materialization: a grid
+over (lanes x KV pages) whose BlockSpec index map reads the device page
+table directly (scalar-prefetch), so each page streams HBM -> VMEM
+exactly once, dequantizes IN REGISTERS with the exact kv_quant recipe
+(int8 * f32 per-head amax scale at the f32 compute dtype), and folds
+into a flash-style online-softmax carry (m/l/acc). Paged decode becomes
+HBM-roofline-bound on the bytes that must move — the pool pages — and
+nothing else (bench_artifacts/README.md has the v5e byte math).
+
+Scope and contracts:
+
+- The kernel computes the PAGE-PREFIX softmax partials only: positions
+  ``0..bound[b]-1`` read from the pool. The current token's K/V (decode)
+  and the causal in-register chunk (spec verify / chunked prefill) are
+  folded OUTSIDE the kernel by the same ``_combine`` math the XLA path
+  uses — the kernel never reads the position being written this step,
+  which is the third leg of the gather/scatter aliasing contract
+  documented on ``decode_attn_paged`` (the attention program must stay
+  read-only over the pool). ``tests/test_llm_pallas.py`` poisons the
+  write target to regression-lock this.
+- Math mirrors the XLA scan op-for-op (same masks, same ``_NEG``
+  surrogate, same combine order), so interpret mode on CPU is
+  token-identical to the XLA oracle — the equivalence tier-1 asserts.
+- ``interpret=True`` (automatic off-TPU) runs the kernel through the
+  Pallas interpreter: slow, but the SAME kernel body TPU compiles, so
+  CPU CI exercises the real code path.
+
+The XLA path remains the default and the fallback; engines opt in with
+``attn_kernel="pallas"`` (llm/engine.py validates, and degrades with a
+one-time warning — never an error — when ``kernel_supported`` says no).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.lint import jaxcheck
+from ray_tpu.llm.paged_kv import _NEG
+
+
+def _interpret_default() -> bool:
+    """Interpret off-TPU: the kernel body is executed by the Pallas
+    interpreter as plain jax ops (slow, exact); on TPU it compiles."""
+    return jax.default_backend() != "tpu"
+
+
+def kernel_supported(page_size: int, num_kv_heads: int, head_dim: int, quantized: bool = False):
+    """(ok, why_not) for this config on this backend. CPU always works
+    (interpret mode); TPU gets a CONSERVATIVE tile gate on the dims
+    Mosaic actually tiles — the trailing two of each block: the K/V
+    block ``(1, page, kvh, hd)`` tiles (kvh, hd), so ``hd`` is the
+    128-lane dim and ``kvh`` the 8-sublane dim; an int8 pool's scale
+    block ``(1, kvh, page)`` additionally puts ``page`` on lanes.
+    Anything else has no lowering. This decision is taken ONCE at engine
+    construction, so it must be strict enough that a promised kernel
+    never fails to compile later — the engine turns a False into a
+    one-time warning + XLA fallback, never an error."""
+    try:
+        from jax.experimental import pallas as pl  # noqa: F401
+        from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    except Exception as e:  # noqa: BLE001 — stubbed/absent pallas degrades
+        return False, f"pallas unavailable: {type(e).__name__}: {e}"
+    backend = jax.default_backend()
+    if backend == "cpu":
+        return True, ""
+    if backend == "tpu":
+        if head_dim % 128:
+            return False, f"head_dim {head_dim} is not a multiple of the 128-lane tile"
+        if num_kv_heads % 8:
+            return False, f"num_kv_heads {num_kv_heads} is not a multiple of the 8-sublane tile (the K/V block's sublane dim)"
+        if quantized and page_size % 128:
+            return False, f"int8 pool: page_size {page_size} is not a multiple of the 128-lane tile (the scale plane's lane dim)"
+        return True, ""
+    return False, f"no pallas paged-attention path for backend {backend!r}"
+
+
+try:  # the module must import (for the XLA-only engines) even if pallas can't
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # noqa: BLE001 — kernel_supported reports the real reason
+    pl = pltpu = None
+
+
+def _partials_kernel(tables_ref, bound_ref, q_ref, k_ref, v_ref, *rest, page: int, quant: bool):
+    """One (lane b, page j) grid step: stream page ``tables[b, j]`` from
+    HBM, dequantize in registers (int8 pools), fold into the lane's
+    online-softmax carry. The carry lives in the output refs — the page
+    grid dim revisits the same output block, the canonical reduction."""
+    if quant:
+        k_sc_ref, v_sc_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    kp = k_ref[0].astype(jnp.float32)  # [page, kv, hd]
+    vp = v_ref[0].astype(jnp.float32)
+    if quant:
+        # the exact kv_quant dequant the XLA path applies to gathered
+        # pages — here on the in-register block, at the f32 compute
+        # dtype (the convert stays off the flops-dominant dots: JXC003)
+        kp = kp * k_sc_ref[0].transpose(1, 0)[..., None]  # [page, kv, 1]
+        vp = vp * v_sc_ref[0].transpose(1, 0)[..., None]
+    qf = q_ref[0]  # [nkv, rep, T, hd], f32, pre-scaled by the caller
+    s = jnp.einsum("grth,pgh->grtp", qf, kp)  # [nkv, rep, T, page]
+    pos = j * page + jax.lax.broadcasted_iota(jnp.int32, (page, 1), 0)[:, 0]
+    ok = pos < bound_ref[b]  # strictly pre-existing positions only
+    s = jnp.where(ok[None, None, None, :], s, _NEG)
+    m_prev, l_prev, acc_prev = m_ref[0], l_ref[0], acc_ref[0]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    alpha = jnp.exp(m_prev - m_new)
+    pexp = jnp.exp(s - m_new[..., None])
+    m_ref[0] = m_new
+    l_ref[0] = l_prev * alpha + pexp.sum(axis=-1)
+    acc_ref[0] = acc_prev * alpha[..., None] + jnp.einsum("grtp,pgh->grth", pexp, vp)
+
+
+def paged_attn_partials(qf, pool_k_l, pool_v_l, tables, bound,
+                        k_scale_l=None, v_scale_l=None, *, interpret: bool | None = None):
+    """Online-softmax partials of ``qf`` over each lane's paged prefix.
+
+    qf: [B, nkv, rep, T, hd] float32, already scaled by 1/sqrt(hd);
+    pool_*_l: [P, page, kv, hd] (one layer; fp or int8);
+    tables: [B, max_pg] int32 device page table (padding rows point at
+    the trash page — masked by ``bound``); bound: [B] int32 — attend to
+    pool positions ``0 .. bound[b]-1`` ONLY (lengths for decode, the
+    prefix start for wide-block verify/extend). The position being
+    written this step is >= bound by contract and must reach attention
+    in registers via the caller's self/chunk fold, never from the pool.
+    k_scale_l/v_scale_l: [P, kv, page] f32 for int8 pools.
+
+    Returns (m [B, nkv, rep, T], l same, acc [B, nkv, rep, T, hd]) f32 —
+    the same partials the XLA page scan carries, ready for the shared
+    ``_combine`` + normalize tail.
+    """
+    if pl is None:  # pragma: no cover — kernel_supported gates real callers
+        raise RuntimeError("pallas is unavailable in this jax build")
+    B, nkv, rep, T, hd = qf.shape
+    page = pool_k_l.shape[1]
+    kvh = pool_k_l.shape[2]
+    max_pg = tables.shape[1]
+    quant = k_scale_l is not None
+    if interpret is None:
+        interpret = _interpret_default()
+
+    kernel = functools.partial(_partials_kernel, page=page, quant=quant)
+    lane = lambda b, j, tbl, bnd: (b, 0, 0, 0)  # noqa: E731
+    in_specs = [
+        pl.BlockSpec((1, nkv, rep, T, hd), lambda b, j, tbl, bnd: (b, 0, 0, 0, 0)),
+        # the fused gather: the index map IS the page-table read, so the
+        # pipeline DMAs exactly one pool page per grid step HBM -> VMEM
+        pl.BlockSpec((1, page, kvh, hd), lambda b, j, tbl, bnd: (tbl[b, j], 0, 0, 0)),
+        pl.BlockSpec((1, page, kvh, hd), lambda b, j, tbl, bnd: (tbl[b, j], 0, 0, 0)),
+    ]
+    args = [tables, bound, qf, pool_k_l, pool_v_l]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, kvh, page), lambda b, j, tbl, bnd: (tbl[b, j], 0, 0)),
+            pl.BlockSpec((1, kvh, page), lambda b, j, tbl, bnd: (tbl[b, j], 0, 0)),
+        ]
+        args += [k_scale_l, v_scale_l]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # tables + bound ride SMEM ahead of the body
+        grid=(B, max_pg),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, nkv, rep, T), lane),
+            pl.BlockSpec((1, nkv, rep, T), lane),
+            pl.BlockSpec((1, nkv, rep, T, hd), lambda b, j, tbl, bnd: (b, 0, 0, 0, 0)),
+        ],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((B, nkv, rep, T), jnp.float32),
+        jax.ShapeDtypeStruct((B, nkv, rep, T), jnp.float32),
+        jax.ShapeDtypeStruct((B, nkv, rep, T, hd), jnp.float32),
+    ]
+    kw = {}
+    if not interpret:
+        # lanes are independent; the page dim carries the m/l/acc
+        # reduction and must stay sequential
+        kw["compiler_params"] = pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        )
+    m, l, acc = pl.pallas_call(
+        kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interpret, **kw
+    )(*args)
+    return m, l, acc
+
+
+# ---------------------------------------------------------------------------
+# jaxcheck entries: the kernel traced over interpret-mode buckets (this is
+# how the static pass sees the program on TPU-less CI; the pallas_call
+# abstract shapes are identical either way). Shapes mirror model_runner's
+# _trace_cfg pools: nkv=8, hd=128, page=16 — tile-true trailing dims so
+# JXC006's (8,128) math stays meaningful. The fp entry carries both the
+# decode (T=1) and wide-block (T=5, spec verify's k+1) buckets.
+# ---------------------------------------------------------------------------
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _bucket_partials(B=8, pages=64, page=16, kv=8, hd=128, T=1, quant=False):
+    qf = _sds((B, kv, 1, T, hd), jnp.float32)
+    pool = _sds((pages, page, kv, hd), jnp.int8 if quant else jnp.float32)
+    tables = _sds((B, 8), jnp.int32)
+    bound = _sds((B,), jnp.int32)
+    args = (qf, pool, pool, tables, bound)
+    if quant:
+        sc = _sds((pages, kv, page), jnp.float32)
+        args += (sc, sc)
+    return args, {}
+
+
+@jaxcheck.entry(
+    name="llm.paged_attn_pallas",
+    shapes={
+        "b8_t1_interp": _bucket_partials,
+        "b8_t5_interp": lambda: _bucket_partials(T=5),
+    },
+)
+def paged_attn_pallas(qf, pool_k_l, pool_v_l, tables, bound):
+    """Registry twin of the fp kernel call (decode + wide-block buckets).
+    Nothing donates: the partials feed the caller's self/chunk fold and
+    qf/pool stay live past the call by design."""
+    return paged_attn_partials(qf, pool_k_l, pool_v_l, tables, bound, interpret=True)
+
+
+@jaxcheck.entry(
+    name="llm.paged_attn_pallas_int8",
+    shapes={
+        "b8_t1_interp": lambda: _bucket_partials(quant=True),
+        "b8_t5_interp": lambda: _bucket_partials(T=5, quant=True),
+    },
+)
+def paged_attn_pallas_int8(qf, pool_k_l, pool_v_l, tables, bound, k_scale_l, v_scale_l):
+    """Int8-pool twin: in-register dequant rides the same kernel body
+    (the scale planes stream with their pages through the index map)."""
+    return paged_attn_partials(
+        qf, pool_k_l, pool_v_l, tables, bound, k_scale_l, v_scale_l, interpret=True
+    )

@@ -348,6 +348,7 @@ def spec_verify_paged(
     hist_len,
     cfg: LlamaConfig,
     tpc: TpSpec | None = None,
+    attn_impl: str = "xla",
 ):
     """READ-ONLY half of the paged speculative tick: block attention over
     the cached pages (prefix from the pool, the block itself in
@@ -355,8 +356,11 @@ def spec_verify_paged(
     + write-target math; the pool scatter is spec_append_paged. Rows past
     a lane's table edge redirect to the trash page — those positions only
     arise in rounds whose tokens the host already discarded. ``tpc``:
-    shard_map body mode, as on decode_step/_forward_block_slots."""
-    from ray_tpu.llm.paged_kv import _paged_attn_seq
+    shard_map body mode, as on decode_step/_forward_block_slots.
+    ``attn_impl``: "pallas" streams the prefix pages through the fused
+    kernel (llm/pallas/paged_attn.py) — the wide-block verify rides the
+    same HBM-streaming path as decode; "xla" stays the oracle."""
+    from ray_tpu.llm.paged_kv import _paged_attn_seq_batch
 
     B, k = proposals.shape
     T = k + 1
@@ -382,8 +386,9 @@ def spec_verify_paged(
         qh = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)  # [B, nh, T, hd]
         kh = apply_rope(k_t.transpose(0, 2, 1, 3), cos, sin).transpose(0, 2, 1, 3)  # [B, T, nkv, hd]
         qg = qh.reshape(B, nkv, rep, T, hd)
-        o = jax.vmap(_paged_attn_seq, in_axes=(0, None, None, 0, 0, 0, 0, None, None, None))(
-            qg, k_pool_l, v_pool_l, tables, lengths, kh, v_t, scale, k_sc_l, v_sc_l
+        o = _paged_attn_seq_batch(
+            qg, k_pool_l, v_pool_l, tables, lengths, kh, v_t, scale, k_sc_l, v_sc_l,
+            impl=attn_impl,
         )  # [B, nkv, rep, T, hd]
         o = o.transpose(0, 3, 1, 2, 4).reshape(B, T, nh * hd).astype(x.dtype)
         x = x + _tp_reduce(jnp.dot(o, layer["wo"]), tpc)
@@ -478,11 +483,14 @@ def _sharded_spec_verify_paged(cfg: LlamaConfig, mesh, tp_collective: str, kv_qu
     )
 
 
-def make_spec_verify_paged(cfg: LlamaConfig, k: int, mesh=None, tp_collective: str = "fp", kv_quant: bool = False):
+def make_spec_verify_paged(cfg: LlamaConfig, k: int, mesh=None, tp_collective: str = "fp", kv_quant: bool = False,
+                           attn_impl: str = "xla"):
     """(attention+accept program, scatter-append program) for the paged
     layout — two dispatches, never fused (see decode_attn_paged). With a
     tp>1 mesh the attention half compiles under shard_map, same explicit
-    collective schedule as the fused step."""
+    collective schedule as the fused step. ``attn_impl="pallas"`` puts
+    the wide-block prefix attention on the fused kernel (single-device
+    path only, matching make_fused_paged_fns)."""
     del k
     from ray_tpu.parallel.mesh import axis_size
 
@@ -492,7 +500,8 @@ def make_spec_verify_paged(cfg: LlamaConfig, k: int, mesh=None, tp_collective: s
             donate_argnums=(3, 5, 6, 7, 8, 9, 10, 11, 12),
         )
     else:
-        attn_fn = jax.jit(partial(spec_verify_paged, cfg=cfg), donate_argnums=(3, 5, 6, 7, 8, 9, 10, 11, 12))
+        attn_fn = jax.jit(partial(spec_verify_paged, cfg=cfg, attn_impl=attn_impl),
+                          donate_argnums=(3, 5, 6, 7, 8, 9, 10, 11, 12))
     append_fn = jax.jit(spec_append_paged, donate_argnums=(0,))
     return attn_fn, append_fn
 

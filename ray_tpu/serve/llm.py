@@ -602,7 +602,8 @@ class KVPlaneServer(LLMServer):
     replica registers under its deployment name so the router's
     cache-aware scores and the index's entries name the same thing."""
 
-    def __init__(self, llm_config: LLMConfig, index_handle, replica_name: str):
+    def __init__(self, llm_config: LLMConfig, index_handle, replica_name: str,
+                 publish_min_hits: int = 2):
         from dataclasses import replace as _replace
 
         from ray_tpu.llm.kvplane import KVPlaneClient
@@ -614,7 +615,12 @@ class KVPlaneServer(LLMServer):
             "telemetry_tags",
             default_tags(self.telemetry_stage, model=llm_config.model_id, replica=self.replica_name),
         )
-        kwargs.setdefault("kv_plane", KVPlaneClient(index_handle, self.replica_name))
+        # publish_min_hits: the client's capacity-aware publication policy
+        # (publish a prefix only once it shows reuse; 1 = publish-on-store)
+        kwargs.setdefault(
+            "kv_plane",
+            KVPlaneClient(index_handle, self.replica_name, publish_min_hits=publish_min_hits),
+        )
         super().__init__(_replace(llm_config, engine_kwargs=kwargs))
 
     def kvplane_stats(self) -> dict:

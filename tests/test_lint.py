@@ -174,10 +174,12 @@ def test_jaxcheck_traces_at_least_thirty_entries():
     # shard_map'd fused/paged-fused/spec-verify steps over mesh buckets
     # (where JXC005 finally audits real serving-path collectives); the
     # cluster KV plane (llm/kvplane/quant.py) adds the wire
-    # quantize/dequantize pair on the publish/remote-hit paths — any
-    # entry silently dropping out of the registry is an invariant check
-    # that stopped running
-    assert len(entries) >= 30, [e.name for e in entries]
+    # quantize/dequantize pair on the publish/remote-hit paths; the
+    # Pallas paged-attention kernel (llm/pallas/paged_attn.py) adds its
+    # fp + int8 entries over interpret-mode buckets — any entry silently
+    # dropping out of the registry is an invariant check that stopped
+    # running
+    assert len(entries) >= 32, [e.name for e in entries]
     subsystems = {e.name.split(".")[0] for e in entries}
     assert {"llm", "parallel", "collective"} <= subsystems
     names = {e.name for e in entries}
@@ -197,6 +199,7 @@ def test_jaxcheck_traces_at_least_thirty_entries():
         "llm.spec_verify_tp", "llm.spec_verify_paged_tp",
     } <= names
     assert {"llm.kvplane_wire_quantize", "llm.kvplane_wire_dequantize"} <= names
+    assert {"llm.paged_attn_pallas", "llm.paged_attn_pallas_int8"} <= names
     # the tp entries declare their mesh axis, so JXC005 has teeth on them
     by_name = {e.name: e for e in entries}
     assert all(by_name[n].mesh_axes == ("tp",) for n in (
@@ -213,7 +216,7 @@ def test_cli_jax_flag_and_rt_wiring():
     )
     assert r.returncode == 0, r.stdout + r.stderr
     m = re.search(r"jaxcheck traced (\d+) entry point", r.stderr)
-    assert m and int(m.group(1)) >= 28, r.stderr
+    assert m and int(m.group(1)) >= 30, r.stderr
 
 
 def test_cli_list_rules_includes_jax_catalog(capsys):

@@ -75,6 +75,16 @@ def _engine(params, plane=None, **kw):
     return LLMEngine(CFG, params, kv_plane=plane, **kw)
 
 
+def _client(idx, rid, **kw):
+    """Plane client with publish-on-first-store (publish_min_hits=1):
+    these tests exercise the publish/fetch/evict MACHINERY, where the
+    capacity policy's default skip-the-first-sighting would just add a
+    warm-up request to every scenario. The policy itself is locked by
+    test_publish_min_hits_policy."""
+    kw.setdefault("publish_min_hits", 1)
+    return KVPlaneClient(idx, rid, **kw)
+
+
 @pytest.fixture(scope="module")
 def oracle_fp(params):
     """One shared slots-fp oracle engine (no plane): every default-config
@@ -272,13 +282,13 @@ def test_cross_replica_prefix_reuse_token_identical(params, rt, layout, dtype):
     if layout == "paged":
         kw["page_size"] = 32
     idx = PrefixIndex()
-    a = _engine(params, KVPlaneClient(idx, "A"), **kw)
+    a = _engine(params, _client(idx, "A"), **kw)
     a.generate(SHARED + [5, 6, 7], SP)
     assert a.prefix_cache_stats()["remote"]["published_blocks"] == 1
     assert idx.stats()["keys"] == 1
 
     prompt_b = SHARED + [9, 10, 11, 12]
-    b = _engine(params, KVPlaneClient(idx, "B"), **kw)
+    b = _engine(params, _client(idx, "B"), **kw)
     out_b = b.generate(prompt_b, SP)
     oracle_eng = _engine(params, **kw)  # same layout/dtype, no plane
     oracle = oracle_eng.generate(prompt_b, SP)
@@ -301,6 +311,39 @@ def test_cross_replica_prefix_reuse_token_identical(params, rt, layout, dtype):
     assert idx.stats()["keys"] == 1 and idx.match_replicas(
         boundary_keys(prompt_b2, 64)
     ).keys() == {"A", "B"}
+
+
+def test_publish_min_hits_policy(params, rt):
+    """Capacity-aware publication policy (ROADMAP item 1 follow-on): with
+    the default publish_min_hits=2, a ONCE-seen prefix (one store, no
+    reuse evidence) is NOT published — no wire quantize, no owned object,
+    no index entry — and the skip is counted in the plane tier; the
+    SECOND sighting (the first local hit's re-offer) publishes it."""
+    idx = PrefixIndex()
+    a = _engine(params, KVPlaneClient(idx, "A"))  # default policy: min_hits=2
+    a.generate(SHARED + [5, 6], SP)  # store mints the 64-boundary: seen=1
+    s = a.prefix_cache_stats()
+    assert idx.stats()["keys"] == 0, "a once-seen prefix must not publish"
+    assert s["plane"]["published_skipped"] == 1
+    assert s["plane"]["published_blocks"] == 0 and s["remote"]["published_blocks"] == 0
+
+    a.generate(SHARED + [7, 8], SP)  # local hit -> re-offer: seen=2 -> publish
+    s = a.prefix_cache_stats()
+    assert s["local"]["hits"] == 1
+    assert idx.stats()["keys"] == 1, "the second sighting must publish"
+    assert s["plane"]["published_blocks"] == 1 and s["remote"]["published_blocks"] == 1
+    assert s["plane"]["published_skipped"] == 1  # no new skips
+
+    # a REMOTE FETCH is itself reuse evidence: replica B's republish of
+    # the block it just fetched bypasses the policy (proven_reuse), so B
+    # registers as a second holder immediately — not after min_hits of
+    # its own local traffic
+    b = _engine(params, KVPlaneClient(idx, "B"))  # default policy too
+    b.generate(SHARED + [9, 10], SP)
+    sb = b.prefix_cache_stats()
+    assert sb["remote"]["hits"] == 1
+    assert sb["plane"]["published_blocks"] == 1 and sb["plane"]["published_skipped"] == 0
+    assert idx.match_replicas(boundary_keys(SHARED + [0], 64)).keys() == {"A", "B"}
 
 
 def test_blocked_follower_still_hits_leaders_same_wave_store(params):
@@ -338,7 +381,7 @@ def test_evicted_remote_block_bounded_retry_local_prefill(params, rt, oracle_fp)
     from ray_tpu.core import direct
 
     idx = PrefixIndex()
-    a = _engine(params, KVPlaneClient(idx, "A"))
+    a = _engine(params, _client(idx, "A"))
     a.generate(SHARED + [5, 6, 7], SP)
     # simulate the eviction RACE: free the owned bytes WITHOUT
     # unregistering (a clean eviction unregisters first; the race is what
@@ -348,7 +391,7 @@ def test_evicted_remote_block_bounded_retry_local_prefill(params, rt, oracle_fp)
     direct.free_owned([ref.id])
 
     prompt = SHARED + [9, 10, 11]
-    b = _engine(params, KVPlaneClient(idx, "B", fetch_timeout_s=1.0, fetch_retries=1, retry_wait_s=0.05))
+    b = _engine(params, _client(idx, "B", fetch_timeout_s=1.0, fetch_retries=1, retry_wait_s=0.05))
     t0 = time.time()
     out_b = b.generate(prompt, SP)
     assert time.time() - t0 < 30, "lost-block fallback must be bounded, not a hang"
@@ -368,7 +411,7 @@ def test_local_eviction_unregisters_then_frees(params, rt):
     from ray_tpu.llm.disagg.handoff import HandoffLostError, fetch as fetch_handoff
 
     idx = PrefixIndex()
-    client = KVPlaneClient(idx, "A")
+    client = _client(idx, "A")
     a = _engine(params, client)
     a.generate(SHARED + [5, 6], SP)
     key = boundary_keys(SHARED + [1], 64)[0][1]
@@ -393,8 +436,8 @@ def test_cache_aware_router_over_live_engines(params, rt, oracle_fp):
     to the oracle."""
     idx = PrefixIndex()
     engines = {
-        "r0": _engine(params, KVPlaneClient(idx, "r0")),
-        "r1": _engine(params, KVPlaneClient(idx, "r1")),
+        "r0": _engine(params, _client(idx, "r0")),
+        "r1": _engine(params, _client(idx, "r1")),
     }
 
     def submit(rid, prompt, sp):
