@@ -1091,6 +1091,246 @@ def bench_kvplane(cfg, prompt_len: int, gen_len: int, n_replicas: int = 2,
     return rec
 
 
+def bench_overload(cfg, max_num_seqs: int = 4, stream_gen: int = 96, n_phases: int = 3,
+                   arrivals_per_phase: int = 8) -> dict:
+    """Overload A/B (serve/overload.py): an OPEN-LOOP ramp of
+    prefill-heavy arrivals past a saturated replica's capacity, with
+    admission control ON vs OFF.
+
+    The replica runs ``max_num_seqs`` latency-sensitive decode streams
+    (priority 1) that saturate every slot — the SLO traffic whose ITL
+    the fleet must protect. Arrivals are long-prompt/short-gen requests
+    (priority 0) submitted open-loop at 1x/2x/4x the replica's serial
+    arrival-service rate; with zero free capacity EVERY arrival is
+    over-capacity by construction.
+
+    - **OFF** (AdmissionConfig(enabled=False)): every arrival joins the
+      engine queue. Each slot a finishing stream frees is immediately
+      backfilled from the backlog, so the surviving streams eat one
+      prefill stall per served arrival for the rest of the run — decode
+      ITL p99 blows up to the prefill stall, and queue wait grows with
+      the backlog (unbounded in an open loop).
+    - **ON**: class-0 arrivals shed with typed 429s while the streams
+      hold the slots (max_slot_occupancy headroom reservation + queue
+      caps), so overload degrades SHED RATE, never the streams' ITL —
+      the committed gate is ITL p99 within 1.2x of the same replica's
+      unloaded baseline, measured while the OFF arm shows the blow-up.
+
+    Both ITL distributions and the queue waits come from the engine's
+    FLIGHT RECORDER (the same samples the live rt_llm_itl_s /
+    rt_llm_queue_wait_s series aggregate) — telemetry-sourced
+    provenance, like the disagg A/B."""
+    import numpy as np
+
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+    from ray_tpu.serve.overload import AdmissionConfig, OverloadedError
+
+    rng = np.random.default_rng(3)
+    stream_prompts = [
+        [int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=48)] for _ in range(max_num_seqs)
+    ]
+    # STAGGERED stream lengths: slots free progressively, so the OFF arm
+    # backfills each freed slot from its backlog and the surviving
+    # streams eat a prefill stall per served arrival — the blow-up the
+    # ON arm's headroom reservation prevents
+    stream_gens = [
+        max(8, stream_gen * (max_num_seqs - i) // max_num_seqs) for i in range(max_num_seqs)
+    ]
+    arrival_len = min(cfg.max_seq_len - 16, 256)
+    arrival_prompt = [int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=arrival_len)]
+    mults = [2 ** i for i in range(n_phases)]  # 1x, 2x, 4x serial service rate
+
+    def run(admission_on: bool) -> dict:
+        srv = LLMServer(LLMConfig(
+            model_config=cfg,
+            engine_kwargs={
+                "max_num_seqs": max_num_seqs,
+                "max_seq_len": cfg.max_seq_len,
+                "enable_prefix_caching": False,
+            },
+            prewarm=True,
+            admission=AdmissionConfig(
+                enabled=admission_on,
+                max_queue_depth=8,
+                max_queue_wait_s=5.0,
+                # reserve the slots for the priority-1 streams: class 0
+                # sheds whenever >= 25% of slots are busy (i.e. always,
+                # while any stream lives), the streams admit at the full cap
+                max_slot_occupancy=1.0,
+                class_fracs=(0.25, 1.0),
+            ),
+        ))
+        try:
+            def warm_round(gen, n_arr):
+                ths = [
+                    threading.Thread(target=lambda p=p: srv.generate(
+                        p, {"max_tokens": gen, "temperature": 0.0, "priority": 1}, timeout_s=1200.0))
+                    for p in stream_prompts
+                ]
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join()
+                # arrival-shaped warms AFTER the streams drain: the slots
+                # are free, so the ON arm's headroom reservation admits
+                # them and the 256-bucket prefill compiles here, not
+                # inside the measured window (or the t_arrival probe)
+                for _ in range(n_arr):
+                    try:
+                        srv.generate(arrival_prompt, {"max_tokens": 4, "temperature": 0.0, "priority": 1},
+                                     timeout_s=1200.0)
+                    except OverloadedError:
+                        pass
+
+            # two warm rounds: compile every prefill-batch variant and
+            # the fused decode the measured pattern can mint
+            warm_round(6, 2)
+            warm_round(4, 1)
+            # serial arrival service time -> the phase rates
+            t0 = time.perf_counter()
+            srv.generate(arrival_prompt, {"max_tokens": 4, "temperature": 0.0, "priority": 1}, timeout_s=1200.0)
+            t_arrival = max(time.perf_counter() - t0, 1e-3)
+
+            def stream_round(label):
+                ids, ths = [], []
+                lock = threading.Lock()
+
+                def one(p, g):
+                    out = srv.generate(
+                        p, {"max_tokens": g, "temperature": 0.0, "priority": 1},
+                        timeout_s=1200.0,
+                    )
+                    with lock:
+                        ids.append(out["request_id"])
+
+                for p, g in zip(stream_prompts, stream_gens):
+                    ths.append(threading.Thread(target=one, args=(p, g), name=f"stream-{label}"))
+                return ids, ths
+
+            # ---- baseline: streams alone, no arrivals ----
+            base_ids, ths = stream_round("base")
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+
+            # ---- loaded: streams + open-loop arrival ramp ----
+            load_ids, ths = stream_round("load")
+            phases = []
+            arr_lock = threading.Lock()
+            arr_threads = []
+            for t in ths:
+                t.start()
+            for mult in mults:
+                interval = t_arrival / mult
+                ph = {"rate_mult": mult, "interval_s": round(interval, 4),
+                      "submitted": 0, "shed": 0, "errors": 0, "completed": 0}
+                phases.append(ph)
+
+                def arrive(ph=ph):
+                    try:
+                        out = srv.generate(
+                            arrival_prompt,
+                            {"max_tokens": 4, "temperature": 0.0, "priority": 0},
+                            timeout_s=1200.0,
+                        )
+                        with arr_lock:
+                            ph["completed"] += 1
+                            ph.setdefault("ids", []).append(out["request_id"])
+                    except OverloadedError as e:
+                        with arr_lock:
+                            ph["shed"] += 1
+                            ph.setdefault("retry_after_s", round(float(e.retry_after_s), 3))
+                    except Exception:  # noqa: BLE001
+                        with arr_lock:
+                            ph["errors"] += 1
+
+                for _ in range(arrivals_per_phase):
+                    if not any(t.is_alive() for t in ths):
+                        break  # streams done: the overload window closed
+                    ph["submitted"] += 1
+                    th = threading.Thread(target=arrive)
+                    th.start()
+                    arr_threads.append(th)
+                    time.sleep(interval)
+            for t in ths:
+                t.join()
+            t_streams_done = time.perf_counter()
+            for t in arr_threads:
+                t.join(timeout=600)
+            drain_s = time.perf_counter() - t_streams_done
+
+            # ---- telemetry-sourced distributions ----
+            recs = srv.engine.telemetry()["requests"]
+
+            def dist(ids):
+                idset = set(ids)
+                itls = [x for r in recs if r["request_id"] in idset for x in r["itl_s"]]
+                return _dist([], itls), itls
+
+            base, _ = dist(base_ids)
+            load, load_itls = dist(load_ids)
+            arrival_ids = {i for ph in phases for i in ph.get("ids", [])}
+            qwaits = [r["queue_wait_s"] for r in recs
+                      if r["request_id"] in arrival_ids and r.get("queue_wait_s") is not None]
+            st = srv.overload_stats()
+            submitted = sum(p["submitted"] for p in phases)
+            shed = sum(p["shed"] for p in phases)
+            for ph in phases:
+                ph.pop("ids", None)
+                ph["shed_rate"] = round(ph["shed"] / ph["submitted"], 3) if ph["submitted"] else None
+            return {
+                "admission": admission_on,
+                "telemetry": True,  # ITL/queue-wait sourced from the flight recorder
+                "baseline_itl_ms_p50": base["itl_ms_p50"],
+                "baseline_itl_ms_p99": base["itl_ms_p99"],
+                "loaded_itl_ms_p50": load["itl_ms_p50"],
+                "loaded_itl_ms_p99": load["itl_ms_p99"],
+                "itl_p99_vs_baseline": (
+                    round(load["itl_ms_p99"] / base["itl_ms_p99"], 3) if base["itl_ms_p99"] else None
+                ),
+                "itl_samples": len(load_itls),
+                "arrival_service_s": round(t_arrival, 3),
+                "phases": phases,
+                "arrivals_submitted": submitted,
+                "arrivals_shed": shed,
+                "shed_rate": round(shed / submitted, 3) if submitted else None,
+                "queue_wait_ms_p50": _pct(qwaits, 0.50),
+                "queue_wait_ms_p99": _pct(qwaits, 0.99),
+                "backlog_drain_s": round(drain_s, 2),
+                "shed_counters": {k: v for k, v in st.items() if k.startswith("shed")},
+            }
+        finally:
+            srv.shutdown()
+
+    on = run(True)
+    off = run(False)
+    rec = {
+        "metric": "engine_overload_ab",
+        **_device_info(),
+        "kv_dtype": cfg.dtype,
+        "tp": 1,
+        "tp_collective": "fp",
+        "workload": (
+            f"{max_num_seqs} decode streams (priority 1, staggered gen {stream_gens}) saturating every "
+            f"slot + open-loop priority-0 arrivals (prompt {arrival_len}, 4 tokens) ramped at "
+            f"{'/'.join(str(m) + 'x' for m in mults)} the serial arrival-service rate, "
+            f"{arrivals_per_phase} per phase"
+        ),
+        "admission_on": on,
+        "admission_off": off,
+        "batch": max_num_seqs,
+    }
+    print(
+        f"  ON : ITL p99 {on['loaded_itl_ms_p99']} ms ({on['itl_p99_vs_baseline']}x baseline), "
+        f"shed {on['arrivals_shed']}/{on['arrivals_submitted']}, queue-wait p99 {on['queue_wait_ms_p99']} ms\n"
+        f"  OFF: ITL p99 {off['loaded_itl_ms_p99']} ms ({off['itl_p99_vs_baseline']}x baseline), "
+        f"shed {off['arrivals_shed']}/{off['arrivals_submitted']}, queue-wait p99 {off['queue_wait_ms_p99']} ms",
+        flush=True,
+    )
+    return rec
+
+
 def bench_full_stack(cfg, prompt_len: int, gen_len: int, concurrency: int, tiny: bool) -> dict:
     """proxy -> router -> replica -> engine with N concurrent callers."""
     import numpy as np
@@ -1229,6 +1469,7 @@ def main(argv=None):
     benches.append(("engine_tp_ab", lambda: bench_tp(cfg, prompt_len, gen_len, repeats=args.repeats)))
     benches.append(("engine_disagg_ab", lambda: bench_disagg(cfg, prompt_len, gen_len)))
     benches.append(("engine_kvplane_ab", lambda: bench_kvplane(cfg, prompt_len, gen_len)))
+    benches.append(("engine_overload_ab", lambda: bench_overload(cfg)))
     benches.append(("full_stack", lambda: bench_full_stack(cfg, prompt_len, gen_len, args.concurrency, args.tiny or args.small)))
     for name, fn in benches:
         if args.only and args.only not in name:

@@ -1,0 +1,188 @@
+"""Deterministic fault injection for the runtime's serving planes.
+
+Generalizes ``core/rpc_chaos.py`` (reference parity: src/ray/rpc/
+rpc_chaos.h:24 RpcFailureManager — per-method delay/failure injection
+from testing config) from the head<->node-agent transport into ONE
+seeded, rule-based plane whose injection points reach everything the
+serving fleet's failure semantics depend on:
+
+==================  =====================================================
+site                injection point
+==================  =====================================================
+direct.put_owned    owner-local publish on the direct object plane
+direct.get_owned_view  borrow-get of an owned object (handoff/prefix fetch)
+handoff.put         disagg/kvplane handoff publish (codec -> owned object)
+handoff.fetch       bounded-retry handoff fetch (each ATTEMPT is a hit)
+kvplane.index       every cluster prefix-index RPC (filter with methods=)
+serve.step          the serve replica's stepper tick (stall = delay rule,
+                    kill = raises rule: the stepper dies exactly like a
+                    replica crash — waiters fail, health check trips)
+==================  =====================================================
+
+Rules (``inject``) can DELAY (sleep inline), DROP (``apply`` returns
+False — each site maps a drop onto its native loss signal, e.g. a
+dropped ``handoff.fetch`` raises ObjectLostError into the bounded-retry
+loop), or RAISE a supplied exception type. ``max_hits`` bounds a rule,
+``after`` skips the first N matches (fail mid-stream, not at warmup),
+``methods`` filters multi-method sites like ``kvplane.index``.
+
+Safety contract (enforced by scripts/lint_gate.py's chaos-safety gate):
+
+- **Inert by default.** With no rule installed, ``apply()`` is a
+  zero-cost passthrough (one module-flag check), so injection points can
+  live on serving paths without a perf or behavior footprint.
+- **Unreachable from non-test config.** Nothing under ``ray_tpu/`` may
+  call ``inject()``/``seed()`` — rules only ever come from tests (the
+  autouse conftest fixture clears and re-seeds the plane around every
+  test so chaos runs reproduce regardless of ordering).
+- **Enumerable.** Every ``chaos.apply`` call site passes a literal site
+  name from ``SITES``; the gate cross-checks both directions so the
+  documented surface above can never drift from the code.
+
+Determinism: drop/fail draws use one dedicated seeded RNG (``seed``),
+shared with the rpc_chaos adapter, so a chaos test's fault schedule is a
+pure function of its seed and call order.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+
+class ChaosError(RuntimeError):
+    """Default injected fault (rules may substitute any exception type)."""
+
+
+# the fixed injection surface: literal site names at every apply() call
+# site under ray_tpu/ (lint_gate's chaos-safety check enforces the
+# bijection). The transport adapter (core/rpc_chaos.py) keeps its own
+# dynamic "rpc.<msg_type>" namespace on top.
+SITES = frozenset({
+    "direct.put_owned",
+    "direct.get_owned_view",
+    "handoff.put",
+    "handoff.fetch",
+    "kvplane.index",
+    "serve.step",
+})
+
+_RPC_PREFIX = "rpc."
+
+
+@dataclass
+class Rule:
+    delay_s: float = 0.0
+    drop_prob: float = 0.0
+    fail_prob: float = 0.0
+    raises: object = None  # exception CLASS (instantiated per hit)
+    max_hits: int | None = None  # stop applying after this many hits
+    after: int = 0  # skip the first N matches (warmup passes clean)
+    methods: tuple | None = None  # kvplane.index: restrict to these RPCs
+    hits: int = 0  # matches that applied (delay/drop/fail evaluated)
+    seen: int = 0  # matches including ones skipped by `after`
+
+
+_rules: dict[str, Rule] = {}
+_lock = threading.Lock()
+_rng = random.Random(0)
+# fast-path flag read WITHOUT the lock: no rules installed => apply() is
+# a single attribute check. Only mutated under the lock.
+_armed = False
+
+
+def inject(
+    site: str,
+    *,
+    delay_s: float = 0.0,
+    drop_prob: float = 0.0,
+    fail_prob: float = 0.0,
+    raises: object = None,
+    max_hits: int | None = None,
+    after: int = 0,
+    methods=None,
+) -> Rule:
+    """Install one rule for ``site`` (replacing any existing rule there).
+    ``raises`` without ``fail_prob`` means fail on every hit; ``fail_prob``
+    without ``raises`` raises ChaosError. Returns the live Rule so tests
+    can assert on ``.hits``."""
+    global _armed
+    if site not in SITES and not site.startswith(_RPC_PREFIX):
+        raise ValueError(f"unknown chaos site {site!r}; sites: {sorted(SITES)} or rpc.<msg_type>")
+    if raises is not None and fail_prob == 0.0:
+        fail_prob = 1.0
+    if fail_prob > 0.0 and raises is None:
+        raises = ChaosError
+    if raises is not None and not (isinstance(raises, type) and issubclass(raises, BaseException)):
+        raise TypeError(f"raises must be an exception class, got {raises!r}")
+    rule = Rule(
+        delay_s=float(delay_s), drop_prob=float(drop_prob), fail_prob=float(fail_prob),
+        raises=raises, max_hits=max_hits, after=int(after),
+        methods=tuple(methods) if methods else None,
+    )
+    with _lock:
+        _rules[site] = rule
+        _armed = True
+    return rule
+
+
+def clear(prefix: str | None = None) -> None:
+    """Remove every rule (or just those whose site starts with ``prefix``)."""
+    global _armed
+    with _lock:
+        if prefix is None:
+            _rules.clear()
+        else:
+            for k in [k for k in _rules if k.startswith(prefix)]:
+                del _rules[k]
+        _armed = bool(_rules)
+
+
+def seed(n: int = 0) -> None:
+    """Re-seed the drop/fail RNG — chaos schedules reproduce from here."""
+    global _rng
+    with _lock:
+        _rng = random.Random(n)
+
+
+def active() -> bool:
+    """True while any rule is installed (the inert-by-default flag)."""
+    return _armed
+
+
+def rules() -> dict[str, Rule]:
+    with _lock:
+        return dict(_rules)
+
+
+def apply(site: str, method: str | None = None) -> bool:
+    """Evaluate chaos for one event at ``site``. Returns False when the
+    event must be DROPPED (the call site maps that onto its native loss
+    signal); sleeps inline for delay rules; raises for fail rules. With
+    no rules installed this is a single flag check — the zero-cost
+    passthrough the chaos-safety gate locks."""
+    if not _armed:
+        return True
+    with _lock:
+        rule = _rules.get(site)
+        if rule is None:
+            return True
+        if rule.methods is not None and method not in rule.methods:
+            return True
+        rule.seen += 1
+        if rule.seen <= rule.after:
+            return True
+        if rule.max_hits is not None and rule.hits >= rule.max_hits:
+            return True
+        rule.hits += 1
+        delay = rule.delay_s
+        drop = rule.drop_prob > 0 and _rng.random() < rule.drop_prob
+        fail = rule.fail_prob > 0 and (rule.fail_prob >= 1.0 or _rng.random() < rule.fail_prob)
+        exc = rule.raises
+    if delay > 0:
+        time.sleep(delay)
+    if fail:
+        raise exc(f"chaos: injected fault at {site}" + (f".{method}" if method else ""))
+    return not drop

@@ -1560,6 +1560,12 @@ def put_owned(value) -> "ObjectRef":
     st = _state
     if st is None or st.server is None:
         raise RuntimeError("put_owned needs the direct plane (call ray_tpu.init first)")
+    from ray_tpu import chaos
+
+    # chaos site (ray_tpu/chaos.py): object-plane publish faults — inert
+    # single-flag check when no rule is armed
+    if not chaos.apply("direct.put_owned"):
+        raise RuntimeError("chaos: put_owned dropped")
     from ray_tpu.core.payloads import encode_serialized
     from ray_tpu.core.serialization import serialize
 
@@ -1642,6 +1648,12 @@ def get_owned_view(obj_id: ObjectID, timeout: float | None = None):
     Raises ObjectLostError for ids whose owner is gone, GetTimeoutError
     on a bounded wait; falls back to the ordinary (copying) get for ids
     this plane does not own or hint."""
+    from ray_tpu import chaos
+
+    # chaos site: owned-object loss at the borrow-get — a drop rule IS
+    # the loss signal bounded-retry consumers must absorb
+    if not chaos.apply("direct.get_owned_view"):
+        raise ObjectLostError(f"chaos: owned object {obj_id.hex()[:16]} lost")
     handled, value = maybe_get_owned(obj_id, timeout=timeout, zero_copy=True)
     if handled:
         return value

@@ -6,65 +6,88 @@ the head's transport boundary with node agents: outbound messages
 (dispatch, worker control) and inbound messages (task done, worker death,
 pongs) can be delayed or dropped by message type.
 
+Since the serving-plane chaos harness landed, this module is a thin
+ADAPTER over the general plane (``ray_tpu/chaos.py``): each message type
+becomes a rule at site ``rpc.<msg_type>`` in the shared registry, so one
+``chaos.clear()``/``chaos.seed()`` governs transport and serving faults
+together (the autouse conftest fixture relies on exactly that). The
+public API and the ``_rules`` view (tests assert on ``Rule.hits``) are
+unchanged.
+
 Test usage:
     from ray_tpu.core import rpc_chaos
     rpc_chaos.inject("pong", drop_prob=1.0)        # starve health checks
     rpc_chaos.inject("to_worker", delay_s=0.2)     # slow dispatch
     rpc_chaos.clear()
 
-Determinism: drop decisions use a dedicated seeded RNG so chaos tests can
-be reproduced (`rpc_chaos.seed(n)`).
+Determinism: drop decisions use the chaos plane's dedicated seeded RNG
+(`rpc_chaos.seed(n)` == `chaos.seed(n)`).
 """
 
 from __future__ import annotations
 
-import random
-import threading
-import time
-from dataclasses import dataclass
+from ray_tpu import chaos
+from ray_tpu.chaos import Rule  # noqa: F401 (compat re-export)
+
+class _RulesView:
+    """msg_type -> the live chaos Rule, derived ON EVERY ACCESS from the
+    shared registry (no second copy of state, so it cannot desync: a
+    rule cleared there — e.g. by a direct ``chaos.clear()``, which this
+    module's docstring promises governs both planes — is instantly
+    absent here too). Rule objects are the live ones, so tests' ``.hits``
+    assertions keep working."""
+
+    @staticmethod
+    def _live() -> dict:
+        return {k[4:]: r for k, r in chaos.rules().items() if k.startswith("rpc.")}
+
+    def __getitem__(self, msg_type):
+        return self._live()[msg_type]
+
+    def __contains__(self, msg_type):
+        return msg_type in self._live()
+
+    def get(self, msg_type, default=None):
+        return self._live().get(msg_type, default)
+
+    def __iter__(self):
+        return iter(self._live())
+
+    def __len__(self):
+        return len(self._live())
+
+    def __bool__(self):
+        return bool(self._live())
+
+    def keys(self):
+        return self._live().keys()
+
+    def values(self):
+        return self._live().values()
+
+    def items(self):
+        return self._live().items()
+
+    def __repr__(self):
+        return repr(self._live())
 
 
-@dataclass
-class Rule:
-    delay_s: float = 0.0
-    drop_prob: float = 0.0
-    max_hits: int | None = None  # stop applying after this many matches
-    hits: int = 0
-
-
-_rules: dict[str, Rule] = {}
-_lock = threading.Lock()
-_rng = random.Random(0)
+_rules = _RulesView()
 
 
 def inject(msg_type: str, *, delay_s: float = 0.0, drop_prob: float = 0.0, max_hits: int | None = None):
-    with _lock:
-        _rules[msg_type] = Rule(delay_s=delay_s, drop_prob=drop_prob, max_hits=max_hits)
+    chaos.inject("rpc." + msg_type, delay_s=delay_s, drop_prob=drop_prob, max_hits=max_hits)
 
 
 def clear():
-    with _lock:
-        _rules.clear()
+    chaos.clear(prefix="rpc.")
 
 
 def seed(n: int):
-    global _rng
-    with _lock:
-        _rng = random.Random(n)
+    chaos.seed(n)
 
 
 def apply(msg_type: str) -> bool:
     """Apply chaos for one message. Returns False if the message must be
     DROPPED; sleeps inline for delay rules."""
-    with _lock:
-        rule = _rules.get(msg_type)
-        if rule is None:
-            return True
-        if rule.max_hits is not None and rule.hits >= rule.max_hits:
-            return True
-        rule.hits += 1
-        delay = rule.delay_s
-        drop = rule.drop_prob > 0 and _rng.random() < rule.drop_prob
-    if delay > 0:
-        time.sleep(delay)
-    return not drop
+    return chaos.apply("rpc." + msg_type)

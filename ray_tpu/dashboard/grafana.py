@@ -116,6 +116,18 @@ def grafana_dashboard_json(client=None, *, datasource: str = "Prometheus", title
         ("sum(rate(rt_llm_prefix_hits_total[5m])) / sum(rate(rt_llm_requests_finished_total[5m]))", "cluster hit-rate"),
         ("rate(rt_llm_prefix_fetch_bytes_total[1m])", "remote fetch B/s"),
     ], w=12, x=0)
+    add("Serving: overload & drain", [
+        # the degradation-order dashboard: under pressure the shed rate
+        # (lowest class first) and queue-wait estimate move while decode
+        # ITL (panel above) must not. `stage` stays in the sum because a
+        # router's per-request sheds and the replica ingresses'
+        # per-attempt sheds are different rates — folding them together
+        # would overcount one client request by its failover fan-out
+        ("sum by (class, stage) (rate(rt_llm_requests_shed_total[1m]))", "shed/s {{stage}} c{{class}}"),
+        ("rt_llm_admission_queue_wait_est_ms", "est queue wait (ms)"),
+        ("rt_llm_drain_state", "drain state"),
+        ("rate(rt_llm_retry_budget_exhausted_total[5m])", "retry budget exhausted/s"),
+    ], w=12, x=12)
 
     # -- one panel per registered metric (user Counters/Gauges/Histograms) --
     try:

@@ -194,10 +194,15 @@ def publish(kv: dict):
     Returns (meta, ref): the multi-MB payload stays owner-local (shm for
     anything over the inline threshold) and only the tiny (meta, ref)
     pair travels back to the router."""
+    from ray_tpu import chaos
     from ray_tpu.core import direct as _direct
 
     payload = encode(kv)
     t0 = time.time()
+    # chaos site: a dropped publish surfaces as the owner-side loss the
+    # router's re-prefill path must absorb (inert when unarmed)
+    if not chaos.apply("handoff.put"):
+        raise HandoffLostError("chaos: handoff publish dropped")
     ref = _direct.put_owned(payload)
     _handoff_span("llm.handoff.put", payload, t0, nbytes=meta_of(payload)["nbytes"])
     return meta_of(payload), ref
@@ -218,6 +223,7 @@ def fetch(
     never hang on a dead handoff. ``kind=PREFIX_KIND`` fetches a cluster
     prefix block under the same retry contract (the kvplane client maps
     the loss into its local-prefill fallback)."""
+    from ray_tpu import chaos
     from ray_tpu.core import direct as _direct
     from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
 
@@ -225,6 +231,12 @@ def fetch(
     for attempt in range(retries + 1):
         try:
             t0 = time.time()
+            # chaos site, INSIDE the bounded-retry loop: each attempt is
+            # one hit, so a max_hits rule can lose the first N attempts
+            # and let the retry succeed (or exhaust into HandoffLostError).
+            # A drop rule maps onto the native loss signal.
+            if not chaos.apply("handoff.fetch"):
+                raise ObjectLostError("chaos: handoff fetch dropped")
             value = _direct.get_owned_view(ref.id, timeout=timeout_s)
             payload = decode(value, kind=kind)
             if meta is not None and tuple(meta.get("shape", payload["k"].shape)) != tuple(payload["k"].shape):

@@ -70,6 +70,7 @@ class DisaggRouter:
         self.stats_counts = {
             "requests": 0, "prefills": 0, "decode_retries": 0,
             "handoffs_lost": 0, "failed": 0, "handoff_bytes": 0,
+            "budget_exhausted": 0, "shed": 0,
         }
         self._seq = 0
         # control-plane events also flow into the live serving metrics
@@ -86,16 +87,25 @@ class DisaggRouter:
             self.stats_counts[key] += by
 
     def generate(self, prompt_token_ids, sampling_params: dict | None = None) -> dict:
-        """One request end to end. Raises DisaggRequestError after the
-        attempt budget; any success path returns the decode result."""
+        """One request end to end. The failover budget is the SHARED
+        per-request ``serve.overload.RetryBudget`` (one policy across the
+        disagg and kvplane routers): every attempt — prefill retry,
+        handoff-lost re-prefill, decode failover — spends one unit.
+        Exhaustion surfaces a typed terminal error: OverloadedError when
+        the last failure was a shedding/draining replica (the 429
+        propagates so clients back off), DisaggRequestError otherwise."""
+        from ray_tpu.serve.overload import RetryBudget, router_terminal
+
         with self._lock:
             self.stats_counts["requests"] += 1
             self._seq += 1
             key = f"dreq-{self._seq}"
+        priority = int((sampling_params or {}).get("priority", 0))
+        budget = RetryBudget(self.max_attempts, self._tel)
         meta = ref = None
         last: BaseException | None = None
         try:
-            for attempt in range(self.max_attempts):
+            while budget.try_spend():
                 if ref is None:
                     try:
                         meta, ref = self._prefill(list(prompt_token_ids))
@@ -121,13 +131,23 @@ class DisaggRouter:
                         meta = ref = None
                     else:
                         # decode lane failure (replica death, transport
-                        # cut): keep the handoff — the block lives in the
+                        # cut, or an overloaded/draining replica's shed):
+                        # keep the handoff — the block lives in the
                         # PREFILL replica, so a surviving owner lets the
                         # retry skip the re-prefill entirely
                         self._bump("decode_retries")
                         self._tel.on_reused()
-            self._bump("failed")
-            self._tel.on_failed()
+            # shared terminal epilogue (serve/overload.py): saturation
+            # re-raises the 429 with the replica's backoff hint; real
+            # failure falls through to this router's terminal class
+            router_terminal(
+                last, budget=budget, priority=priority,
+                counters=self.stats_counts, lock=self._lock, telemetry=self._tel,
+                shed_msg=(
+                    f"request shed: every decode lane overloaded/draining after "
+                    f"{self.max_attempts} attempts"
+                ),
+            )
             raise DisaggRequestError(
                 f"request failed after {self.max_attempts} attempts "
                 f"(last: {type(last).__name__}: {last})"

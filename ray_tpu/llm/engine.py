@@ -1015,6 +1015,53 @@ class LLMEngine:
     def num_running(self) -> int:
         return sum(1 for s in self._slots if s is not None)
 
+    def host_load(self) -> dict:
+        """Load snapshot for admission control (serve/overload.py): queue
+        depth, slot occupancy, occupied/queued/capacity tokens — all host
+        scheduler shadow state, never a device array (the telemetry
+        plane's zero-sync rule applies to the actuator too). Queued
+        demand counts each waiting request's prompt + max_tokens: the
+        admission caps bound BACKLOG, not just live occupancy."""
+        with self._lock:
+            waiting = len(self._waiting)
+            # max_tokens bounds TOTAL generated tokens, so a preempted
+            # requeued request's footprint stays prompt + max_tokens
+            # (its already-generated tokens are part of that budget, not
+            # additional demand)
+            queued_tokens = 0
+            queued_gen_tokens = 0
+            for st in self._waiting:
+                queued_tokens += len(st.prompt_token_ids) + st.params.max_tokens
+                queued_gen_tokens += st.params.max_tokens
+            slots_in_use = sum(1 for s in self._slots if s is not None)
+            if self.kv_layout == "paged":
+                occupied = int(self._lengths.sum())
+                capacity = (self._pcfg.num_pages - 1) * self._pcfg.page_size
+            else:
+                occupied = sum(
+                    len(s.prompt_token_ids) + len(s.token_ids) for s in self._slots if s is not None
+                )
+                capacity = self.max_num_seqs * self.max_seq_len
+        return {
+            "queue_depth": waiting,
+            "queued_tokens": queued_tokens,
+            "queued_gen_tokens": queued_gen_tokens,
+            "slots_in_use": slots_in_use,
+            "slots_total": self.max_num_seqs,
+            "occupied_tokens": occupied,
+            "capacity_tokens": capacity,
+        }
+
+    def release_handoffs(self) -> int:
+        """Drop every stashed (never-popped) handoff payload. Replica
+        drain calls this after admission stops: nothing will ever pop
+        them, and the host arrays would otherwise pin their bytes for
+        the replica's remaining life. Returns how many were dropped."""
+        with self._lock:
+            n = len(self._handoffs)
+            self._handoffs.clear()
+            return n
+
     # --------------------------------------------------------------- engine
 
     def _finish(self, st: RequestState, reason: str):

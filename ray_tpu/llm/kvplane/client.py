@@ -41,7 +41,17 @@ def index_call(index, name: str, *args, timeout_s: float = 10.0):
     in-process PrefixIndex (direct call). The ONE copy of this duck-type
     — the client and the cache-aware router both route through it, so
     transport semantics can never diverge between them. Raises on
-    transport failure; callers own their degrade policy."""
+    transport failure; callers own their degrade policy.
+
+    Chaos plane (ray_tpu/chaos.py, site ``kvplane.index``): tests inject
+    per-method delays/failures HERE — the one seam every index RPC
+    crosses — so the client's circuit breaker and the router's
+    index-down degrade are exercised over the real call path instead of
+    hand-mocked transports. Inert single-flag check when unarmed."""
+    from ray_tpu import chaos
+
+    if not chaos.apply("kvplane.index", method=name):
+        raise ConnectionError(f"chaos: dropped index rpc {name}")
     method = getattr(index, name)
     remote = getattr(method, "remote", None)
     if remote is not None:
@@ -380,6 +390,38 @@ class KVPlaneClient:
                     self.counts["unpublished_blocks"] += 1
                 except BaseException:  # noqa: BLE001
                     pass  # the leak backstop reclaims what an errored free leaves
+
+    # -- drain / teardown --------------------------------------------------
+    def shutdown(self) -> int:
+        """Replica drain/teardown: drop every route this replica
+        registered (one ``drop_replica`` call — the index forgets us
+        atomically) and then free the owned blocks, preserving the
+        route-dies-before-bytes order the eviction path keeps. Publishing
+        disables permanently (the replica is exiting). Returns how many
+        published keys were released. A dead index degrades silently —
+        the lease expiry prunes our entries anyway, and the owned bytes
+        die with this process regardless."""
+        with self._lock:
+            self._publish_enabled = False
+            published = dict(self._published)
+            self._published.clear()
+            self._ref_keys.clear()
+            self._seen.clear()
+        n = len(published)
+        self._safe_call("drop_replica", self.replica_id)
+        refs = {}
+        for _, _, ref in published.values():
+            refs[ref.id.binary()] = ref
+        if refs:
+            from ray_tpu.core import direct as _direct
+
+            for ref in refs.values():
+                try:
+                    _direct.free_owned([ref.id])
+                    self.counts["unpublished_blocks"] += 1
+                except BaseException:  # noqa: BLE001 — backstop reclaims stragglers
+                    pass
+        return n
 
     def stats(self) -> dict:
         with self._lock:

@@ -70,7 +70,10 @@ class CacheAwareRouter:
 
     def __init__(self, index, submit, replicas, *, block: int = 64,
                  cache_weight: float = 1.0, load_weight: float = 0.1,
-                 max_attempts: int = 2, index_timeout_s: float = 10.0):
+                 max_attempts: int = 2, index_timeout_s: float = 10.0,
+                 telemetry_tags: dict | None = None):
+        from ray_tpu.llm.telemetry import RouterTelemetry
+
         self._index = index
         self._submit = submit
         self.replicas = list(replicas)
@@ -84,8 +87,11 @@ class CacheAwareRouter:
         self.stats_counts = {
             "requests": 0, "routed_to_holder": 0, "routed_off_holder": 0,
             "cold": 0, "retries": 0, "failed": 0, "matched_tokens": 0,
-            "index_errors": 0,
+            "index_errors": 0, "budget_exhausted": 0, "shed": 0,
         }
+        # failover/shed events flow into the live serving metrics, same
+        # catalog as the disagg router's
+        self._tel = RouterTelemetry(telemetry_tags)
 
     def _matches(self, prompt) -> dict:
         """Per-replica longest cached prefix; {} when the index is down
@@ -131,8 +137,16 @@ class CacheAwareRouter:
                 self.stats_counts["routed_to_holder"] += 1
             else:
                 self.stats_counts["routed_off_holder"] += 1
+        from ray_tpu.serve.overload import RetryBudget, router_terminal
+
+        priority = int((sampling_params or {}).get("priority", 0))
+        budget = RetryBudget(self.max_attempts, self._tel)
         last: BaseException | None = None
-        for attempt, rid in enumerate(ranked[: self.max_attempts]):
+        attempted = 0
+        for attempt, rid in enumerate(ranked):
+            if not budget.try_spend():
+                break
+            attempted += 1
             if attempt:
                 with self._lock:
                     self.stats_counts["retries"] += 1
@@ -145,10 +159,17 @@ class CacheAwareRouter:
             finally:
                 with self._lock:
                     self._inflight[rid] -= 1
-        with self._lock:
-            self.stats_counts["failed"] += 1
+        # shared terminal epilogue (serve/overload.py): distinguishes
+        # budget exhaustion from a small fleet's ranked list running out,
+        # re-raises saturation as the 429, and only counts real failures
+        # as failed — the ONE policy the disagg router runs too
+        router_terminal(
+            last, budget=budget, priority=priority,
+            counters=self.stats_counts, lock=self._lock, telemetry=self._tel,
+            shed_msg=f"request shed: {attempted} replicas overloaded/draining",
+        )
         raise KVRouteError(
-            f"request failed on {min(self.max_attempts, len(ranked))} replicas "
+            f"request failed on {attempted} replicas "
             f"(last: {type(last).__name__}: {last})"
         ) from last
 

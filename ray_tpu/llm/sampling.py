@@ -27,10 +27,17 @@ class SamplingParams:
     stop_token_ids: tuple = field(default_factory=tuple)
     seed: int | None = None
     logprobs: bool = False
+    # request class for admission control / load shedding
+    # (serve/overload.py): 0 = lowest, shed first; higher classes only
+    # shed at larger fractions of the ingress caps. Never reorders
+    # admitted work — priority decides WHO sheds, not who runs first.
+    priority: int = 0
 
     def __post_init__(self):
         if self.temperature < 0.0:
             raise ValueError("temperature must be >= 0")
+        if self.priority < 0:
+            raise ValueError("priority must be >= 0")
         if not 0.0 < self.top_p <= 1.0:
             raise ValueError("top_p must be in (0, 1]")
         if self.top_k < 0:

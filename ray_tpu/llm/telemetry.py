@@ -144,6 +144,30 @@ METRICS: dict[str, dict] = {
         "kind": "counter", "tags": _SERVE_TAGS,
         "desc": "bytes fetched from remote replicas' published prefix blocks (cluster KV plane)",
     },
+    # overload plane (serve/overload.py): admission control sheds by
+    # request class BEFORE queue wait grows, queue wait grows before
+    # decode ITL ever does — these series are how a dashboard sees that
+    # degradation order actually holding.
+    "rt_llm_requests_shed_total": {
+        "kind": "counter", "tags": _SERVE_TAGS + ("class",),
+        "desc": (
+            "admission sheds (OverloadedError) by request class; each replica ingress counts "
+            "its own shed and a router counts once per client request, so separate by stage "
+            "when summing request-level shed rates"
+        ),
+    },
+    "rt_llm_admission_queue_wait_est_ms": {
+        "kind": "gauge", "tags": _SERVE_TAGS,
+        "desc": "admission controller's live queue-wait estimate (queue depth x service-time EMA / slots)",
+    },
+    "rt_llm_retry_budget_exhausted_total": {
+        "kind": "counter", "tags": _SERVE_TAGS,
+        "desc": "requests whose router failover budget ran out (terminal typed error surfaced)",
+    },
+    "rt_llm_drain_state": {
+        "kind": "gauge", "tags": _SERVE_TAGS,
+        "desc": "replica drain lifecycle: 0 serving, 1 draining (shedding new work), 2 drained",
+    },
 }
 
 _instruments: dict = {}
@@ -353,6 +377,17 @@ class EngineTelemetry:
         # advanced every dispatched step). 0 on tp=1 engines; computed
         # lazily so engine construction never pays an extra trace.
         self._wire_bytes_per_step: float | None = None
+        # live EMAs the admission controller reads (serve/overload.py):
+        # inter-token latency and per-request service time (admit ->
+        # finish wall). One multiply-add on paths already stamping these
+        # clocks — inside the zero-overhead gate's budget.
+        self.itl_ema_s = 0.0
+        self.service_ema_s = 0.0
+        # optional per-sample-tick callback (the admission controller's
+        # queue-wait-gauge refresh): called with the current queue depth
+        # so the gauge tracks DRAINING pressure too — a gauge only set at
+        # admission time would freeze at its peak once arrivals stop
+        self.sample_hook = None
 
     # -- registration -----------------------------------------------------
     def register_fused_entries(self) -> None:
@@ -466,11 +501,16 @@ class EngineTelemetry:
             gap = now - st.t_last
             st.itls.append(gap)
             self._b_itl.observe(max(gap, 0.0))
+            g = max(gap, 0.0)
+            self.itl_ema_s = g if self.itl_ema_s == 0.0 else 0.9 * self.itl_ema_s + 0.1 * g
         st.t_last = now
         self._tok_accum += 1.0  # flushed into the counter on sample ticks
 
     def on_finish(self, st, reason: str) -> None:
         now = time.time()
+        if st.t_admit:
+            dur = max(now - st.t_admit, 0.0)
+            self.service_ema_s = dur if self.service_ema_s == 0.0 else 0.9 * self.service_ema_s + 0.1 * dur
         self.m["rt_llm_requests_finished_total"].inc(1.0, tags={**self.tags, "reason": reason.split(":")[0]})
         self.recorder.record_request({
             "request_id": st.request_id,
@@ -607,6 +647,11 @@ class EngineTelemetry:
         if self._wire_accum:
             self._b_wire.inc(self._wire_accum)
             self._wire_accum = 0.0
+        if self.sample_hook is not None:
+            try:
+                self.sample_hook(waiting)
+            except Exception:  # noqa: BLE001 — observers never break the step
+                pass
 
     # -- postmortem --------------------------------------------------------
     def dump_on_error(self, exc: BaseException) -> str | None:
@@ -666,3 +711,19 @@ class RouterTelemetry:
 
     def on_failed(self) -> None:
         self.m["rt_llm_requests_finished_total"].inc(1.0, tags={**self.tags, "reason": "error"})
+
+    def on_budget_exhausted(self) -> None:
+        """A request's shared failover budget (serve/overload.RetryBudget)
+        ran dry — the typed terminal error is about to surface."""
+        self.m["rt_llm_retry_budget_exhausted_total"].inc(1.0, tags=self.tags)
+
+    def on_shed(self, shed_class: int) -> None:
+        """The router itself shed a request (every ranked replica was
+        overloaded/draining). Same series as the replica-level sheds but
+        under this router's ``stage`` tag: one CLIENT request that shed
+        at several replicas during failover counts once per replica plus
+        once here — separate by stage when summing request-level rates
+        (the Grafana panel does). Label clamped like the replicas'."""
+        self.m["rt_llm_requests_shed_total"].inc(
+            1.0, tags={**self.tags, "class": str(max(0, min(int(shed_class), 9)))}
+        )

@@ -105,8 +105,14 @@ class AsyncHTTPProxy(RouteTableMixin):
                 except (ConnectionError, asyncio.CancelledError):
                     return
                 except Exception as e:  # noqa: BLE001
+                    from ray_tpu.serve.overload import http_error_of
+
+                    mapped = http_error_of(e)  # typed 429s keep their status
                     try:
-                        await self._respond(writer, 500, {"error": repr(e)})
+                        if mapped is not None:
+                            await self._respond(writer, mapped[0], mapped[1])
+                        else:
+                            await self._respond(writer, 500, {"error": repr(e)})
                     except ConnectionError:
                         return
                     close = not keep_alive
@@ -197,28 +203,78 @@ class AsyncHTTPProxy(RouteTableMixin):
         return False
 
     async def _stream(self, writer, gen, timeout) -> bool:
-        """Chunked streaming with drain() backpressure. As in the sync
-        proxy, an error after the 200 header aborts WITHOUT the chunked
-        terminator — truncation is the only honest mid-stream error."""
+        """Chunked streaming with drain() backpressure. The FIRST item is
+        fetched before the 200 header commits, so a shed (OverloadedError
+        -> 429 + retry-after) or admission error keeps its typed status.
+        As in the sync proxy, an error AFTER the 200 header aborts
+        WITHOUT the chunked terminator — truncation is the only honest
+        mid-stream error."""
+        # the whole-request deadline starts at stream OPEN (matching the
+        # sync proxy): TTFT spends from the same budget as the body
+        deadline = time.time() + timeout if timeout else None
+        ait = aiter_stream(gen, item_timeout_s=timeout).__aiter__()
+        exhausted = False
+        first = None
+        have_first = False
+        try:
+            first = await ait.__anext__()
+            have_first = True
+        except StopAsyncIteration:
+            exhausted = True
+        except asyncio.CancelledError:
+            raise
+        except ray_tpu.exceptions.GetTimeoutError:
+            # same deadline classification as the unary path: a
+            # first-token timeout is a 504, not a server fault. The
+            # remote generation was already admitted — cancel it, as the
+            # mid-stream abort path does, or the abandoned request holds
+            # a slot generating tokens nobody consumes.
+            self._cancel_stream(gen)
+            try:
+                await self._respond(writer, 504, {"error": f"request exceeded {timeout}s"})
+            except ConnectionError:
+                return True
+            return False
+        except Exception as e:  # noqa: BLE001
+            from ray_tpu.serve.overload import http_error_of
+
+            self._cancel_stream(gen)
+            mapped = http_error_of(e)
+            try:
+                if mapped is not None:
+                    await self._respond(writer, mapped[0], mapped[1])
+                else:
+                    await self._respond(writer, 500, {"error": repr(e)})
+            except ConnectionError:
+                return True
+            return False
         writer.write(
             b"HTTP/1.1 200 OK\r\nContent-Type: application/octet-stream\r\nTransfer-Encoding: chunked\r\n\r\n"
         )
-        deadline = time.time() + timeout if timeout else None
-        clean = False
+        clean = exhausted  # an empty stream terminates cleanly
+
+        def _encode(item) -> bytes:
+            if isinstance(item, (bytes, bytearray)):
+                return bytes(item)
+            if isinstance(item, str):
+                return item.encode()
+            return (json.dumps(item) + "\n").encode()
+
         try:
-            async for item in aiter_stream(gen, item_timeout_s=timeout):
+            while not exhausted:
                 if deadline is not None and time.time() > deadline:
-                    break
-                if isinstance(item, (bytes, bytearray)):
-                    data = bytes(item)
-                elif isinstance(item, str):
-                    data = item.encode()
+                    break  # unclean abort below
+                if have_first:
+                    item, have_first = first, False
                 else:
-                    data = (json.dumps(item) + "\n").encode()
+                    try:
+                        item = await ait.__anext__()
+                    except StopAsyncIteration:
+                        clean = True
+                        break
+                data = _encode(item)
                 writer.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
                 await writer.drain()  # slow client backpressures HERE
-            else:
-                clean = True
         except (Exception, asyncio.CancelledError):  # noqa: BLE001
             clean = False
         if clean:
@@ -228,11 +284,18 @@ class AsyncHTTPProxy(RouteTableMixin):
             except ConnectionError:
                 return True
             return False
+        self._cancel_stream(gen)
+        return True  # aborted: close so the client sees truncation
+
+    @staticmethod
+    def _cancel_stream(gen) -> None:
+        """Abort the remote generation behind an abandoned stream (every
+        failure path — pre- and post-header — must cancel, or the request
+        keeps its slot generating tokens nobody consumes)."""
         try:
             gen.cancel()
-        except Exception:
+        except Exception:  # noqa: BLE001
             pass
-        return True  # aborted: close so the client sees truncation
 
     async def _respond(self, writer, code: int, payload, close: bool = False):
         if isinstance(payload, (bytes, bytearray)):
@@ -241,10 +304,18 @@ class AsyncHTTPProxy(RouteTableMixin):
             data, ctype = payload.encode(), "text/plain"
         else:
             data, ctype = json.dumps(payload).encode(), "application/json"
-        reason = {200: "OK", 404: "Not Found", 413: "Payload Too Large", 431: "Headers Too Large", 500: "Internal Server Error", 504: "Gateway Timeout"}.get(code, "")
+        reason = {200: "OK", 404: "Not Found", 413: "Payload Too Large", 429: "Too Many Requests", 431: "Headers Too Large", 500: "Internal Server Error", 504: "Gateway Timeout"}.get(code, "")
+        extra = b""
+        if code == 429 and isinstance(payload, dict) and payload.get("retry_after_s"):
+            # the STANDARD backoff header: off-the-shelf clients / load
+            # balancers honor Retry-After, not our body field
+            import math
+
+            extra = f"Retry-After: {max(1, math.ceil(float(payload['retry_after_s'])))}\r\n".encode()
         conn = b"Connection: close\r\n" if close else b""
         writer.write(
             f"HTTP/1.1 {code} {reason}\r\nContent-Type: {ctype}\r\nContent-Length: {len(data)}\r\n".encode()
+            + extra
             + conn
             + b"\r\n"
             + data

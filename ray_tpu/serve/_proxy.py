@@ -131,6 +131,15 @@ class HTTPProxy(RouteTableMixin):
                         return
                     self._respond(200, result)
                 except Exception as e:  # noqa: BLE001
+                    from ray_tpu.serve.overload import http_error_of
+
+                    mapped = http_error_of(e)
+                    if mapped is not None:
+                        # typed serving errors carry their own status:
+                        # OverloadedError/ReplicaDrainingError -> 429 with
+                        # a retry-after hint instead of a generic 500
+                        self._respond(mapped[0], mapped[1])
+                        return
                     import traceback as _tb
 
                     self._respond(500, {"error": repr(e), "trace": _tb.format_exc()})
@@ -152,26 +161,69 @@ class HTTPProxy(RouteTableMixin):
 
             def _stream(self, gen, timeout):
                 """Chunked transfer: one chunk per yielded item (reference:
-                proxy streaming of StreamingResponse bodies). Errors and
-                timeouts after the 200 header abort the connection WITHOUT
-                the chunked terminator — a truncated stream is the only
-                honest error signal once streaming began; a clean
-                terminator would make partial output look complete (and a
-                second response would desync HTTP/1.1 keep-alive)."""
+                proxy streaming of StreamingResponse bodies). The FIRST
+                item is fetched before the 200 header commits, so an
+                ingress that sheds (OverloadedError) or errors at
+                admission still gets its typed status (429 + retry-after)
+                instead of a fake 200. Errors and timeouts AFTER the 200
+                header abort the connection WITHOUT the chunked
+                terminator — a truncated stream is the only honest error
+                signal once streaming began; a clean terminator would
+                make partial output look complete (and a second response
+                would desync HTTP/1.1 keep-alive)."""
+                import itertools
+
+                def cancel():
+                    # every failure path must abort the admitted
+                    # generation (the unary path's resp.cancel()), or the
+                    # abandoned request holds a batch slot generating
+                    # tokens nobody consumes — inflating host_load()
+                    # occupancy and shedding real traffic
+                    try:
+                        gen.cancel()
+                    except Exception:  # noqa: BLE001
+                        pass
+
+                deadline = time.time() + timeout if timeout else None
+                it = iter(gen)
+                exhausted = False
+                try:
+                    if deadline is not None:
+                        gen.item_timeout_s = max(deadline - time.time(), 0.01)
+                    first = next(it)
+                    it = itertools.chain([first], it)
+                except StopIteration:
+                    exhausted = True
+                except ray_tpu.exceptions.GetTimeoutError:
+                    # same deadline classification as the unary path: a
+                    # first-token timeout is a 504, not a server fault
+                    cancel()
+                    self._respond(504, {"error": f"request exceeded {timeout}s"})
+                    return
+                except Exception as e:  # noqa: BLE001
+                    from ray_tpu.serve.overload import http_error_of
+
+                    cancel()
+                    mapped = http_error_of(e)
+                    if mapped is not None:
+                        self._respond(mapped[0], mapped[1])
+                        return
+                    import traceback as _tb
+
+                    self._respond(500, {"error": repr(e), "trace": _tb.format_exc()})
+                    return
                 self.send_response(200)
                 self.send_header("Content-Type", "application/octet-stream")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
-                deadline = time.time() + timeout if timeout else None
 
                 def chunk(data: bytes):
                     self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
                     self.wfile.flush()
 
-                clean = False
+                clean = exhausted  # an empty stream terminates cleanly
                 try:
-                    it = iter(gen)
-                    while True:
+                    while not exhausted:
                         if deadline is not None:
                             remaining = deadline - time.time()
                             if remaining <= 0:
@@ -199,6 +251,7 @@ class HTTPProxy(RouteTableMixin):
                         except OSError:
                             pass
                     else:
+                        cancel()  # post-header abort: same slot-leak rule
                         self.close_connection = True
 
             def _respond(self, code: int, payload):
@@ -211,6 +264,12 @@ class HTTPProxy(RouteTableMixin):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                if code == 429 and isinstance(payload, dict) and payload.get("retry_after_s"):
+                    # the STANDARD backoff header: off-the-shelf clients /
+                    # load balancers honor Retry-After, not our body field
+                    import math
+
+                    self.send_header("Retry-After", str(max(1, math.ceil(float(payload["retry_after_s"])))))
                 self.end_headers()
                 self.wfile.write(data)
 

@@ -83,27 +83,52 @@ class Replica:
         if fn is not None:
             fn(user_config)
 
+    def _drain_hook(self):
+        """The deployment's drain lifecycle hook, iff it matches the
+        contract (accepts timeout_s — serve/llm.py LLMServer.drain): a
+        user method merely NAMED drain with a different signature is not
+        the hook and must not be mis-called."""
+        if self._is_function:
+            return None
+        hook = getattr(self._callable, "drain", None)
+        if not callable(hook):
+            return None
+        try:
+            inspect.signature(hook).bind(timeout_s=0.0)
+        except TypeError:
+            return None
+        return hook
+
     def prepare_shutdown(self, timeout_s: float = 5.0):
-        """Drain in-flight requests (bounded), then run the deployment's
-        cleanup hook — `shutdown()`/`close()`/`__del__` in that order
-        (reference: replica graceful shutdown calls the user __del__)."""
+        """Drain in-flight requests, then run the deployment's cleanup
+        hook — `drain(timeout_s=...)`/`shutdown()`/`close()`/`__del__`
+        in that order (reference: replica graceful shutdown calls the
+        user __del__). A contract-matching drain hook gets the WHOLE
+        budget and owns the bounded finish-in-flight wait itself;
+        otherwise this method waits for in-flight requests first."""
         deadline = time.time() + timeout_s
-        while time.time() < deadline:
-            with self._lock:
-                if self._ongoing == 0:
-                    break
-            time.sleep(0.02)
+        drain = self._drain_hook()
+        if drain is None:
+            while time.time() < deadline:
+                with self._lock:
+                    if self._ongoing == 0:
+                        break
+                time.sleep(0.02)
         if not self._is_function:
-            for name in ("shutdown", "close", "__del__"):
-                hook = getattr(self._callable, name, None)
-                if callable(hook):
-                    try:
-                        res = hook()
-                        if inspect.iscoroutine(res):
-                            asyncio.run_coroutine_threadsafe(res, self._loop).result(timeout=timeout_s)
-                    except Exception:
-                        pass
-                    break
+            for name in ("drain", "shutdown", "close", "__del__"):
+                if name == "drain":
+                    hook, kwargs = drain, {"timeout_s": max(deadline - time.time(), 0.0)}
+                else:
+                    hook, kwargs = getattr(self._callable, name, None), {}
+                if not callable(hook):
+                    continue
+                try:
+                    res = hook(**kwargs)
+                    if inspect.iscoroutine(res):
+                        asyncio.run_coroutine_threadsafe(res, self._loop).result(timeout=timeout_s)
+                except Exception:
+                    pass
+                break
         with self._lock:
             drained = self._ongoing == 0
         if drained:

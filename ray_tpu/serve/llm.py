@@ -23,6 +23,13 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ray_tpu import chaos
+from ray_tpu.serve.overload import (
+    AdmissionController,
+    OverloadedError,  # noqa: F401 (re-export: the ingress's typed 429)
+    ReplicaDrainingError,  # noqa: F401 (re-export)
+)
+
 
 @dataclass
 class LLMConfig:
@@ -55,6 +62,13 @@ class LLMConfig:
     # spin-up — not the first request — pays the XLA compiles, in
     # parallel across replicas (BENCH_scale.json: disagg_spinup)
     prewarm: bool = True
+    # admission control / load shedding at the replica ingress
+    # (serve/overload.AdmissionConfig). None = the default caps; pass
+    # AdmissionConfig(enabled=False) to admit unconditionally (the
+    # overload bench's baseline arm). Past the caps, generate() raises
+    # OverloadedError (HTTP 429 + retry-after) with the lowest request
+    # class (SamplingParams.priority / body "priority") shed first.
+    admission: object = None
 
 
 class LLMServer:
@@ -96,6 +110,11 @@ class LLMServer:
         self._stopped = False
         self._stepper_error: str | None = None
         self._work = threading.Event()
+        # bounded admission at this replica's ingress (serve/overload.py):
+        # past the caps generate() sheds with a typed OverloadedError
+        # instead of joining an unbounded queue — overload degrades shed
+        # rate and queue wait, never in-flight decode ITL
+        self._admission = AdmissionController(self.engine, llm_config.admission)
         if llm_config.prewarm:
             # BEFORE the stepping thread exists: engine.generate drives
             # its own loop and would race a concurrent stepper
@@ -136,6 +155,14 @@ class LLMServer:
                 self._work.clear()
                 continue
             try:
+                # chaos plane: a delay rule stalls this replica's decode
+                # ticks, a drop rule skips them (a stall without sleeping
+                # inside the rule), a raises rule kills the stepper
+                # exactly like a replica crash (waiters fail, health check
+                # trips, routers fail over). Inert one-flag check unarmed.
+                if not chaos.apply("serve.step"):
+                    time.sleep(0.005)  # dropped tick: yield, don't spin
+                    continue
                 outs = self.engine.step()
             except Exception:  # noqa: BLE001
                 # a dying stepper must not wedge the replica silently:
@@ -143,37 +170,74 @@ class LLMServer:
                 # the controller replaces it
                 import traceback
 
-                self._stepper_error = traceback.format_exc()
-                with self._lock:
-                    events = list(self._events.values())
-                    self._events.clear()
-                for ev in events:
-                    ev.set()
-                # streaming consumers block on their queues, not events:
-                # push sentinels so they wake and re-check _stepper_error
-                with self.engine._lock:
-                    streams = [st.out_queue for st in self.engine._requests.values() if st.out_queue is not None]
-                for q in streams:
-                    q.put(None)
+                self._fail_all_waiters(traceback.format_exc())
                 return
-            for out in outs:
-                # streamed requests deliver through their out_queue; putting
-                # them in _done would leak (no collector ever pops them)
-                if out.finished and not out.streamed:
-                    with self._lock:
-                        self._done[out.request_id] = out
-                        ev = self._events.get(out.request_id)
-                    if ev is not None:
-                        ev.set()
+            self._deliver_outputs(outs)
+
+    def _fail_all_waiters(self, reason: str) -> None:
+        """The ONE failure sweep for a stepper that will never step again
+        (death, drain's broken-engine path, shutdown with work in
+        flight): record the reason, wake every blocked _await_finished
+        waiter, and push sentinels into streaming consumers' queues —
+        they block on their queues, not events, and re-check
+        _stepper_error on waking."""
+        if self._stepper_error is None:
+            self._stepper_error = reason
+        with self._lock:
+            events = list(self._events.values())
+            self._events.clear()
+        for ev in events:
+            ev.set()
+        with self.engine._lock:
+            streams = [st.out_queue for st in self.engine._requests.values() if st.out_queue is not None]
+        for q in streams:
+            q.put(None)
+
+    def _deliver_outputs(self, outs):
+        """Publish finished outputs to their blocked waiters (the stepper's
+        delivery half; drain() reuses it for the post-abort cleanup step)."""
+        for out in outs:
+            # streamed requests deliver through their out_queue; putting
+            # them in _done would leak (no collector ever pops them)
+            if out.finished and not out.streamed:
+                with self._lock:
+                    self._done[out.request_id] = out
+                    ev = self._events.get(out.request_id)
+                if ev is not None:
+                    ev.set()
+
+    def _check_alive(self):
+        """Ingress guard: a dead stepper surfaces its error; a cleanly
+        STOPPED stepper (shutdown() is public API — benches, drain,
+        teardown) must fail fast with a typed failover signal instead of
+        admitting work nothing will ever step (the waiter would ride out
+        its whole timeout)."""
+        if self._stopped:
+            # a STOPPED replica is a deliberate lifecycle state, checked
+            # BEFORE the stepper error (shutdown's waiter sweep records
+            # one — it must not reclassify the typed failover signal as
+            # a server fault). Drained replicas defer to the admission
+            # controller so the shed is counted with its real class; a
+            # bare shutdown has no drain state and fails fast here.
+            if not self._admission.draining:
+                raise ReplicaDrainingError(
+                    "replica is shut down (stepper stopped)", retry_after_s=1.0
+                )
+            return
+        if self._stepper_error is not None:
+            raise RuntimeError(f"llm stepper died:\n{self._stepper_error}")
 
     # -- request paths --
     def generate(self, prompt_token_ids, sampling_params: dict | None = None, timeout_s: float = 300.0) -> dict:
         """Blocking generation; many concurrent calls batch in the engine."""
         from ray_tpu.llm import SamplingParams
 
-        if self._stepper_error is not None:
-            raise RuntimeError(f"llm stepper died:\n{self._stepper_error}")
+        self._check_alive()
         params = SamplingParams(**(sampling_params or {}))
+        # admission control: raises OverloadedError (429 + retry-after)
+        # past the caps, lowest request class first; ReplicaDrainingError
+        # while drain() is finishing in-flight work
+        self._admission.check(params.priority)
         rid = self._admit(list(prompt_token_ids), params)
         out = self._await_finished(rid, timeout_s)
         return {
@@ -193,6 +257,19 @@ class LLMServer:
                 ev.set()
             self._events[rid] = ev
         self._work.set()
+        if self._stopped and not ev.is_set():
+            # raced a shutdown between the ingress check and admission:
+            # nothing will ever step this request — fail fast with the
+            # failover signal instead of riding out timeout_s
+            self.engine.abort_request(rid)
+            with self._lock:
+                self._events.pop(rid, None)
+                out = self._done.pop(rid, None)
+            if out is not None:
+                return out
+            raise ReplicaDrainingError(
+                "replica shut down while admitting", retry_after_s=1.0
+            )
         if not ev.wait(timeout_s):
             self.engine.abort_request(rid)
             with self._lock:  # reap bookkeeping (completion may have raced)
@@ -235,13 +312,101 @@ class LLMServer:
         sentinel counts, and this replica's model/replica/stage tags."""
         return self.engine.telemetry()
 
+    def overload_stats(self) -> dict:
+        """Admission-control counters: admitted, shed by cause and by
+        request class, live queue-wait estimate, drain state."""
+        return self._admission.stats()
+
     def __call__(self, request):
         """HTTP entry: POST {"prompt_token_ids": [...], "sampling_params": {...}}."""
         body = request.json() if hasattr(request, "json") else dict(request)
         return self.generate(body["prompt_token_ids"], body.get("sampling_params"))
 
-    def __del__(self):
+    # -- replica lifecycle -------------------------------------------------
+    def _stop_stepper(self) -> None:
+        """Set the stop flag AND wake the idle wait, then join: exit is
+        immediate instead of riding out the 1 s idle tick. No waiter
+        sweep — drain()'s timeout path stops the stepper first and then
+        delivers the aborted finals itself."""
         self._stopped = True
+        self._work.set()
+        st = getattr(self, "_stepper", None)
+        if st is not None and st.is_alive() and st is not threading.current_thread():
+            st.join(timeout=5.0)
+
+    def shutdown(self) -> None:
+        """Stop the stepper thread promptly. Used by benches/tests,
+        drain(), and __del__. Waiters still blocked on in-flight work
+        fail fast (nothing will ever step them) instead of riding out
+        their timeouts; drain() settles in-flight work FIRST, so its
+        final shutdown finds none."""
+        self._stop_stepper()
+        with self._lock:
+            pending = bool(self._events)
+        if pending or self.engine.has_unfinished():
+            self._fail_all_waiters("replica shut down (stepper stopped) with requests in flight")
+
+    def drain(self, timeout_s: float = 30.0) -> dict:
+        """Graceful drain, the replica's half of fleet failover:
+
+        1. stop admitting — new requests shed with ReplicaDrainingError
+           (a 429 subclass: routers fail over, clients back off);
+        2. finish in-flight work, bounded by ``timeout_s`` (whatever is
+           left past the deadline is aborted with its typed reason);
+        3. release owned resources while the process is still healthy:
+           stashed handoff blocks drop, and a cluster-KV-plane replica
+           unregisters every published prefix from the index and frees
+           the owned blocks (route dies before the bytes — nobody can
+           fetch from a replica that is about to exit);
+        4. stop the stepper (shutdown()).
+
+        Serve's graceful teardown calls this through the replica's
+        shutdown hook; it is also directly callable for planned
+        rebalancing. Returns what was drained."""
+        from ray_tpu.serve.overload import wait_for_drain
+
+        self._admission.drain()
+        finished = wait_for_drain(self, timeout_s=timeout_s)
+        aborted = 0
+        if not finished:
+            # deadline passed with work still in flight: stop the stepper
+            # FIRST (joins any in-progress step — no concurrent stepping),
+            # abort what's left, then run ONE cleanup step ourselves so
+            # the aborted finals publish through the normal path and
+            # blocked waiters wake NOW instead of riding out their own
+            # timeouts (abort outputs only surface via the next step)
+            self._stop_stepper()
+            try:
+                with self.engine._lock:
+                    rids = [rid for rid, st in self.engine._requests.items() if not st.finished]
+                for rid in rids:
+                    aborted += bool(self.engine.abort_request(rid))
+                self._deliver_outputs(self.engine.step())
+            except Exception:  # noqa: BLE001 — drain is BEST-EFFORT: the
+                # likeliest reason the deadline passed is a broken engine,
+                # and the resource release below must still run; fail any
+                # still-blocked waiters exactly like the stepper-death path
+                import traceback
+
+                self._fail_all_waiters(traceback.format_exc())
+        released = self.engine.release_handoffs()
+        plane = getattr(self.engine, "_kv_plane", None)
+        unregistered = plane.shutdown() if plane is not None else 0
+        self._admission.drained()
+        self.shutdown()
+        return {
+            "drained": True,
+            "inflight_finished": finished,
+            "aborted": aborted,
+            "handoffs_released": released,
+            "kvplane_keys_unregistered": unregistered,
+        }
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
 
 class OpenAIServer(LLMServer):
@@ -285,6 +450,10 @@ class OpenAIServer(LLMServer):
             sp["seed"] = int(body["seed"])
         if body.get("stop_token_ids"):
             sp["stop_token_ids"] = tuple(body["stop_token_ids"])
+        if body.get("priority") is not None:
+            # request class for admission control (serve/overload.py):
+            # 0 = shed first; higher classes shed only at the full caps
+            sp["priority"] = int(body["priority"])
         return sp
 
     # -- HTTP entry --
@@ -322,20 +491,51 @@ class OpenAIServer(LLMServer):
 
     def _stream_completion(self, prompt_ids, body: dict, chat: bool):
         """SSE chunks, one per generated token (reference: OpenAI
-        streaming format). Serve streams these through the chunked proxy."""
-        import json as _json
+        streaming format). Serve streams these through the chunked proxy.
+
+        NOT itself a generator: the admission check and the engine
+        admission run EAGERLY here, so a shed streaming request raises
+        its typed OverloadedError at call time — before any stream
+        machinery engages — and the proxies (which fetch the first item
+        before committing the 200 header) can surface the 429."""
         import queue as _queue
-        import time as _time
 
         from ray_tpu.llm import SamplingParams
 
         params = SamplingParams(**self._sampling(body))
+        # streaming ingress guards exactly like the unary one
+        self._check_alive()
+        self._admission.check(params.priority)
         # we own the queue: a tiny request can finish (and leave the
         # engine registry) before add_request even returns, so the state
         # must never be looked up there afterwards
         out_q = _queue.SimpleQueue()
         rid = self.engine.add_request(list(prompt_ids), params, out_queue=out_q)
         self._work.set()
+        if self._stopped:
+            # raced a shutdown between the ingress check and admission
+            # (the unary path's _await_finished guard, streaming flavor).
+            # A request that COMPLETED in the race already has its tokens
+            # and sentinel in out_q — serve them (mirroring the unary
+            # path's pop-from-_done); otherwise nothing will ever step
+            # it and the shutdown sweep may have already run, so fail
+            # fast with the typed signal.
+            with self.engine._lock:
+                st = self.engine._requests.get(rid)
+                unfinished = st is not None and not st.finished
+            if unfinished:
+                self.engine.abort_request(rid)
+                raise ReplicaDrainingError(
+                    "replica shut down while admitting", retry_after_s=1.0
+                )
+        return self._stream_tokens(rid, out_q, chat)
+
+    def _stream_tokens(self, rid: str, out_q, chat: bool):
+        """The generator half of _stream_completion (admission already
+        done): drain the request's token queue into SSE chunks."""
+        import json as _json
+        import time as _time
+
         key = "delta" if chat else "text"
         obj = "chat.completion.chunk" if chat else "text_completion"
         deadline = _time.monotonic() + 300.0
@@ -391,8 +591,10 @@ class PrefillServer(LLMServer):
         (llm/disagg/handoff.py)."""
         from ray_tpu.llm.disagg import publish_handoff
 
-        if self._stepper_error is not None:
-            raise RuntimeError(f"llm stepper died:\n{self._stepper_error}")
+        self._check_alive()
+        # class-blind capacity guard (the prefill ingress doesn't know the
+        # request class; the class-aware shed ran at the decode ingress)
+        self._admission.check_capacity()
         rid = self.engine.add_prefill_request(list(prompt_token_ids))
         try:
             out = self._await_finished(rid, timeout_s)
@@ -461,10 +663,12 @@ class DecodeServer(LLMServer):
         from ray_tpu.llm import SamplingParams
         from ray_tpu.llm.disagg import fetch_handoff
 
-        if self._stepper_error is not None:
-            raise RuntimeError(f"llm stepper died:\n{self._stepper_error}")
-        kv = fetch_handoff(ref, meta)
+        self._check_alive()
         params = SamplingParams(**(sampling_params or {}))
+        # shed BEFORE borrowing the handoff: an overloaded decode replica
+        # must bounce the router to a peer without touching the block
+        self._admission.check(params.priority)
+        kv = fetch_handoff(ref, meta)
         rid = self.engine.add_prefilled(kv, params)
         self._work.set()
         out = self._await_finished(rid, timeout_s)
@@ -658,6 +862,7 @@ class KVRouterServer:
         self.router = CacheAwareRouter(
             index_handle, _submit, names, block=block,
             cache_weight=cache_weight, load_weight=load_weight, max_attempts=max_attempts,
+            telemetry_tags={"model": llm_config.model_id},
         )
 
     def generate(self, prompt_token_ids, sampling_params: dict | None = None) -> dict:

@@ -138,6 +138,104 @@ def check_telemetry() -> list[str]:
     return problems
 
 
+def check_chaos_safety() -> list[str]:
+    """Chaos-safety gate (ray_tpu/chaos.py):
+
+    1. **Inert by default** — importing the plane arms nothing, and with
+       no rule installed ``apply()`` is a passthrough returning True.
+    2. **Unreachable from non-test config** — no module under ray_tpu/
+       may call ``chaos.inject()``/``chaos.seed()`` (rules only come
+       from tests; the rpc_chaos adapter and the plane itself are the
+       two exemptions).
+    3. **Enumerable surface** — every ``chaos.apply`` call site passes a
+       LITERAL site name registered in ``chaos.SITES``, and every SITES
+       entry has at least one call site: the documented injection
+       surface can never drift from the code in either direction.
+
+    Import-time + AST only (no jax, no cluster); returns problems."""
+    import ast
+    import importlib.util
+
+    problems: list[str] = []
+    cpath = os.path.join(ROOT, "ray_tpu", "chaos.py")
+    try:
+        # reuse an already-imported plane (in-process tier-1 caller);
+        # otherwise load by PATH — jax-free, like the telemetry gate —
+        # registering in sys.modules first (3.10 dataclasses resolves
+        # annotations through sys.modules[cls.__module__])
+        chaos = sys.modules.get("ray_tpu.chaos")
+        if chaos is None:
+            spec = importlib.util.spec_from_file_location("_rt_chaos_gate", cpath)
+            chaos = importlib.util.module_from_spec(spec)
+            sys.modules["_rt_chaos_gate"] = chaos
+            try:
+                spec.loader.exec_module(chaos)
+            finally:
+                sys.modules.pop("_rt_chaos_gate", None)
+    except Exception as e:  # noqa: BLE001
+        return [f"chaos: plane module failed to import: {type(e).__name__}: {e}"]
+    if chaos.active():
+        problems.append("chaos: plane is armed at import time (must be inert by default)")
+    for site in sorted(chaos.SITES):
+        try:
+            if chaos.apply(site) is not True:
+                problems.append(f"chaos: apply({site!r}) with no rules is not a passthrough")
+        except Exception as e:  # noqa: BLE001
+            problems.append(f"chaos: apply({site!r}) with no rules raised {type(e).__name__}")
+
+    # the adapter owns its own dynamic "rpc.<msg_type>" namespace; the
+    # plane module defines the API — both are exempt from the scans
+    exempt = {os.path.join("ray_tpu", "chaos.py"), os.path.join("ray_tpu", "core", "rpc_chaos.py")}
+    sites_found: set[str] = set()
+    for dirpath, _, files in os.walk(os.path.join(ROOT, "ray_tpu")):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, ROOT)
+            if rel in exempt:
+                continue
+            try:
+                tree = ast.parse(open(full, encoding="utf-8").read())
+            except SyntaxError as e:
+                problems.append(f"chaos: {rel} failed to parse: {e}")
+                continue
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "chaos"
+                ):
+                    continue
+                meth = node.func.attr
+                if meth in ("inject", "seed"):
+                    problems.append(
+                        f"chaos: {rel}:{node.lineno} calls chaos.{meth}() — rule installation "
+                        "must be unreachable from library code (tests only)"
+                    )
+                elif meth == "apply":
+                    arg = node.args[0] if node.args else None
+                    if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+                        problems.append(
+                            f"chaos: {rel}:{node.lineno} passes a non-literal site to chaos.apply() "
+                            "(the gate must be able to enumerate the injection surface)"
+                        )
+                    elif arg.value not in chaos.SITES:
+                        problems.append(
+                            f"chaos: {rel}:{node.lineno} uses unregistered site {arg.value!r} "
+                            "(add it to chaos.SITES or fix the name)"
+                        )
+                    else:
+                        sites_found.add(arg.value)
+    for site in sorted(chaos.SITES - sites_found):
+        problems.append(
+            f"chaos: documented site {site!r} has no injection point under ray_tpu/ "
+            "(remove it from SITES or wire the apply() call)"
+        )
+    return problems
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--base", default=None, help="git ref to diff against (default: origin/main, main, HEAD~1)")
@@ -147,10 +245,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("git_hook_args", nargs="*", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
-    # the telemetry gate is import-time cheap: run it unconditionally (a
-    # broken metric catalog or dashboard panel fails the push regardless
-    # of which file introduced it)
-    telemetry_problems = check_telemetry()
+    # the telemetry and chaos-safety gates are import-time cheap: run
+    # them unconditionally (a broken metric catalog, dashboard panel, or
+    # reachable chaos injection fails the push regardless of which file
+    # introduced it)
+    telemetry_problems = check_telemetry() + check_chaos_safety()
     for prob in telemetry_problems:
         print(f"lint_gate: {prob}", file=sys.stderr)
 

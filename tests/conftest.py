@@ -62,6 +62,23 @@ def pytest_runtest_call(item):
         signal.signal(signal.SIGALRM, old)
 
 
+@pytest.fixture(autouse=True)
+def _chaos_hygiene():
+    """Chaos determinism: every test starts with a CLEARED, freshly
+    seeded chaos plane (ray_tpu/chaos.py + the rpc_chaos transport
+    adapter share one registry/RNG), so chaos tests reproduce regardless
+    of ordering and a leaked rule can never bleed into the next test."""
+    from ray_tpu import chaos
+    from ray_tpu.core import rpc_chaos
+
+    rpc_chaos.clear()
+    chaos.clear()
+    chaos.seed(0)
+    yield
+    rpc_chaos.clear()
+    chaos.clear()
+
+
 @pytest.fixture
 def rt_start():
     """Fresh single-node runtime per test."""

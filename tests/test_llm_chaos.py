@@ -1,0 +1,644 @@
+"""Serving-plane fault injection (ray_tpu/chaos.py) + overload plane.
+
+The system-level invariants under EVERY injected fault:
+
+- every request either completes TOKEN-IDENTICAL to the fault-free
+  oracle or fails with a TYPED error (OverloadedError /
+  DisaggRequestError / KVRouteError / HandoffLostError / the stepper's
+  RuntimeError) within a bounded deadline;
+- nothing hangs — each scenario asserts its own wall-clock bound, well
+  inside the conftest watchdog;
+- no silent corruption — after the fault clears, a fresh request on
+  every surviving engine still matches the oracle (an injected loss must
+  never scatter garbage into a live KV pool).
+
+Plus the overload half of the plane: admission control sheds the lowest
+request class first with typed 429s, the estimated-queue-wait test reads
+the flight recorder's live EMAs, replica drain finishes in-flight work
+and unregisters its cluster-plane routes, and LLMServer.shutdown() exits
+the stepper promptly.
+
+Chaos rules are seeded/cleared around every test by the autouse conftest
+fixture; scenario tests carry the ``chaos`` marker.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import ray_tpu  # noqa: E402
+from ray_tpu import chaos  # noqa: E402
+from ray_tpu.chaos import ChaosError  # noqa: E402
+from ray_tpu.exceptions import ObjectLostError  # noqa: E402
+from ray_tpu.llm import LLMEngine, SamplingParams  # noqa: E402
+from ray_tpu.llm.disagg import (  # noqa: E402
+    DisaggRequestError,
+    DisaggRouter,
+    fetch_handoff,
+    publish_handoff,
+)
+from ray_tpu.llm.kvplane import CacheAwareRouter, KVPlaneClient, PrefixIndex  # noqa: E402
+from ray_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
+from ray_tpu.serve.llm import KVPlaneServer, LLMConfig, LLMServer, OpenAIServer  # noqa: E402
+from ray_tpu.serve.overload import (  # noqa: E402
+    AdmissionConfig,
+    AdmissionController,
+    OverloadedError,
+    ReplicaDrainingError,
+    RetryBudget,
+    http_error_of,
+    is_overloaded,
+)
+
+CFG = LlamaConfig.tiny(dtype="float32", remat=False, max_seq_len=128)
+SP = SamplingParams(max_tokens=6, temperature=0.0)
+RNG = np.random.default_rng(11)
+PROMPT = [int(x) for x in RNG.integers(1, CFG.vocab_size - 1, size=24)]
+SHARED = [int(x) for x in RNG.integers(1, CFG.vocab_size - 1, size=70)]  # >= one 64-block
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def rt():
+    """Real object plane (direct.put_owned / get_owned_view), exactly as
+    the disagg and kvplane suites use it."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def oracle(params):
+    """Fault-free oracle: greedy completions per prompt from one plain
+    engine (module pays its compiles once). Every chaos scenario's
+    success path must be token-identical to these."""
+    eng = LLMEngine(CFG, params, max_num_seqs=2, max_seq_len=128)
+
+    def run(prompt, sp=SP):
+        return list(eng.generate(list(prompt), sp).token_ids)
+
+    toks = {"prompt": run(PROMPT), "shared": run(SHARED)}
+    toks["run"] = run
+    return toks
+
+
+def _cfg(params, **engine_kwargs):
+    engine_kwargs.setdefault("max_num_seqs", 2)
+    engine_kwargs.setdefault("max_seq_len", 128)
+    return LLMConfig(model_config=CFG, params=params, engine_kwargs=engine_kwargs, prewarm=False)
+
+
+# ---------------------------------------------------------------- satellites
+
+
+def test_llmserver_shutdown_exits_stepper_promptly(params):
+    """shutdown() sets _stopped AND wakes the idle wait: the stepper must
+    exit immediately instead of riding out the 1 s idle tick."""
+    srv = LLMServer(_cfg(params))
+    time.sleep(0.15)  # let the stepper settle into its idle wait
+    t0 = time.perf_counter()
+    srv.shutdown()
+    dt = time.perf_counter() - t0
+    assert not srv._stepper.is_alive()
+    assert dt < 0.8, f"shutdown rode out the idle tick: {dt:.2f}s"
+    # idempotent, and __del__'s path is the same call
+    srv.shutdown()
+
+
+def test_chaos_marker_registered_and_fixture_reseeds():
+    """The autouse fixture hands every test a cleared, deterministically
+    seeded plane (same seed => same drop schedule)."""
+    assert not chaos.active()
+    r = chaos.inject("serve.step", drop_prob=0.5, max_hits=0)
+    assert chaos.active() and r.hits == 0
+    chaos.seed(123)
+    a = [chaos.apply("rpc.x") for _ in range(0)]  # rpc namespace allowed
+    del a
+    chaos.clear()
+    assert not chaos.active()
+
+
+# ---------------------------------------------------------- admission control
+
+
+def test_admission_sheds_lowest_class_first(params):
+    """Queue past the cap: class 0 sheds with a typed 429 while a higher
+    class still admits (shed-lowest-first), and the counters/stats see
+    both. The engine queue is built directly so the scenario is
+    deterministic against the stepper."""
+    srv = LLMServer(
+        LLMConfig(
+            model_config=CFG, params=params, prewarm=False,
+            engine_kwargs={"max_num_seqs": 1, "max_seq_len": 128},
+            admission=AdmissionConfig(max_queue_depth=4, class_fracs=(0.25, 1.0)),
+        )
+    )
+    try:
+        # three waiting requests without waking the stepper: depth 3
+        for _ in range(3):
+            srv.engine.add_request(list(PROMPT), SamplingParams(max_tokens=2))
+        with pytest.raises(OverloadedError) as ei:
+            srv.generate(PROMPT, {"max_tokens": 2, "priority": 0})
+        assert ei.value.status_code == 429
+        assert ei.value.retry_after_s > 0
+        assert ei.value.shed_class == 0
+        # priority 1 admits at the same depth (3 < 4 * 1.0) and completes
+        out = srv.generate(PROMPT, {"max_tokens": 2, "priority": 1}, timeout_s=120.0)
+        assert len(out["token_ids"]) == 2
+        stats = srv.overload_stats()
+        assert stats["shed_depth"] == 1 and stats["shed_by_class"] == {0: 1}
+        assert stats["admitted"] >= 1
+    finally:
+        srv.shutdown()
+
+
+def test_estimated_queue_wait_feeds_admission(params):
+    """The estimated-queue-wait test: queue_depth x live service-time EMA
+    / slots, fed by the flight recorder's lifecycle stamps. A fake EMA
+    makes the arithmetic exact; a real completed request then moves the
+    EMA off zero (the recorder really feeds it)."""
+    eng = LLMEngine(CFG, params, max_num_seqs=1, max_seq_len=128)
+    eng._tel.service_ema_s = 10.0
+    for _ in range(2):
+        eng.add_request(list(PROMPT), SamplingParams(max_tokens=2))
+    ac = AdmissionController(eng, AdmissionConfig(max_queue_depth=100, max_queue_wait_s=5.0))
+    assert ac.estimate_queue_wait_s() == pytest.approx(20.0)
+    with pytest.raises(OverloadedError) as ei:
+        ac.check(0)
+    assert ac.stats()["shed_wait"] == 1
+    assert 0 < ei.value.retry_after_s <= 30.0
+    # the ITL path covers the cold window before anything finishes:
+    # queued max_tokens (2 x 2) x live ITL EMA / slots
+    eng._tel.service_ema_s = 0.0
+    eng._tel.itl_ema_s = 0.1
+    assert ac.estimate_queue_wait_s() == pytest.approx(0.4)
+    eng._tel.itl_ema_s = 0.0
+    while eng.has_unfinished():
+        eng.step()
+    assert eng._tel.service_ema_s > 0.0  # on_finish fed the EMA
+    assert eng._tel.itl_ema_s > 0.0  # on_emit fed the EMA
+    ac.check(0)  # queue empty again: admits
+
+
+def test_admission_check_is_cheap(params):
+    """The admission test is host-only dict work — cheap enough to sit
+    on every ingress without touching the serving budget (the 1.05x
+    zero-overhead gate measures engine.step, which admission never
+    enters; this bounds the ingress side)."""
+    eng = LLMEngine(CFG, params, max_num_seqs=2, max_seq_len=128)
+    ac = AdmissionController(eng)
+    ac.check(0)  # warm binds
+    t0 = time.perf_counter()
+    for _ in range(1000):
+        ac.check(0)
+    assert time.perf_counter() - t0 < 1.0
+
+
+def test_http_429_mapping_and_priority_plumbing():
+    """OverloadedError carries 429 + retry-after through the proxy
+    mapping, directly and through a wire-wrapped cause chain; the OpenAI
+    body's "priority" reaches SamplingParams."""
+    code, body = http_error_of(OverloadedError("busy", retry_after_s=2.0))
+    assert code == 429 and body["retry_after_s"] == 2.0
+    wrapped = RuntimeError("task failed")
+    wrapped.cause = OverloadedError("busy", retry_after_s=3.0)
+    assert is_overloaded(wrapped)
+    code, body = http_error_of(wrapped)
+    # the surviving cause's REAL hint wins over the wrapper's tb fallback
+    assert code == 429 and body["retry_after_s"] == 3.0
+    tb_only = RuntimeError("remote")
+    tb_only.tb_str = "... ray_tpu.serve.overload.OverloadedError: busy ..."
+    assert is_overloaded(tb_only) and http_error_of(tb_only)[0] == 429
+    drain_tb = RuntimeError("remote")
+    drain_tb.tb_str = "... ray_tpu.serve.overload.ReplicaDrainingError: draining ..."
+    assert is_overloaded(drain_tb) and http_error_of(drain_tb)[0] == 429
+    assert http_error_of(RuntimeError("plain")) is None
+    assert not is_overloaded(RuntimeError("plain"))
+    sp = OpenAIServer._sampling(None, {"max_tokens": 4, "priority": 2})
+    assert sp["priority"] == 2
+    assert SamplingParams(**sp).priority == 2
+    with pytest.raises(ValueError):
+        SamplingParams(priority=-1)
+    assert issubclass(ReplicaDrainingError, OverloadedError)
+
+
+# -------------------------------------------------------------- retry budget
+
+
+class _Ref:
+    class id:  # noqa: N801 — mimics ObjectRef.id
+        @staticmethod
+        def binary():
+            return b"ref"
+
+        @staticmethod
+        def hex():
+            return "ref"
+
+
+def test_retry_budget_is_shared_across_attempt_kinds():
+    """ONE budget covers prefill retries, handoff-lost re-prefills and
+    decode failovers; the handoff is reused across decode deaths (no
+    re-prefill) and exhaustion is a typed terminal error + counter."""
+    calls = {"prefill": 0, "decode": 0}
+
+    def prefill(prompt):
+        calls["prefill"] += 1
+        return {"nbytes": 0}, _Ref()
+
+    def decode(meta, ref, prompt, sp):
+        calls["decode"] += 1
+        raise RuntimeError("decode lane dead")
+
+    router = DisaggRouter(prefill, decode, max_attempts=3)
+    with pytest.raises(DisaggRequestError):
+        router.generate([1, 2, 3])
+    assert calls == {"prefill": 1, "decode": 3}  # block reused, 3 attempts total
+    st = router.stats()
+    assert st["budget_exhausted"] == 1 and st["failed"] == 1 and st["decode_retries"] == 3
+    b = RetryBudget(2)
+    assert b.try_spend() and b.try_spend() and not b.try_spend()
+    assert b.remaining == 0
+
+
+def test_routers_surface_overload_as_429():
+    """A fleet whose every lane sheds is saturated, not broken: both
+    routers re-raise OverloadedError (429 + the replica's backoff hint)
+    instead of their terminal error class."""
+
+    def prefill(prompt):
+        return {"nbytes": 0}, _Ref()
+
+    def decode(meta, ref, prompt, sp):
+        # a TaskError-shaped wrapper: the hint lives on the CAUSE, the
+        # router must dig it out (not read the wrapper's default)
+        w = RuntimeError("TaskError wrapper")
+        w.cause = OverloadedError("replica busy", retry_after_s=3.0, shed_class=1)
+        raise w
+
+    router = DisaggRouter(prefill, decode, max_attempts=2)
+    with pytest.raises(OverloadedError) as ei:
+        router.generate([1, 2, 3], {"priority": 1})
+    assert ei.value.retry_after_s == 3.0 and ei.value.shed_class == 1
+    assert router.stats()["shed"] == 1
+
+    def submit(rid, prompt, sp):
+        raise OverloadedError("replica draining", retry_after_s=1.5)
+
+    kvr = CacheAwareRouter(PrefixIndex(), submit, ["r0", "r1"], max_attempts=2)
+    with pytest.raises(OverloadedError) as ei:
+        kvr.generate([1, 2, 3])
+    assert ei.value.retry_after_s == 1.5
+    st = kvr.stats()
+    assert st["shed"] == 1 and st["budget_exhausted"] == 1
+
+    # a fleet SMALLER than the budget: the ranked list running out is a
+    # failure, not a budget exhaustion (the counter must not over-report)
+    kvr2 = CacheAwareRouter(PrefixIndex(), submit, ["r0"], max_attempts=3)
+    with pytest.raises(OverloadedError):
+        kvr2.generate([1, 2, 3])
+    assert kvr2.stats()["budget_exhausted"] == 0
+
+
+# ---------------------------------------------------------------- drain
+
+
+def test_drain_finishes_inflight_unregisters_and_sheds(params, rt):
+    """drain(): in-flight completes token-identical, the cluster index
+    forgets the replica (route dies before the bytes), stashed handoffs
+    drop, new requests shed with ReplicaDrainingError, stepper exits."""
+    idx = PrefixIndex(ttl_s=30.0)
+    plane = KVPlaneClient(idx, "drainA", publish_min_hits=1)
+    srv = KVPlaneServer(
+        LLMConfig(
+            model_config=CFG, params=params, prewarm=False,
+            engine_kwargs={"max_num_seqs": 2, "max_seq_len": 128, "kv_plane": plane},
+        ),
+        idx, "drainA",
+    )
+    oracle_eng = LLMEngine(CFG, params, max_num_seqs=2, max_seq_len=128)
+    want = list(oracle_eng.generate(list(SHARED), SP).token_ids)
+
+    results = {}
+
+    def bg():
+        results["out"] = srv.generate(list(SHARED), {"max_tokens": SP.max_tokens}, timeout_s=120.0)
+
+    th = threading.Thread(target=bg)
+    th.start()
+    # wait until the request is actually in flight before draining
+    deadline = time.time() + 30
+    while not srv.engine.has_unfinished() and time.time() < deadline:
+        time.sleep(0.005)
+    t0 = time.perf_counter()
+    res = srv.drain(timeout_s=60.0)
+    th.join(timeout=60)
+    assert not th.is_alive()
+    assert time.perf_counter() - t0 < 60
+    assert res["drained"] and res["inflight_finished"] and res["aborted"] == 0
+    assert results["out"]["token_ids"] == want  # finished, token-identical
+    assert res["kvplane_keys_unregistered"] >= 1  # SHARED minted a 64-block
+    assert idx.stats()["keys"] == 0  # route died before the bytes
+    with pytest.raises(ReplicaDrainingError):
+        srv.generate(PROMPT, {"max_tokens": 2})
+    assert not srv._stepper.is_alive()
+    assert srv.overload_stats()["draining"] and srv.overload_stats()["shed_draining"] == 1
+
+
+def test_shutdown_with_inflight_fails_waiters_fast(params):
+    """A bare shutdown() (no drain) with work in flight must fail the
+    blocked waiters immediately — nothing will ever step them — and
+    subsequent requests fail fast with the typed failover signal."""
+    srv = LLMServer(_cfg(params))
+    chaos.inject("serve.step", delay_s=0.2)  # keep the request in flight
+    results = {}
+
+    def bg():
+        try:
+            srv.generate(list(PROMPT), {"max_tokens": 64}, timeout_s=120.0)
+        except Exception as e:  # noqa: BLE001
+            results["err"] = e
+
+    th = threading.Thread(target=bg)
+    th.start()
+    deadline = time.time() + 30
+    while not srv.engine.has_unfinished() and time.time() < deadline:
+        time.sleep(0.005)
+    t0 = time.perf_counter()
+    srv.shutdown()
+    th.join(timeout=10.0)
+    chaos.clear()
+    assert not th.is_alive(), "waiter did not fail fast on shutdown"
+    assert time.perf_counter() - t0 < 10.0
+    assert isinstance(results.get("err"), RuntimeError)
+    with pytest.raises((ReplicaDrainingError, RuntimeError)):
+        srv.generate(PROMPT, {"max_tokens": 2}, timeout_s=5.0)
+
+
+def test_drain_deadline_aborts_and_wakes_waiters(params):
+    """A drain whose deadline passes with work in flight must abort the
+    leftovers AND deliver their finals — the blocked waiter wakes with
+    finish_reason 'aborted' immediately, never riding out its own
+    timeout (abort outputs only publish via a step; drain runs one)."""
+    srv = LLMServer(_cfg(params))
+    # stall the stepper so the request cannot finish inside the deadline
+    chaos.inject("serve.step", delay_s=0.2)
+    results = {}
+
+    def bg():
+        results["out"] = srv.generate(list(PROMPT), {"max_tokens": 64}, timeout_s=120.0)
+
+    th = threading.Thread(target=bg)
+    th.start()
+    deadline = time.time() + 30
+    while not srv.engine.has_unfinished() and time.time() < deadline:
+        time.sleep(0.005)
+    t0 = time.perf_counter()
+    res = srv.drain(timeout_s=0.3)
+    th.join(timeout=10.0)
+    chaos.clear()
+    assert not th.is_alive(), "waiter did not wake after the drain abort"
+    assert time.perf_counter() - t0 < 10.0
+    assert not res["inflight_finished"] and res["aborted"] == 1
+    assert results["out"]["finish_reason"] == "aborted"
+    assert not srv._stepper.is_alive()
+
+
+# ------------------------------------------------------------ chaos scenarios
+
+
+def _disagg_pair(params):
+    """Prefill + decode engines over the real object plane (the disagg
+    suite's wiring, condensed)."""
+    pre = LLMEngine(CFG, params, max_num_seqs=2, max_seq_len=128, enable_prefix_caching=False)
+    dec = LLMEngine(CFG, params, max_num_seqs=2, max_seq_len=128, enable_prefix_caching=False)
+
+    def prefill(prompt):
+        return publish_handoff(pre.prefill_handoff(prompt))
+
+    def decode(meta, ref, prompt, sp):
+        kv = fetch_handoff(ref, meta, timeout_s=2.0, retries=1, retry_wait_s=0.02)
+        rid = dec.add_prefilled(kv, SamplingParams(**sp))
+        while dec.has_unfinished():
+            for o in dec.step():
+                if o.request_id == rid and o.finished:
+                    return {"request_id": rid, "token_ids": o.token_ids, "finish_reason": o.finish_reason}
+        raise RuntimeError("decode drained without finishing")
+
+    return pre, dec, prefill, decode
+
+
+@pytest.mark.chaos
+def test_chaos_lost_and_delayed_handoff_fetch(params, rt, oracle):
+    """Dropped handoff fetch: the first decode's bounded retries exhaust
+    into HandoffLostError, the router re-prefills, the request completes
+    token-identical. A delay-only rule completes without any retry. The
+    surviving decode pool stays clean."""
+    pre, dec, prefill, decode = _disagg_pair(params)
+    router = DisaggRouter(prefill, decode, max_attempts=3)
+
+    # decode's fetch budget is retries=1 => 2 attempts; lose both
+    chaos.inject("handoff.fetch", raises=ObjectLostError, max_hits=2)
+    t0 = time.perf_counter()
+    out = router.generate(list(PROMPT), {"max_tokens": SP.max_tokens, "temperature": 0.0})
+    wall = time.perf_counter() - t0
+    assert out["token_ids"] == oracle["prompt"]
+    assert wall < 60.0
+    assert router.stats()["handoffs_lost"] == 1
+    chaos.clear()
+
+    chaos.inject("handoff.fetch", delay_s=0.05)
+    out = router.generate(list(PROMPT), {"max_tokens": SP.max_tokens, "temperature": 0.0})
+    assert out["token_ids"] == oracle["prompt"]
+    assert router.stats()["handoffs_lost"] == 1  # delay is not loss
+    chaos.clear()
+
+    # no silent corruption: a clean request on the surviving pair
+    out = router.generate(list(PROMPT), {"max_tokens": SP.max_tokens, "temperature": 0.0})
+    assert out["token_ids"] == oracle["prompt"]
+
+
+@pytest.mark.chaos
+def test_chaos_owned_object_loss_bounded_typed_failure(params, rt, oracle):
+    """Permanent owned-object loss at the direct plane: every fetch
+    fails, the shared budget exhausts, and the TYPED terminal error
+    surfaces in bounded time — no hang, and the decode pool was never
+    touched (fresh request matches the oracle after the fault clears).
+    A bounded put_owned fault retries through the same budget."""
+    pre, dec, prefill, decode = _disagg_pair(params)
+    router = DisaggRouter(prefill, decode, max_attempts=2)
+
+    chaos.inject("direct.get_owned_view", raises=ObjectLostError)
+    t0 = time.perf_counter()
+    with pytest.raises(DisaggRequestError):
+        router.generate(list(PROMPT), {"max_tokens": 4, "temperature": 0.0})
+    assert time.perf_counter() - t0 < 30.0
+    st = router.stats()
+    assert st["budget_exhausted"] == 1 and st["handoffs_lost"] == 2
+    chaos.clear()
+
+    # one-shot publish fault: attempt 1 loses the prefill, attempt 2 lands
+    chaos.inject("direct.put_owned", raises=RuntimeError, max_hits=1)
+    out = router.generate(list(PROMPT), {"max_tokens": SP.max_tokens, "temperature": 0.0})
+    assert out["token_ids"] == oracle["prompt"]
+    chaos.clear()
+
+    # no silent corruption on either engine
+    out = router.generate(list(PROMPT), {"max_tokens": SP.max_tokens, "temperature": 0.0})
+    assert out["token_ids"] == oracle["prompt"]
+
+
+@pytest.mark.chaos
+def test_chaos_replica_kill_mid_decode_fails_over(params, rt, oracle):
+    """A raises rule on serve.step kills replica r0's stepper mid-decode
+    — exactly a replica crash: the waiter gets the stepper-death error,
+    check_health trips, and the router fails over to r1, which completes
+    token-identical. Bounded wall, no hang."""
+    srv0 = LLMServer(_cfg(params))
+    srv1 = LLMServer(_cfg(params))
+    try:
+        handles = {"r0": srv0, "r1": srv1}
+
+        def submit(rid, prompt, sp):
+            return handles[rid].generate(prompt, sp, timeout_s=120.0)
+
+        router = CacheAwareRouter(PrefixIndex(), submit, ["r0", "r1"], max_attempts=2)
+        # two clean decode ticks, then the killer lands mid-request. Only
+        # r0 steps (r1 is idle and the idle wait never reaches the site).
+        chaos.inject("serve.step", raises=ChaosError, after=2, max_hits=1)
+        t0 = time.perf_counter()
+        out = router.generate(list(PROMPT), {"max_tokens": SP.max_tokens, "temperature": 0.0})
+        wall = time.perf_counter() - t0
+        assert out["token_ids"] == oracle["prompt"]
+        assert wall < 60.0
+        assert router.stats()["retries"] == 1
+        assert srv0._stepper_error is not None and "ChaosError" in srv0._stepper_error
+        with pytest.raises(RuntimeError):
+            srv0.check_health()
+        srv1.check_health()
+        chaos.clear()
+        # survivor's pool is clean
+        out = srv1.generate(list(PROMPT), {"max_tokens": SP.max_tokens}, timeout_s=120.0)
+        assert out["token_ids"] == oracle["prompt"]
+    finally:
+        srv0.shutdown()
+        srv1.shutdown()
+
+
+@pytest.mark.chaos
+def test_chaos_replica_stall_degrades_queue_wait_not_correctness(params, rt, oracle):
+    """A delay rule on serve.step stalls the replica's ticks: requests
+    still complete token-identical (slow, never wrong, never hung)."""
+    srv = LLMServer(_cfg(params))
+    try:
+        chaos.inject("serve.step", delay_s=0.05, max_hits=20)
+        t0 = time.perf_counter()
+        out = srv.generate(list(PROMPT), {"max_tokens": SP.max_tokens}, timeout_s=120.0)
+        assert out["token_ids"] == oracle["prompt"]
+        assert time.perf_counter() - t0 < 60.0
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.chaos
+def test_chaos_index_death_breaker_and_recovery_over_serve_classes(params, rt, oracle):
+    """The kvplane circuit breaker driven through INJECTED index faults
+    over the real serve classes (KVIndexServer + KVPlaneServer), not
+    hand-mocked transports:
+
+    - injected index death -> every plane RPC fails -> after 2
+      consecutive failures the breaker opens;
+    - while open, admissions short-circuit (zero new index RPCs) and
+      serving degrades to LOCAL prefill — outputs token-identical;
+    - fault cleared + cooldown elapsed -> the heartbeat probe closes the
+      breaker, and the replica re-registers so a peer replica gets a
+      REMOTE-tier hit again (full recovery, token-identical)."""
+    from ray_tpu.serve.llm import KVIndexServer
+
+    isrv = KVIndexServer(ttl_s=60.0)
+    plane = KVPlaneClient(
+        isrv, "cb0", publish_min_hits=1,
+        index_down_cooldown_s=0.3, heartbeat_every_s=1e6,  # probes only when told
+    )
+    srv = KVPlaneServer(
+        LLMConfig(
+            model_config=CFG, params=params, prewarm=False,
+            engine_kwargs={"max_num_seqs": 2, "max_seq_len": 128, "kv_plane": plane},
+        ),
+        isrv, "cb0",
+    )
+    srv2 = None
+    try:
+        # healthy: publish SHARED through the real serve class
+        out = srv.generate(list(SHARED), {"max_tokens": SP.max_tokens}, timeout_s=120.0)
+        assert out["token_ids"] == oracle["shared"]
+        assert isrv.stats()["keys"] >= 1
+        # consume the one unthrottled heartbeat (fresh client's stamp is
+        # 0) so the idle stepper can't probe mid-scenario
+        plane.maybe_heartbeat()
+
+        rule = chaos.inject("kvplane.index", raises=ConnectionError)
+        fresh = [int(x) for x in RNG.integers(1, CFG.vocab_size - 1, size=70)]
+        t0 = time.perf_counter()
+        out = srv.generate(list(fresh), {"max_tokens": SP.max_tokens}, timeout_s=120.0)
+        assert out["token_ids"] == oracle["run"](fresh)  # degraded to local prefill
+        assert time.perf_counter() - t0 < 60.0
+        # miss -> lookup fail (1), store -> publish register fail (2): open
+        assert plane.index_down()
+        hits_at_open = rule.hits
+        fresh2 = [int(x) for x in RNG.integers(1, CFG.vocab_size - 1, size=70)]
+        out = srv.generate(list(fresh2), {"max_tokens": SP.max_tokens}, timeout_s=120.0)
+        assert out["token_ids"] == oracle["run"](fresh2)
+        assert rule.hits == hits_at_open, "open breaker must short-circuit, not re-RPC"
+
+        chaos.clear()
+        time.sleep(0.35)  # cooldown lapses; breaker half-open
+        plane._last_heartbeat = 0.0
+        plane.maybe_heartbeat()  # probe succeeds -> closed + re-registration
+        assert not plane.index_down()
+        # re-offer self-heal: a local hit republishes what the open
+        # breaker kept cluster-invisible
+        out = srv.generate(list(SHARED), {"max_tokens": SP.max_tokens}, timeout_s=120.0)
+        assert out["token_ids"] == oracle["shared"]
+        assert isrv.stats()["keys"] >= 1
+
+        # full recovery: a PEER replica now gets a remote-tier hit
+        srv2 = KVPlaneServer(
+            LLMConfig(
+                model_config=CFG, params=params, prewarm=False,
+                engine_kwargs={"max_num_seqs": 2, "max_seq_len": 128},
+            ),
+            isrv, "cb1", publish_min_hits=1,
+        )
+        out = srv2.generate(list(SHARED), {"max_tokens": SP.max_tokens}, timeout_s=120.0)
+        assert out["token_ids"] == oracle["shared"]
+        stats = srv2.kvplane_stats()
+        assert stats["remote"]["hits"] == 1, f"expected a remote-tier hit, got {stats}"
+    finally:
+        srv.shutdown()
+        if srv2 is not None:
+            srv2.shutdown()
+
+
+@pytest.mark.chaos
+def test_chaos_index_delay_bounded_by_engine_paths(params, rt, oracle):
+    """A slow (not dead) index: delay rules on the index RPCs must only
+    slow admissions, never change output or hang the engine."""
+    idx = PrefixIndex(ttl_s=60.0)
+    plane = KVPlaneClient(idx, "slow0", publish_min_hits=1, heartbeat_every_s=1e6)
+    eng = LLMEngine(CFG, params, max_num_seqs=2, max_seq_len=128, kv_plane=plane)
+    chaos.inject("kvplane.index", delay_s=0.05, max_hits=10)
+    t0 = time.perf_counter()
+    out = eng.generate(list(SHARED), SP)
+    assert list(out.token_ids) == oracle["shared"]
+    assert time.perf_counter() - t0 < 60.0
+    assert not plane.index_down()  # slow is not dead: breaker stays closed
