@@ -1331,6 +1331,139 @@ def bench_overload(cfg, max_num_seqs: int = 4, stream_gen: int = 96, n_phases: i
     return rec
 
 
+def bench_migrate(cfg, prompt_len: int, gen_lens=(16, 48, 128), max_num_seqs: int = 4) -> dict:
+    """Live migration vs abort-and-re-prefill A/B (llm/migrate.py):
+    time-to-NEXT-token after a replica death, at several generated-
+    prefix lengths G.
+
+    - MIGRATE arm: a request decodes G tokens on engine A; A is
+      "preempted" — checkpoint_request extracts + publishes the live
+      state over the real object plane (put_owned), engine B fetches,
+      restores and decodes. TTNT = death -> token G+1 on B; recomputed
+      tokens = 0 (the splice-dedup contract).
+    - ABORT arm (the pre-migration failover): the router re-prefills the
+      ORIGINAL prompt on B from scratch. TTNT = death -> token G+1,
+      which costs a full prompt prefill plus G recomputed decode steps.
+
+    Migrate's cost is ~constant in G (one extract + transfer + scatter +
+    one step); abort's grows linearly — the crossover is where live
+    migration starts paying for itself, and the per-G rows show it."""
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu.llm import migrate as mig
+    from ray_tpu.llm.engine import LLMEngine
+    from ray_tpu.llm.sampling import SamplingParams
+
+    rng = np.random.default_rng(0)
+    prompt = [int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=prompt_len)]
+    gen_lens = [g for g in gen_lens if prompt_len + g + 9 <= cfg.max_seq_len] or [8]
+
+    def _engine():
+        return LLMEngine(
+            cfg, max_num_seqs=max_num_seqs, max_seq_len=cfg.max_seq_len,
+            enable_prefix_caching=False,
+        )
+
+    def _run_until(eng, rid, n_tokens):
+        while True:
+            with eng._lock:
+                st = eng._requests.get(rid)
+                if st is None or st.finished or len(st.token_ids) >= n_tokens:
+                    return
+            eng.step()
+
+    def _drain_request(eng, rid):
+        while True:
+            for o in eng.step():
+                if o.request_id == rid and o.finished:
+                    return o
+
+    rt.init(num_cpus=2)
+    try:
+        src, dst = _engine(), _engine()
+        warm_sp = SamplingParams(temperature=0.0, max_tokens=3)
+        # compile every bucket + the restore scatter OUTSIDE the timed
+        # region: the A/B measures the steady-state failover, not XLA
+        src.generate(prompt, warm_sp)
+        dst.generate(prompt, warm_sp)
+        for g in gen_lens:
+            wid = src.add_request(prompt, SamplingParams(temperature=0.0, max_tokens=g + 2))
+            _run_until(src, wid, g)
+            wmeta, wref = mig.publish(src.checkpoint_request(wid))
+            src.abort_request(wid)
+            rid = dst.restore_request(mig.fetch(wref, wmeta))
+            # one token PAST the checkpoint: the restore must actually
+            # step (scatter-in + splice step compile), not just admit —
+            # the settle already put g+1 tokens in the checkpoint
+            _run_until(dst, rid, g + 2)
+            dst.abort_request(rid)
+            while src.has_unfinished():
+                src.step()
+            while dst.has_unfinished():
+                dst.step()
+
+        rows = []
+        for g in gen_lens:
+            sp = SamplingParams(temperature=0.0, max_tokens=g + 8)
+            # --- migrate arm ---
+            rid = src.add_request(prompt, sp)
+            _run_until(src, rid, g)
+            t0 = time.perf_counter()
+            state = src.checkpoint_request(rid)
+            meta, ref = mig.publish(state)
+            pub_ms = (time.perf_counter() - t0) * 1e3
+            fetched = mig.fetch(ref, meta)
+            rid2 = dst.restore_request(fetched)
+            _run_until(dst, rid2, len(state["emitted_token_ids"]) + 1)
+            ttnt_mig = time.perf_counter() - t0
+            src.finish_migrated(rid)
+            dst.abort_request(rid2)
+            while dst.has_unfinished():
+                dst.step()
+            while src.has_unfinished():
+                src.step()
+            # --- abort-and-re-prefill arm ---
+            t0 = time.perf_counter()
+            rid3 = dst.add_request(prompt, sp)
+            _run_until(dst, rid3, g + 1)  # re-reach the NEXT token from scratch
+            ttnt_abort = time.perf_counter() - t0
+            dst.abort_request(rid3)
+            while dst.has_unfinished():
+                dst.step()
+            rows.append({
+                "gen_prefix": g,
+                "migrate_ttnt_ms": round(ttnt_mig * 1e3, 2),
+                "abort_ttnt_ms": round(ttnt_abort * 1e3, 2),
+                "speedup": round(ttnt_abort / ttnt_mig, 2) if ttnt_mig else None,
+                "checkpoint_publish_ms": round(pub_ms, 2),
+                "migrated_bytes": int(meta["nbytes"]),
+                "recomputed_tokens_migrate": 0,
+                "recomputed_tokens_abort": g,
+            })
+            print(
+                f"  G={g}: migrate TTNT {rows[-1]['migrate_ttnt_ms']} ms "
+                f"({rows[-1]['migrated_bytes'] >> 10} KiB) vs abort {rows[-1]['abort_ttnt_ms']} ms "
+                f"({rows[-1]['speedup']}x, {g} tokens recomputed)",
+                flush=True,
+            )
+    finally:
+        rt.shutdown()
+    return {
+        "metric": "engine_migrate_ab",
+        **_device_info(),
+        "kv_dtype": str(src.kv_dtype),
+        "tp": 1,
+        "tp_collective": "fp",
+        "workload": (
+            f"prompt {prompt_len}, replica death after G generated tokens; TTNT = death -> "
+            f"token G+1 on the peer (migrate: checkpoint+publish+fetch+restore+1 step over the "
+            f"real object plane; abort: full re-prefill + G recomputed decode steps)"
+        ),
+        "rows": rows,
+    }
+
+
 def bench_full_stack(cfg, prompt_len: int, gen_len: int, concurrency: int, tiny: bool) -> dict:
     """proxy -> router -> replica -> engine with N concurrent callers."""
     import numpy as np
@@ -1470,6 +1603,7 @@ def main(argv=None):
     benches.append(("engine_disagg_ab", lambda: bench_disagg(cfg, prompt_len, gen_len)))
     benches.append(("engine_kvplane_ab", lambda: bench_kvplane(cfg, prompt_len, gen_len)))
     benches.append(("engine_overload_ab", lambda: bench_overload(cfg)))
+    benches.append(("engine_migrate_ab", lambda: bench_migrate(cfg, prompt_len)))
     benches.append(("full_stack", lambda: bench_full_stack(cfg, prompt_len, gen_len, args.concurrency, args.tiny or args.small)))
     for name, fn in benches:
         if args.only and args.only not in name:

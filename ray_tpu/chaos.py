@@ -10,13 +10,22 @@ serving fleet's failure semantics depend on:
 site                injection point
 ==================  =====================================================
 direct.put_owned    owner-local publish on the direct object plane
-direct.get_owned_view  borrow-get of an owned object (handoff/prefix fetch)
+direct.get_owned_view  borrow-get of an owned object (handoff/prefix/
+                    live-state fetch)
 handoff.put         disagg/kvplane handoff publish (codec -> owned object)
 handoff.fetch       bounded-retry handoff fetch (each ATTEMPT is a hit)
 kvplane.index       every cluster prefix-index RPC (filter with methods=)
 serve.step          the serve replica's stepper tick (stall = delay rule,
                     kill = raises rule: the stepper dies exactly like a
                     replica crash — waiters fail, health check trips)
+serve.preempt       preemption notice, SIGTERM-with-deadline-shaped: a
+                    DROP rule delivers the notice (the replica starts
+                    drain(mode="migrate") — live migration of in-flight
+                    decode state, llm/migrate.py); a delay rule models
+                    notice latency; a raises rule kills the stepper like
+                    SIGKILL (no grace). Only actively-stepping replicas
+                    reach the site (an idle replica has nothing to
+                    evacuate).
 ==================  =====================================================
 
 Rules (``inject``) can DELAY (sleep inline), DROP (``apply`` returns
@@ -67,6 +76,7 @@ SITES = frozenset({
     "handoff.fetch",
     "kvplane.index",
     "serve.step",
+    "serve.preempt",
 })
 
 _RPC_PREFIX = "rpc."

@@ -128,6 +128,16 @@ def grafana_dashboard_json(client=None, *, datasource: str = "Prometheus", title
         ("rt_llm_drain_state", "drain state"),
         ("rate(rt_llm_retry_budget_exhausted_total[5m])", "retry budget exhausted/s"),
     ], w=12, x=12)
+    add("Serving: preemption & migration", [
+        # the evacuation dashboard (llm/migrate.py): checkpoint/restore
+        # rates by outcome (source and peer replicas count their own
+        # halves; routers count resumed/lost once per client request —
+        # stage separates them), splice latency p99, and the checkpoint
+        # bytes crossing the object plane
+        ("sum by (outcome, stage) (rate(rt_llm_migrations_total[5m]))", "migrations/s {{stage}} {{outcome}}"),
+        ("histogram_quantile(0.99, sum by (le) (rate(rt_llm_migration_splice_s_bucket[5m])))", "splice p99 (s)"),
+        ("rate(rt_llm_migration_bytes_total[1m])", "checkpoint B/s"),
+    ], w=12, x=0)
 
     # -- one panel per registered metric (user Counters/Gauges/Histograms) --
     try:

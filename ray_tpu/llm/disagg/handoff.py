@@ -68,6 +68,15 @@ PREFIX_KIND = "kv_prefix"  # cluster KV plane (llm/kvplane/): a published
 # prefix block — same wire validation, no first-token logits (the
 # consumer re-attends the prompt's remaining suffix itself)
 
+LIVE_KIND = "live_state"  # live request migration (llm/migrate.py): a
+# mid-decode checkpoint's KV half — the wire prompt_token_ids are the
+# COVERED tokens (prompt + emitted[:-1], exactly the n attended
+# positions), and the next token comes from the peer's first decode
+# step, so like a prefix block it carries no logits
+
+# kinds whose wire carries no first-token logits
+_NO_LOGITS_KINDS = (PREFIX_KIND, LIVE_KIND)
+
 
 def encode(kv: dict, *, kind: str = "kv_handoff") -> dict:
     """Engine handoff payload -> self-describing wire dict.
@@ -96,7 +105,7 @@ def encode(kv: dict, *, kind: str = "kv_handoff") -> dict:
         "k": k,
         "v": v,
     }
-    if kind != PREFIX_KIND:
+    if kind not in _NO_LOGITS_KINDS:
         wire["logits"] = np.asarray(kv["logits"], np.float32)
     # telemetry plumbing (llm/telemetry.py): the producer's trace context
     # and original submit stamp ride the wire so the decode replica's
@@ -147,7 +156,7 @@ def decode(payload: dict, *, kind: str = "kv_handoff") -> dict:
     if not 0 < n <= shape[1] or n != len(prompt):
         raise HandoffError(f"length {n} inconsistent with block width {shape[1]} / prompt {len(prompt)}")
     out = {"k": k, "v": v, "n": n, "prompt_token_ids": list(prompt)}
-    if kind != PREFIX_KIND:
+    if kind not in _NO_LOGITS_KINDS:
         out["logits"] = payload["logits"]
     if isinstance(payload.get("trace"), dict) and payload["trace"].get("trace_id"):
         out["trace"] = dict(payload["trace"])

@@ -24,6 +24,14 @@ Failure policy — bounded, never hanging:
 - handoff evicted/freed before scatter-in: the decode side's bounded
   fetch raises HandoffLostError; the router re-prefills (a fresh block)
   up to the same attempt budget, then fails the request client-visibly.
+- decode replica PREEMPTED mid-request (llm/migrate.py): the replica's
+  drain(mode="migrate") hands the waiter a RequestMigratedError carrying
+  the published checkpoint's (meta, ref) — the router RESUMES the
+  request on another lane via the injected ``resume`` callable, zero
+  recomputed tokens, beating re-prefill (which pays prompt + generated
+  prefix). A lost checkpoint degrades to re-prefill; the whole ladder
+  spends the one shared RetryBudget: migrate -> re-prefill -> typed
+  error.
 """
 
 from __future__ import annotations
@@ -59,11 +67,17 @@ class DisaggRouter:
     are injected (under Serve: deployment-handle calls; in tests: engine
     closures), so the policy is testable without a cluster."""
 
-    def __init__(self, prefill, decode, *, max_attempts: int = 3, telemetry_tags: dict | None = None):
+    def __init__(self, prefill, decode, *, resume=None, max_attempts: int = 3,
+                 telemetry_tags: dict | None = None):
         from ray_tpu.llm.telemetry import RouterTelemetry
 
         self._prefill = prefill
         self._decode = decode
+        # resume(meta, ref, sampling_params) -> dict: splice a preempted
+        # replica's published live_state checkpoint on a peer (under
+        # Serve: the decode handle's resume_from_migration). None = the
+        # resume leg is off and migrations degrade to re-prefill.
+        self._resume = resume
         self.max_attempts = max(1, int(max_attempts))
         self._lock = threading.Lock()
         self._inflight: dict[str, object] = {}  # request key -> handoff ref
@@ -71,6 +85,7 @@ class DisaggRouter:
             "requests": 0, "prefills": 0, "decode_retries": 0,
             "handoffs_lost": 0, "failed": 0, "handoff_bytes": 0,
             "budget_exhausted": 0, "shed": 0,
+            "migrations": 0, "resumed": 0,
         }
         self._seq = 0
         # control-plane events also flow into the live serving metrics
@@ -94,6 +109,7 @@ class DisaggRouter:
         Exhaustion surfaces a typed terminal error: OverloadedError when
         the last failure was a shedding/draining replica (the 429
         propagates so clients back off), DisaggRequestError otherwise."""
+        from ray_tpu.llm.migrate import migration_lost, migration_of
         from ray_tpu.serve.overload import RetryBudget, router_terminal
 
         with self._lock:
@@ -103,9 +119,30 @@ class DisaggRouter:
         priority = int((sampling_params or {}).get("priority", 0))
         budget = RetryBudget(self.max_attempts, self._tel)
         meta = ref = None
+        mig = None  # (request_id, meta, ref) of a preempted lane's checkpoint
         last: BaseException | None = None
         try:
             while budget.try_spend():
+                if mig is not None and self._resume is not None:
+                    # resume-on-peer leg (recompute = 0): splice the
+                    # dying replica's live_state checkpoint before ever
+                    # considering a re-prefill (which would recompute
+                    # prompt + the whole generated prefix)
+                    try:
+                        out = self._resume(mig[1], mig[2], sampling_params or {})
+                        self._bump("resumed")
+                        self._tel.on_migration("resumed")
+                        return out
+                    except BaseException as e:  # noqa: BLE001
+                        last = e
+                        if migration_lost(e):
+                            # checkpoint gone (owner exited before the
+                            # fetch): degrade to re-prefill from scratch
+                            self._tel.on_migration("lost")
+                            mig = None
+                        # an overloaded/dead peer keeps the checkpoint —
+                        # the next budget unit retries the resume
+                    continue
                 if ref is None:
                     try:
                         meta, ref = self._prefill(list(prompt_token_ids))
@@ -121,7 +158,18 @@ class DisaggRouter:
                     return self._decode(meta, ref, list(prompt_token_ids), sampling_params or {})
                 except BaseException as e:  # noqa: BLE001
                     last = e
-                    if _handoff_lost(e):
+                    m = migration_of(e)
+                    if m is not None and self._resume is not None:
+                        # the decode lane was PREEMPTED and checkpointed
+                        # this request's live state: switch to the resume
+                        # leg. The prefill handoff ref is KEPT — its owner
+                        # (the prefill replica) is not the one dying, so
+                        # if the checkpoint is lost the retry can still
+                        # re-decode from the surviving block instead of
+                        # re-prefilling
+                        self._bump("migrations")
+                        mig = m
+                    elif _handoff_lost(e):
                         # block gone before scatter-in (possibly wrapped
                         # in the task layer's TaskError): this ref is
                         # dead weight — drop it and re-prefill

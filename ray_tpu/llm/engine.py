@@ -87,6 +87,15 @@ class RequestState:
     # hit accounting (cache counters, telemetry tiers, any object-plane
     # transfer) happen ONCE per request, never once per blocked step
     cached_pref: tuple | None = None
+    # live migration (llm/migrate.py): a restored request's splice state
+    # (exact PRNG key, spec controller state) consumed by _bind_resume —
+    # set together with `prefilled` so the checkpointed KV block rides
+    # the existing transferred-KV admission path, but the bind continues
+    # generation instead of sampling a first token from shipped logits
+    resume: dict | None = None
+    # restore ingress wall clock (0.0 = never migrated): the splice
+    # latency observed at the first post-splice token
+    t_restore: float = 0.0
 
 
 @dataclass
@@ -1062,6 +1071,238 @@ class LLMEngine:
             self._handoffs.clear()
             return n
 
+    # ------------------------------------------------------- live migration
+
+    def checkpoint_request(self, request_id: str) -> dict:
+        """Extract one in-flight request's COMPLETE resumable state
+        (llm/migrate.py): the KV block covering every attended position
+        via the fused extract programs (int8 caches ship int8 values +
+        per-head wire scales), the emitted token/logprob stream, the
+        lane's live PRNG key, the sampling params, and the speculative
+        controller's sticky EMA/effective-k. A peer engine's
+        ``restore_request`` continues generation token-identically.
+
+        The one-step-delayed emission is settled FIRST: the in-flight
+        fused step (or speculative round) drains here, so the checkpoint
+        holds every token the device has minted — the splice-dedup half
+        of the migration contract (restore emits nothing at admission;
+        the next token comes from the peer's first decode step).
+
+        Pure snapshot: the request keeps running locally until the
+        caller finishes it (``finish_migrated``). Raises MigrationError
+        for state that cannot move — a finished/unknown request, a
+        prefill-only stub (its handoff already IS the transferable
+        state), a streaming consumer, or a WAITING sampled request with
+        generated tokens (its live key existed only on a bound lane; a
+        cold re-admission would resample the suffix — the router's
+        re-prefill leg is the token-identical fallback there)."""
+        from ray_tpu.llm.migrate import LIVE_KIND, MigrationError
+
+        with self._lock:
+            st = self._requests.get(request_id)
+            if st is None or st.finished:
+                raise MigrationError(f"request {request_id!r} is not in flight")
+            if st.prefill_only:
+                raise MigrationError("prefill-only requests hand off, they do not migrate")
+            if st.out_queue is not None:
+                raise MigrationError(
+                    "streaming requests cannot migrate (the consumer holds a live token queue)"
+                )
+            if self._device_resident and self._pending is not None:
+                prev, self._pending = self._pending, None
+                if self._spec_cfg is not None:
+                    self._drain_spec(prev)
+                else:
+                    self._drain(prev)
+                if st.finished:
+                    raise MigrationError(
+                        f"request {request_id!r} finished while settling the in-flight step"
+                    )
+            p = st.params
+            state: dict = {
+                "kind": LIVE_KIND,
+                "prompt_token_ids": list(st.prompt_token_ids),
+                "emitted_token_ids": list(st.token_ids),
+                "emitted_logprobs": [float(x) for x in st.logprobs],
+                "sampling": {
+                    "max_tokens": int(p.max_tokens),
+                    "temperature": float(p.temperature),
+                    "top_k": int(p.top_k),
+                    "top_p": float(p.top_p),
+                    "stop_token_ids": [int(t) for t in p.stop_token_ids],
+                    "seed": None if p.seed is None else int(p.seed),
+                    "logprobs": bool(p.logprobs),
+                    "priority": int(p.priority),
+                },
+                "spec": None,
+            }
+            if st.t_submit:
+                state["submitted_at"] = float(st.t_submit)
+            if st.trace is not None:
+                state["trace"] = {"trace_id": st.trace[0], "parent_id": st.trace[1]}
+            if self._spec_cfg is not None:
+                exp = self._controller.export(request_id)
+                if exp is not None:
+                    state["spec"] = {"ema": exp[0], "k": int(exp[1])}
+            if st.slot < 0:
+                # COLD checkpoint: the request is waiting (queued or
+                # recompute-preempted) — no bound lane, no live KV/key.
+                # The peer re-admits prompt+generated exactly like a
+                # local recompute preemption: token-identical for greedy
+                # (and for fresh requests with nothing generated yet).
+                if st.token_ids and p.temperature > 0.0:
+                    raise MigrationError(
+                        "cannot cold-checkpoint a sampled request with generated tokens "
+                        "(its live PRNG key exists only on a bound lane); the router's "
+                        "re-prefill leg is the token-identical fallback"
+                    )
+                if self._tel is not None:
+                    self._tel.on_migration("checkpointed", 0)
+                return state
+            slot = st.slot
+            l = len(st.prompt_token_ids) + len(st.token_ids) - 1
+            # the authoritative cache length must agree with the host
+            # view before the block can claim to cover l positions
+            if self.kv_layout == "paged":
+                l_auth = int(self._lengths[slot])
+            else:
+                l_auth = int(np.asarray(self.cache["length"][slot]))
+            if l_auth != l:
+                raise MigrationError(
+                    f"inconsistent decode state for {request_id!r}: cache length "
+                    f"{l_auth} != prompt + emitted - 1 = {l}"
+                )
+            T = _bucket(l, self.prefill_buckets)
+            if self.kv_layout == "paged":
+                page = self._pcfg.page_size
+                # table cells past the allocated pages are 0 (trash):
+                # the gather's tail is garbage the peer masks by length
+                row = np.asarray(self._tables[slot][: T // page], np.int32)
+                out = self._extract_paged(self.pool, row)
+            else:
+                out = self._extract_slots(self.cache, np.int32(slot), T)
+            state.update(k=np.asarray(out[0]), v=np.asarray(out[1]), n=l)
+            if len(out) == 4:
+                state.update(k_scale=np.asarray(out[2]), v_scale=np.asarray(out[3]))
+            # the LIVE key: on the device-resident loop it advanced on
+            # device (seeded lanes included — restore must continue the
+            # sequence, never reset from the seed); sync keeps it on host
+            if self._device_resident:
+                state["rng_key"] = np.asarray(self._dkeys[slot]).astype(np.uint32)
+            else:
+                state["rng_key"] = np.asarray(self._keys[slot], np.uint32)
+            if self._tel is not None:
+                nbytes = int(state["k"].nbytes + state["v"].nbytes)
+                if state.get("k_scale") is not None:
+                    nbytes += int(state["k_scale"].nbytes + state["v_scale"].nbytes)
+                self._tel.on_migration("checkpointed", nbytes)
+            return state
+
+    def finish_migrated(self, request_id: str) -> bool:
+        """Finish a checkpointed request locally with reason "migrated"
+        (its continuation now lives on a peer): slot/pages recycle, spec
+        state drops, stream consumers get their sentinel. The abort
+        twin for the migration path — telemetry counts the reason
+        separately so evacuations never read as error-rate."""
+        with self._lock:
+            st = self._requests.get(request_id)
+            if st is None or st.finished:
+                return False
+            self._finish(st, "migrated")
+            return True
+
+    def restore_request(
+        self,
+        state,
+        request_id: str | None = None,
+        stream: bool = False,
+        out_queue=None,
+    ) -> str:
+        """Splice a checkpointed request into THIS engine and continue
+        generation token-identically (llm/migrate.py). ``state`` is the
+        validated live_state dict — or an ObjectRef straight off the
+        object plane (fetched + decoded here, bounded retry).
+
+        A HOT checkpoint scatters its KV block through the existing
+        transferred-KV admission path (fused scatter-in, transparent
+        requant across producer/consumer cache dtypes), then
+        ``_bind_resume`` rebinds the lane from the checkpoint: exact
+        PRNG key, last emitted token as the next decode input, sticky
+        spec k — and emits NOTHING (no dup, no drop at the splice). A
+        COLD checkpoint re-admits prompt+generated like a recompute
+        preemption. Raises MigrationError when the state cannot fit this
+        engine's geometry."""
+        from ray_tpu.llm import migrate as _mig
+
+        if not isinstance(state, dict):
+            state = _mig.fetch(state)
+        _mig.check_state(state)
+        params = _mig.params_of(state)
+        prompt = [int(t) for t in state["prompt_token_ids"]]
+        emitted = [int(t) for t in state["emitted_token_ids"]]
+        hot = state.get("k") is not None
+        with self._lock:
+            if request_id is None:
+                request_id = f"req-{self._auto_id}"
+                self._auto_id += 1
+            if len(prompt) + params.max_tokens > self.max_seq_len:
+                raise _mig.MigrationError(
+                    f"prompt ({len(prompt)}) + max_tokens ({params.max_tokens}) "
+                    f"exceeds this engine's max_seq_len ({self.max_seq_len})"
+                )
+            st = RequestState(request_id, prompt, params)
+            st.token_ids = list(emitted)
+            st.logprobs = [float(x) for x in state.get("emitted_logprobs", [])]
+            st.t_restore = time.time()
+            if stream or out_queue is not None:
+                st.out_queue = out_queue if out_queue is not None else queue.SimpleQueue()
+            nbytes = 0
+            if hot:
+                T_pad = int(state["k"].shape[1])
+                if T_pad > self.max_seq_len:
+                    raise _mig.MigrationError(
+                        f"checkpoint block width {T_pad} exceeds this engine's cache row "
+                        f"({self.max_seq_len}); the producer's bucket ladder is wider"
+                    )
+                if self.kv_layout == "paged":
+                    page = self._pcfg.page_size
+                    need = min(-(-T_pad // page) + 1, self._pcfg.max_pages_per_seq)
+                    if need > self._pcfg.num_pages - 1:
+                        raise _mig.MigrationError(
+                            f"checkpoint needs {need} pages but the pool has "
+                            f"{self._pcfg.num_pages - 1}"
+                        )
+                pref = {"k": state["k"], "v": state["v"], "n": int(state["n"]),
+                        "prompt_token_ids": prompt}
+                if state.get("k_scale") is not None:
+                    pref["k_scale"] = state["k_scale"]
+                    pref["v_scale"] = state["v_scale"]
+                st.prefilled = pref
+                st.resume = {
+                    "rng_key": np.asarray(state["rng_key"], np.uint32),
+                    "spec": state.get("spec"),
+                }
+                nbytes = int(state["k"].nbytes + state["v"].nbytes)
+                if state.get("k_scale") is not None:
+                    nbytes += int(state["k_scale"].nbytes + state["v_scale"].nbytes)
+            elif self._spec_cfg is not None and state.get("spec"):
+                # cold restore: the sticky spec state still survives (the
+                # eventual bind's _spec_admit reads it back from the
+                # controller under the NEW request id)
+                sp = state["spec"]
+                self._controller.restore(request_id, sp.get("ema"), sp.get("k"))
+            if self._tel is not None:
+                tr = state.get("trace")
+                self._tel.on_submit(
+                    st,
+                    state.get("submitted_at"),
+                    parent_trace=(tr["trace_id"], tr.get("parent_id")) if isinstance(tr, dict) else None,
+                )
+                self._tel.on_migration("restored", nbytes)
+            self._requests[request_id] = st
+            self._waiting.append(st)
+            return request_id
+
     # --------------------------------------------------------------- engine
 
     def _finish(self, st: RequestState, reason: str):
@@ -1538,12 +1779,17 @@ class LLMEngine:
                 self._lengths[slot] = n_real
                 if self._tel is not None:
                     self._tel.on_scatter_in(st, t_scatter)
-                self._bind_slot(st, slot, jnp.asarray(kv["logits"])[None])
+                if st.resume is not None:
+                    self._bind_resume(st, slot)
+                else:
+                    self._bind_slot(st, slot, jnp.asarray(kv["logits"])[None])
                 return
             self.pool = self._insert(
                 self.pool, table_row[: T_pad // page], jnp.asarray(k_pad), jnp.asarray(v_pad), *scales
             )
-            logits = jnp.asarray(kv["logits"])[None]
+            # a live-state restore ships no logits: the bind below
+            # splices instead of sampling a first token
+            logits = None if st.resume is not None else jnp.asarray(kv["logits"])[None]
             self._lengths[slot] = n_real
             if self._tel is not None:
                 self._tel.on_scatter_in(st, t_scatter)
@@ -1571,7 +1817,10 @@ class LLMEngine:
             self._lengths[slot] = n
         if self._device_resident:
             self._push_table(slot)
-        self._bind_slot(st, slot, logits)
+        if st.resume is not None:
+            self._bind_resume(st, slot)
+        else:
+            self._bind_slot(st, slot, logits)
 
     def _admit_special_slots(self, st: RequestState, slot: int, pref, prompt):
         """Slot-layout admission for transferred-KV / prefix-cache-hit
@@ -1601,7 +1850,9 @@ class LLMEngine:
                 )
             if self._tel is not None:
                 self._tel.on_scatter_in(st, t_scatter)
-            logits = jnp.asarray(kv["logits"])[None]
+            # a live-state restore ships no logits: the bind below
+            # splices instead of sampling a first token
+            logits = None if st.resume is not None else jnp.asarray(kv["logits"])[None]
         else:
             # reuse the cached prefix KV; re-attend only the suffix. A
             # cluster-plane remote hit carries wire-layout scales when the
@@ -1618,8 +1869,12 @@ class LLMEngine:
                 self.params, self.cache, slot, jnp.asarray(toks), jnp.asarray(m, np.int32)
             )
             logits = logits[None]
-        # sample the first generated token from the prefill logits
-        self._bind_slot(st, slot, logits)
+        # sample the first generated token from the prefill logits (a
+        # live-state restore splices instead: no sample, no emit)
+        if st.resume is not None:
+            self._bind_resume(st, slot)
+        else:
+            self._bind_slot(st, slot, logits)
 
     def _bind_slot(self, st: RequestState, slot: int, logits):
         import jax
@@ -1677,6 +1932,53 @@ class LLMEngine:
         self._emit(st, token, float(logp[0]))
         if spec_hist is not None:
             self._spec_admit(st, slot, spec_hist)
+
+    def _bind_resume(self, st: RequestState, slot: int):
+        """Splice a restored live-state request into the decode loop
+        (llm/migrate.py): bind the slot and every lane from the
+        CHECKPOINTED state — the exact (already-advanced) PRNG key, the
+        last emitted token as the next decode input, the sticky spec
+        effective-k — and emit NOTHING. The checkpoint settled the
+        source's in-flight step, so the next client-visible token is
+        minted by the first decode step here: the stream can neither
+        repeat nor drop a token across the splice."""
+        st.slot = slot
+        st.admit_seq = self._admit_counter = getattr(self, "_admit_counter", 0) + 1
+        self._slots[slot] = st
+        if self._tel is not None:
+            self._tel.on_bind(st, getattr(self, "_t_prefill_start", st.t_submit))
+        rs = st.resume
+        st.resume = None
+        p = st.params
+        self._temps[slot] = p.temperature
+        self._top_k[slot] = p.top_k
+        self._top_p[slot] = p.top_p
+        # the checkpointed key, NEVER re-derived from the seed: a seeded
+        # lane's key advanced once per sample at the source, and the
+        # oracle's post-splice draws continue that sequence
+        self._keys[slot] = np.asarray(rs["rng_key"], np.uint32)
+        token = int(st.token_ids[-1])
+        self._next_tokens[slot] = token
+        if self._device_resident:
+            self._dtokens, self._dkeys, self._dtemps, self._dtopk, self._dtopp = self._set_lane(
+                self._dtokens,
+                self._dkeys,
+                self._dtemps,
+                self._dtopk,
+                self._dtopp,
+                np.int32(slot),
+                np.int32(token),
+                self._keys[slot],
+                np.float32(p.temperature),
+                np.int32(p.top_k),
+                np.float32(p.top_p),
+            )
+        if self._spec_cfg is not None:
+            spec = rs.get("spec") or {}
+            self._controller.restore(st.request_id, spec.get("ema"), spec.get("k"))
+            # history = prompt + everything emitted; the drafter caches
+            # hist[:-1] — exactly the positions the restored block covers
+            self._spec_admit(st, slot, st.prompt_token_ids + st.token_ids)
 
     def _complete_handoff(self, st: RequestState, slot: int, logits):
         """Finish a prefill-only request: extract its KV block into a

@@ -111,6 +111,7 @@ class KVPlaneClient:
         # the engine lock (heartbeats keep probing and close it on success)
         self._consec_errors = 0
         self._down_until = 0.0
+        self._shutdown = False
         self._lock = threading.Lock()
         self._published: dict[bytes, tuple] = {}  # boundary key -> (n, meta, ref)
         self._ref_keys: dict[bytes, set] = {}  # ref id -> live boundary keys
@@ -400,8 +401,13 @@ class KVPlaneClient:
         disables permanently (the replica is exiting). Returns how many
         published keys were released. A dead index degrades silently —
         the lease expiry prunes our entries anyway, and the owned bytes
-        die with this process regardless."""
+        die with this process regardless. IDEMPOTENT: a second call (a
+        controller retrying the drain hook races the stepper) is a
+        no-op — never a second drop_replica RPC or a double-free."""
         with self._lock:
+            if self._shutdown:
+                return 0
+            self._shutdown = True
             self._publish_enabled = False
             published = dict(self._published)
             self._published.clear()

@@ -71,11 +71,16 @@ class CacheAwareRouter:
     def __init__(self, index, submit, replicas, *, block: int = 64,
                  cache_weight: float = 1.0, load_weight: float = 0.1,
                  max_attempts: int = 2, index_timeout_s: float = 10.0,
-                 telemetry_tags: dict | None = None):
+                 resume_submit=None, telemetry_tags: dict | None = None):
         from ray_tpu.llm.telemetry import RouterTelemetry
 
         self._index = index
         self._submit = submit
+        # resume_submit(replica_id, meta, ref, sampling_params) -> dict:
+        # splice a preempted replica's published live_state checkpoint
+        # (llm/migrate.py) on the chosen replica — the failover leg that
+        # beats re-prefill (zero recomputed tokens). None = off.
+        self._resume_submit = resume_submit
         self.replicas = list(replicas)
         self.block = int(block)
         self.cache_weight = float(cache_weight)
@@ -88,6 +93,7 @@ class CacheAwareRouter:
             "requests": 0, "routed_to_holder": 0, "routed_off_holder": 0,
             "cold": 0, "retries": 0, "failed": 0, "matched_tokens": 0,
             "index_errors": 0, "budget_exhausted": 0, "shed": 0,
+            "migrations": 0, "resumed": 0,
         }
         # failover/shed events flow into the live serving metrics, same
         # catalog as the disagg router's
@@ -141,21 +147,54 @@ class CacheAwareRouter:
 
         priority = int((sampling_params or {}).get("priority", 0))
         budget = RetryBudget(self.max_attempts, self._tel)
+        from ray_tpu.llm.migrate import migration_lost, migration_of
+
         last: BaseException | None = None
         attempted = 0
-        for attempt, rid in enumerate(ranked):
+        attempt = 0
+        ix = 0  # position in the ranked list; a failure usually advances
+        mig = None  # a preempted replica's (request_id, meta, ref) checkpoint
+        while ix < len(ranked):
             if not budget.try_spend():
                 break
+            rid = ranked[ix]
             attempted += 1
             if attempt:
                 with self._lock:
                     self.stats_counts["retries"] += 1
+            attempt += 1
             with self._lock:
                 self._inflight[rid] += 1
             try:
+                if mig is not None and self._resume_submit is not None:
+                    # resume-on-peer failover leg (llm/migrate.py): the
+                    # previous replica was preempted mid-decode and
+                    # checkpointed this request's live state — splice it
+                    # here with ZERO recomputed tokens instead of paying
+                    # prompt + generated prefix in a re-prefill
+                    out = self._resume_submit(rid, mig[1], mig[2], sampling_params or {})
+                    with self._lock:
+                        self.stats_counts["resumed"] += 1
+                    self._tel.on_migration("resumed")
+                    return out
                 return self._submit(rid, prompt, sampling_params or {})
             except BaseException as e:  # noqa: BLE001
                 last = e
+                m = migration_of(e)
+                if m is not None and self._resume_submit is not None:
+                    with self._lock:
+                        self.stats_counts["migrations"] += 1
+                    mig = m
+                    ix += 1  # the dying replica is done; resume on the next
+                elif mig is not None and migration_lost(e):
+                    # checkpoint gone before the fetch: THIS replica is
+                    # healthy (it failed to borrow, not to serve) — stay
+                    # on it and re-prefill from scratch next attempt
+                    # (correct, just recomputes the generated prefix)
+                    self._tel.on_migration("lost")
+                    mig = None
+                else:
+                    ix += 1
             finally:
                 with self._lock:
                     self._inflight[rid] -= 1

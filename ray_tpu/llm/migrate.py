@@ -1,0 +1,361 @@
+"""Live request migration: mid-decode state over the object plane.
+
+TPU fleets run on preemptible capacity, so the canonical failure is not a
+crash but a SIGTERM-with-deadline. Replica ``drain()`` (serve/llm.py)
+answers it by finishing what it can — and, before this module, ABORTING
+the rest: every in-flight decode lost its whole generated prefix and the
+router re-prefilled from scratch. The runtime's ownership model already
+knows how to do better — a request's KV state is just bytes we can
+extract, publish as an owned object, and scatter into a peer (the disagg
+handoff proved the pattern for prompt KV) — so mid-decode state survives
+a replica's death the same way.
+
+The unit of migration is a **live_state wire dict**: one request's
+complete resumable state —
+
+- the KV block covering every attended position (``extract_sequence`` /
+  ``gather_pages``, the same fused programs the disagg extract uses; an
+  int8 cache ships int8 values + per-head scales and rides the
+  transparent-requant insert path on the peer),
+- the emitted tokens (and logprobs) the client has already seen,
+- the lane's live PRNG key (seeded lanes carry the ADVANCED key, never a
+  reset — post-splice sampling continues exactly where it left off),
+- the sampling params, and the speculative controller's sticky
+  effective-k / acceptance-EMA state when speculation is on —
+
+versioned and validated on decode with the same severity as every other
+wire (``MigrationError`` — a truncated or foreign object must never
+scatter garbage into a live pool), published via ``direct.put_owned``.
+
+**Splice-dedup contract.** ``engine.checkpoint_request`` first settles
+the one-step-delayed emission (the in-flight fused step drains), so the
+checkpoint holds every token the device has minted; the peer's
+``engine.restore_request`` binds the last emitted token as the next
+decode input and emits NOTHING at admission — the next client-visible
+token is minted by the first decode step on the peer. The stream can
+therefore neither repeat nor drop a token across the splice.
+
+Degradation order (serve/llm.py drain(mode="migrate") + both routers):
+**migrate** (recompute = 0 tokens) → **re-prefill** (recompute = prompt,
+generated prefix lost) → **typed error** after the shared RetryBudget.
+
+A checkpoint is owned by the dying replica's process: it must outlive
+``drain()`` long enough for a peer to fetch it, and dies with the
+process (preemption deadline semantics). A fetch that loses that race
+raises ``MigrationLostError`` after its bounded retries — the routers'
+signal to fall back to re-prefill, never a hang. Loss injection rides
+the existing ``direct.put_owned`` / ``direct.get_owned_view`` chaos
+sites; the preemption NOTICE itself is the ``serve.preempt`` site.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ray_tpu.llm.disagg import handoff as _handoff
+from ray_tpu.llm.sampling import SamplingParams
+
+LIVE_STATE_VERSION = 1
+LIVE_KIND = _handoff.LIVE_KIND
+
+
+class MigrationError(ValueError):
+    """Malformed/inconsistent live_state payload, or a request whose
+    state cannot be checkpointed (streaming consumer, prefill-only stub,
+    sampled request with no live lane key)."""
+
+
+class MigrationLostError(RuntimeError):
+    """The published checkpoint vanished (owner process exited, object
+    freed) before a peer could fetch it. Bounded-retry callers raise this
+    after their budget; routers react by re-prefilling."""
+
+
+class RequestMigratedError(RuntimeError):
+    """Typed signal a migrating replica hands each in-flight waiter: the
+    request did not fail — its live state was checkpointed and published,
+    and ``migration_ref``/``migration_meta`` let a router resume it on a
+    peer with zero recomputed tokens (the resume-on-peer failover leg)."""
+
+    def __init__(self, request_id: str, meta: dict, ref):
+        super().__init__(
+            f"request {request_id} migrated: live decode state published "
+            f"({meta.get('nbytes', 0)} bytes, {meta.get('emitted', 0)} tokens emitted); "
+            "resume on a peer via resume_from_migration"
+        )
+        self.request_id = str(request_id)
+        self.migration_meta = dict(meta)
+        self.migration_ref = ref
+
+
+def _causes(e):
+    """Bounded walk of an error's wire-wrapping chain (TaskError's
+    ``.cause`` links) — same traversal as serve/overload's probes."""
+    for _ in range(8):
+        if e is None:
+            return
+        yield e
+        e = getattr(e, "cause", None)
+
+
+def migration_of(e) -> tuple | None:
+    """(request_id, meta, ref) when ``e`` is (or wraps) a
+    RequestMigratedError whose checkpoint ref survived the wire; None
+    otherwise. tb_str-only detection cannot recover the ref — those
+    callers fall back to re-prefill, which is the correct degraded leg."""
+    for err in _causes(e):
+        ref = getattr(err, "migration_ref", None)
+        if ref is not None:
+            return (
+                getattr(err, "request_id", ""),
+                dict(getattr(err, "migration_meta", None) or {}),
+                ref,
+            )
+    return None
+
+
+def migration_lost(e) -> bool:
+    """True when ``e`` is (or wraps) a lost/invalid checkpoint — the
+    resume leg is dead and the router must fall back to re-prefill."""
+    for err in _causes(e):
+        if isinstance(err, (MigrationLostError, MigrationError)):
+            return True
+        tb = getattr(err, "tb_str", "")
+        if "MigrationLostError" in tb or "MigrationError" in tb:
+            return True
+    return False
+
+
+def _sampling_to_wire(p: SamplingParams) -> dict:
+    return {
+        "max_tokens": int(p.max_tokens),
+        "temperature": float(p.temperature),
+        "top_k": int(p.top_k),
+        "top_p": float(p.top_p),
+        "stop_token_ids": [int(t) for t in p.stop_token_ids],
+        "seed": None if p.seed is None else int(p.seed),
+        "logprobs": bool(p.logprobs),
+        "priority": int(p.priority),
+    }
+
+
+def params_of(state: dict) -> SamplingParams:
+    """Reconstruct (and validate — SamplingParams raises on garbage) the
+    request's sampling params from a live_state dict."""
+    sp = dict(state.get("sampling") or {})
+    if not isinstance(sp.get("max_tokens"), int):
+        raise MigrationError(f"live_state sampling block malformed: {sp!r}")
+    sp["stop_token_ids"] = tuple(int(t) for t in sp.get("stop_token_ids", ()))
+    try:
+        return SamplingParams(**sp)
+    except (TypeError, ValueError) as e:
+        raise MigrationError(f"live_state sampling params invalid: {e}") from e
+
+
+def check_state(state: dict) -> dict:
+    """Validate an engine-facing live_state dict (the decode product, or
+    a checkpoint handed over in-process). Raises MigrationError on
+    anything inconsistent; returns the state for chaining."""
+    if not isinstance(state, dict) or state.get("kind") != LIVE_KIND:
+        raise MigrationError(f"not a live_state payload: {type(state).__name__}")
+    prompt = state.get("prompt_token_ids")
+    emitted = state.get("emitted_token_ids")
+    if not isinstance(prompt, list) or not prompt:
+        raise MigrationError("live_state without prompt_token_ids")
+    if not isinstance(emitted, list):
+        raise MigrationError("live_state without emitted_token_ids")
+    params_of(state)
+    hot = state.get("k") is not None
+    if hot:
+        if not emitted:
+            raise MigrationError("hot live_state with zero emitted tokens (nothing to splice)")
+        n = int(state.get("n", -1))
+        if n != len(prompt) + len(emitted) - 1:
+            raise MigrationError(
+                f"live_state KV length {n} != prompt ({len(prompt)}) + emitted "
+                f"({len(emitted)}) - 1 — the last emitted token's KV is minted by the "
+                "peer's first decode step"
+            )
+        if n > state["k"].shape[1]:
+            raise MigrationError(f"KV length {n} outside block width {state['k'].shape[1]}")
+        key = state.get("rng_key")
+        if key is None or np.asarray(key).dtype != np.uint32 or np.asarray(key).ndim != 1:
+            raise MigrationError("hot live_state needs its lane's uint32 PRNG key data")
+    spec = state.get("spec")
+    if spec is not None and not isinstance(spec, dict):
+        raise MigrationError(f"live_state spec block malformed: {spec!r}")
+    return state
+
+
+def encode(state: dict) -> dict:
+    """Engine-facing live_state -> self-describing wire dict.
+
+    The KV block half rides the handoff codec (kind=live_state): its
+    ``prompt_token_ids`` on the wire are the COVERED tokens — original
+    prompt + emitted[:-1], exactly the ``n`` positions the block holds —
+    so the handoff layer's length/shape/scale validation applies
+    unchanged and the peer can verify coverage token-for-token. The
+    live half (emitted stream, PRNG key, sampling, spec state) travels
+    under ``live``."""
+    check_state(state)
+    prompt = [int(t) for t in state["prompt_token_ids"]]
+    emitted = [int(t) for t in state["emitted_token_ids"]]
+    live = {
+        "version": LIVE_STATE_VERSION,
+        "n_prompt": len(prompt),
+        "emitted_token_ids": emitted,
+        "emitted_logprobs": [float(x) for x in state.get("emitted_logprobs", [])],
+        "sampling": dict(state["sampling"]),
+        "spec": None if state.get("spec") is None else dict(state["spec"]),
+    }
+    if state.get("k") is not None:
+        covered = prompt + emitted[:-1]
+        block = {
+            "k": state["k"], "v": state["v"], "n": int(state["n"]),
+            "prompt_token_ids": covered,
+        }
+        for extra in ("k_scale", "v_scale", "trace", "submitted_at"):
+            if state.get(extra) is not None:
+                block[extra] = state[extra]
+        try:
+            wire = _handoff.encode(block, kind=LIVE_KIND)
+        except _handoff.HandoffError as e:
+            raise MigrationError(str(e)) from e
+        live["rng_key"] = np.asarray(state["rng_key"], np.uint32)
+    else:
+        # cold checkpoint (request was waiting — no bound lane, no KV):
+        # the peer re-admits prompt+generated like a recompute preemption
+        wire = {"version": _handoff.HANDOFF_VERSION, "kind": LIVE_KIND,
+                "prompt_token_ids": prompt}
+        if state.get("trace") is not None:
+            wire["trace"] = dict(state["trace"])
+        if state.get("submitted_at") is not None:
+            wire["submitted_at"] = float(state["submitted_at"])
+    wire["live"] = live
+    return wire
+
+
+def decode(wire: dict) -> dict:
+    """Wire dict -> validated engine-facing live_state (the
+    ``restore_request`` input). MigrationError on anything inconsistent:
+    a truncated block, a foreign kind, drifted versions, a coverage
+    mismatch between the block and the emitted stream — garbage must
+    never reach a live pool."""
+    if not isinstance(wire, dict) or wire.get("kind") != LIVE_KIND:
+        raise MigrationError(f"not a {LIVE_KIND} wire payload: {type(wire).__name__}")
+    live = wire.get("live")
+    if not isinstance(live, dict) or live.get("version") != LIVE_STATE_VERSION:
+        raise MigrationError(
+            f"live_state version {None if not isinstance(live, dict) else live.get('version')} "
+            f"!= {LIVE_STATE_VERSION}"
+        )
+    emitted = [int(t) for t in live.get("emitted_token_ids", [])]
+    n_prompt = int(live.get("n_prompt", 0))
+    if n_prompt < 1:
+        raise MigrationError(f"live_state n_prompt {n_prompt} invalid")
+    state = {
+        "kind": LIVE_KIND,
+        "emitted_token_ids": emitted,
+        "emitted_logprobs": [float(x) for x in live.get("emitted_logprobs", [])],
+        "sampling": dict(live.get("sampling") or {}),
+        "spec": None if live.get("spec") is None else dict(live["spec"]),
+    }
+    if wire.get("k") is not None:
+        try:
+            block = _handoff.decode(wire, kind=LIVE_KIND)
+        except _handoff.HandoffError as e:
+            raise MigrationError(str(e)) from e
+        covered = [int(t) for t in block["prompt_token_ids"]]
+        if n_prompt > len(covered) or covered[n_prompt:] != emitted[:-1]:
+            raise MigrationError(
+                "live_state coverage mismatch: the KV block's covered tokens do not "
+                "equal prompt + emitted[:-1]"
+            )
+        state["prompt_token_ids"] = covered[:n_prompt]
+        state.update(k=block["k"], v=block["v"], n=int(block["n"]))
+        if block.get("k_scale") is not None:
+            state.update(k_scale=block["k_scale"], v_scale=block["v_scale"])
+        # keep the wire dtype as-is: check_state REJECTS a non-uint32 key
+        # (coercing here would let a corrupted key pass validation)
+        state["rng_key"] = None if live.get("rng_key") is None else np.asarray(live["rng_key"])
+        for extra in ("trace", "submitted_at"):
+            if block.get(extra) is not None:
+                state[extra] = block[extra]
+    else:
+        prompt = [int(t) for t in wire.get("prompt_token_ids", [])]
+        if len(prompt) != n_prompt:
+            raise MigrationError(f"cold live_state prompt length {len(prompt)} != n_prompt {n_prompt}")
+        state["prompt_token_ids"] = prompt
+        for extra in ("trace", "submitted_at"):
+            if wire.get(extra) is not None:
+                state[extra] = wire[extra]
+    return check_state(state)
+
+
+def meta_of(state: dict) -> dict:
+    """Small router-facing summary (no arrays) that travels with the ref."""
+    hot = state.get("k") is not None
+    nbytes = 0
+    if hot:
+        nbytes = int(state["k"].nbytes + state["v"].nbytes)
+        if state.get("k_scale") is not None:
+            nbytes += int(state["k_scale"].nbytes + state["v_scale"].nbytes)
+    return {
+        "kind": LIVE_KIND,
+        "hot": hot,
+        "n": int(state.get("n", 0)) if hot else 0,
+        "emitted": len(state.get("emitted_token_ids", [])),
+        "prompt_tokens": len(state.get("prompt_token_ids", [])),
+        "nbytes": nbytes,
+    }
+
+
+def publish(state: dict):
+    """Encode a checkpoint and store it as an owned object in THIS
+    process. Returns (meta, ref) — only the tiny pair travels to the
+    router; the bytes stay owner-local until a peer's fetch borrows
+    them. The object's lifetime is the dying replica's remaining one:
+    a fetch that arrives too late sees MigrationLostError, and the leak
+    backstop reclaims never-fetched checkpoints."""
+    from ray_tpu.core import direct as _direct
+
+    wire = encode(state)
+    ref = _direct.put_owned(wire)
+    return meta_of(state), ref
+
+
+def fetch(ref, meta: dict | None = None, *, timeout_s: float = 10.0, retries: int = 2,
+          retry_wait_s: float = 0.2) -> dict:
+    """Borrow-get a published checkpoint with a bounded retry budget and
+    full wire validation. A checkpoint that is GONE (owner exited — the
+    normal post-preemption case for a late fetch) raises
+    MigrationLostError after the final attempt; callers must never hang
+    on a dead replica's state."""
+    from ray_tpu.core import direct as _direct
+    from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
+
+    last: BaseException | None = None
+    for attempt in range(retries + 1):
+        try:
+            t0 = time.time()
+            value = _direct.get_owned_view(ref.id, timeout=timeout_s)
+            state = decode(value)
+            if meta is not None and meta.get("emitted") is not None and int(
+                meta["emitted"]
+            ) != len(state["emitted_token_ids"]):
+                raise MigrationError(
+                    f"fetched checkpoint emitted count {len(state['emitted_token_ids'])} "
+                    f"does not match routed meta {meta['emitted']}"
+                )
+            _handoff._handoff_span("llm.migrate.fetch", value, t0, attempts=attempt + 1)
+            return state
+        except (ObjectLostError, GetTimeoutError, ConnectionError, FileNotFoundError) as e:
+            last = e
+            if attempt < retries:
+                time.sleep(retry_wait_s)
+    raise MigrationLostError(
+        f"live_state checkpoint {ref.id.hex()[:16]} lost before restore "
+        f"({retries + 1} attempts): {last}"
+    ) from last

@@ -89,6 +89,20 @@ class AdaptiveKController:
                 st[1] = max(st[1] - 1, self.cfg.k_min)
         return st[1]
 
+    def export(self, request_id: str) -> tuple | None:
+        """(ema, effective_k) for a live request — the sticky state a
+        migration checkpoint carries (llm/migrate.py) so the restoring
+        engine's controller continues where this one left off."""
+        st = self._state.get(request_id)
+        return None if st is None else (st[0], st[1])
+
+    def restore(self, request_id: str, ema=None, k=None) -> None:
+        """Seed a migrated request's sticky state under its (possibly
+        new) request id; k clamps into [k_min, k] against THIS engine's
+        config (a heterogeneous fleet may run narrower verify widths)."""
+        kk = self.cfg.k if k is None else max(self.cfg.k_min, min(int(k), self.cfg.k))
+        self._state[request_id] = [None if ema is None else float(ema), kk]
+
     def forget(self, request_id: str) -> None:
         self._state.pop(request_id, None)
 

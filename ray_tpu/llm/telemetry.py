@@ -168,6 +168,26 @@ METRICS: dict[str, dict] = {
         "kind": "gauge", "tags": _SERVE_TAGS,
         "desc": "replica drain lifecycle: 0 serving, 1 draining (shedding new work), 2 drained",
     },
+    # live request migration (llm/migrate.py): preemption-tolerant
+    # serving's evacuation path. Outcomes: "checkpointed" (source
+    # extracted + published), "restored" (peer spliced), "aborted"
+    # (could not checkpoint before the deadline — the abort fallback),
+    # "resumed"/"lost" (router-stage resume leg succeeded / checkpoint
+    # gone before fetch). Source and destination replicas count their
+    # own halves, routers count once per client request — separate by
+    # stage when summing.
+    "rt_llm_migrations_total": {
+        "kind": "counter", "tags": _SERVE_TAGS + ("outcome",),
+        "desc": "live request migrations by outcome (checkpointed/restored/aborted/resumed/lost)",
+    },
+    "rt_llm_migration_bytes_total": {
+        "kind": "counter", "tags": _SERVE_TAGS,
+        "desc": "live_state checkpoint bytes (KV block + scales) moved over the object plane",
+    },
+    "rt_llm_migration_splice_s": {
+        "kind": "histogram", "tags": _SERVE_TAGS, "boundaries": _LATENCY_BOUNDARIES,
+        "desc": "splice latency: restore ingress -> first post-splice token on the peer",
+    },
 }
 
 _instruments: dict = {}
@@ -495,6 +515,12 @@ class EngineTelemetry:
         if st.t_first == 0.0:
             st.t_first = now
             self._b_ttft.observe(max(now - st.t_submit, 0.0))
+            if st.t_restore:
+                # a restored request's first token IS the splice landing:
+                # restore ingress -> first post-splice token on this peer
+                self.m["rt_llm_migration_splice_s"].observe(
+                    max(now - st.t_restore, 0.0), tags=self.tags
+                )
             if st.trace is not None:
                 self._span(st, "llm.first_token", st.t_admit or st.t_submit, now)
         else:
@@ -573,6 +599,14 @@ class EngineTelemetry:
         self.m["rt_llm_handoffs_total"].inc(1.0, tags={**self.tags, "event": "scattered"})
         if st.trace is not None:
             self._span(st, "llm.handoff.scatter_in", t_start, time.time())
+
+    def on_migration(self, outcome: str, nbytes: int = 0) -> None:
+        """Live-migration event (llm/migrate.py): checkpoint extracted
+        here, checkpoint restored here, or the abort fallback. Cold
+        path — once per evacuated request, never per step."""
+        self.m["rt_llm_migrations_total"].inc(1.0, tags={**self.tags, "outcome": str(outcome)})
+        if nbytes:
+            self.m["rt_llm_migration_bytes_total"].inc(float(nbytes), tags=self.tags)
 
     def _span(self, st, name: str, t0: float, t1: float, **attrs) -> None:
         trace_id, root_id, _ = st.trace
@@ -716,6 +750,12 @@ class RouterTelemetry:
         """A request's shared failover budget (serve/overload.RetryBudget)
         ran dry — the typed terminal error is about to surface."""
         self.m["rt_llm_retry_budget_exhausted_total"].inc(1.0, tags=self.tags)
+
+    def on_migration(self, outcome: str) -> None:
+        """Router-stage migration event: "resumed" (a dying replica's
+        checkpoint spliced on a peer, zero recomputed tokens) or "lost"
+        (checkpoint gone before the fetch — degraded to re-prefill)."""
+        self.m["rt_llm_migrations_total"].inc(1.0, tags={**self.tags, "outcome": str(outcome)})
 
     def on_shed(self, shed_class: int) -> None:
         """The router itself shed a request (every ranked replica was

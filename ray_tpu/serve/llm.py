@@ -69,6 +69,11 @@ class LLMConfig:
     # OverloadedError (HTTP 429 + retry-after) with the lowest request
     # class (SamplingParams.priority / body "priority") shed first.
     admission: object = None
+    # default evacuation deadline for a chaos-/signal-delivered
+    # preemption notice (LLMServer.preempt -> drain(mode="migrate")):
+    # checkpoints of in-flight decode state must publish inside it;
+    # stragglers abort typed (the SIGTERM-with-deadline contract)
+    preempt_deadline_s: float = 5.0
 
 
 class LLMServer:
@@ -106,8 +111,18 @@ class LLMServer:
         self.engine = LLMEngine(cfg, params=llm_config.params, **engine_kwargs)
         self._done: dict[str, object] = {}  # request_id -> RequestOutput
         self._events: dict[str, threading.Event] = {}
+        # per-request typed failures delivered OUT of band of the step
+        # loop (live migration hands each evacuated waiter its own
+        # RequestMigratedError; the abort fallback its 429)
+        self._errors: dict[str, BaseException] = {}
         self._lock = threading.Lock()
         self._stopped = False
+        # drain idempotency: a controller retrying its shutdown hook (or
+        # a preemption racing a manual drain) re-observes the first
+        # drain's outcome instead of double-releasing owned state
+        self._drain_lock = threading.Lock()
+        self._drain_result: dict | None = None
+        self._preempt_deadline_s = float(llm_config.preempt_deadline_s)
         self._stepper_error: str | None = None
         self._work = threading.Event()
         # bounded admission at this replica's ingress (serve/overload.py):
@@ -128,9 +143,36 @@ class LLMServer:
         when enabled): the controller marks the replica RUNNING only
         after __init__, so a warmed fleet serves its first real request
         at steady-state latency instead of burying it under compiles."""
+        self._prewarm_compile()
+        self._seed_admission_emas()
+
+    def _prewarm_compile(self):
         from ray_tpu.llm import SamplingParams
 
         self.engine.generate([1, 2, 3], SamplingParams(max_tokens=2, temperature=0.0))
+
+    def _prewarm_probe(self):
+        """One WARM tiny request (all programs already compiled) — the
+        admission plane's steady-state yardstick."""
+        from ray_tpu.llm import SamplingParams
+
+        self.engine.generate([1, 2, 3], SamplingParams(max_tokens=2, temperature=0.0))
+
+    def _seed_admission_emas(self):
+        """Admission cold-start fix: the compile-heavy prewarm request
+        reads as a multi-second service time (the est-queue-wait cap
+        would shed everything until the EMA decays), and with no samples
+        at all the EMAs sit at 0 (the cap is vacuous until the first
+        finish). Reset both EMAs and re-measure ONE warm probe request,
+        so the first real admission decision sees steady-state numbers —
+        the probe's on_finish/on_emit stamps seed service and ITL
+        directly (an EMA at 0 adopts its first sample)."""
+        tel = getattr(self.engine, "_tel", None)
+        if tel is None:
+            return
+        tel.itl_ema_s = 0.0
+        tel.service_ema_s = 0.0
+        self._prewarm_probe()
 
     def check_health(self):
         """Serve health hook: a dead stepper means a dead engine."""
@@ -155,6 +197,18 @@ class LLMServer:
                 self._work.clear()
                 continue
             try:
+                # preemption notice (SIGTERM-with-deadline, chaos-shaped):
+                # a DROP rule delivers the notice and the replica starts
+                # evacuating via live migration from a side thread — the
+                # stepper keeps ticking until drain() stops it, exactly
+                # like a real signal handler; a raises rule escalates to
+                # SIGKILL semantics (stepper dies, no grace). Inert
+                # one-flag check unarmed.
+                if not chaos.apply("serve.preempt"):
+                    if not self._admission.draining:
+                        threading.Thread(
+                            target=self.preempt, daemon=True, name="llm-preempt"
+                        ).start()
                 # chaos plane: a delay rule stalls this replica's decode
                 # ticks, a drop rule skips them (a stall without sleeping
                 # inside the rule), a raises rule kills the stepper
@@ -253,7 +307,9 @@ class LLMServer:
         handoff path, and the prefill replica's handoff wait)."""
         ev = threading.Event()
         with self._lock:
-            if rid in self._done:  # finished before we registered (tiny prompts)
+            # finished (tiny prompts) or failed/migrated before we
+            # registered: don't wait for an event nobody will set
+            if rid in self._done or rid in self._errors:
                 ev.set()
             self._events[rid] = ev
         self._work.set()
@@ -275,13 +331,29 @@ class LLMServer:
             with self._lock:  # reap bookkeeping (completion may have raced)
                 self._events.pop(rid, None)
                 self._done.pop(rid, None)
+                self._errors.pop(rid, None)
             raise TimeoutError(f"generation {rid} timed out after {timeout_s}s")
         with self._lock:
             self._events.pop(rid, None)
+            err = self._errors.pop(rid, None)
             out = self._done.pop(rid, None)
+        if err is not None:
+            # per-request typed failure (live migration's resume signal,
+            # the preemption abort fallback) — not a server fault
+            raise err
         if out is None:
             raise RuntimeError(f"llm stepper died:\n{self._stepper_error or 'unknown'}")
         return out
+
+    def _fail_waiter(self, rid: str, exc: BaseException) -> None:
+        """Deliver ONE request's typed failure to its blocked waiter
+        (the per-request flavor of _fail_all_waiters: live migration
+        hands each evacuated request its own RequestMigratedError)."""
+        with self._lock:
+            self._errors[rid] = exc
+            ev = self._events.get(rid)
+        if ev is not None:
+            ev.set()
 
     def _admit(self, prompt_token_ids, params) -> str:
         """Admission seam: monolithic replicas prefill locally; the
@@ -346,49 +418,85 @@ class LLMServer:
         if pending or self.engine.has_unfinished():
             self._fail_all_waiters("replica shut down (stepper stopped) with requests in flight")
 
-    def drain(self, timeout_s: float = 30.0) -> dict:
+    def drain(self, timeout_s: float = 30.0, mode: str = "abort") -> dict:
         """Graceful drain, the replica's half of fleet failover:
 
         1. stop admitting — new requests shed with ReplicaDrainingError
            (a 429 subclass: routers fail over, clients back off);
-        2. finish in-flight work, bounded by ``timeout_s`` (whatever is
-           left past the deadline is aborted with its typed reason);
+        2. settle in-flight work. ``mode="abort"`` (default) finishes it
+           bounded by ``timeout_s`` and aborts whatever is left past the
+           deadline; ``mode="migrate"`` EVACUATES instead: the stepper
+           stops, every in-flight request's live decode state is
+           checkpointed and published over the object plane
+           (llm/migrate.py), and each waiter gets a typed
+           RequestMigratedError carrying (meta, ref) — the routers'
+           resume-on-peer leg splices it with ZERO recomputed tokens.
+           Whatever cannot checkpoint (streams, prefill stubs, sampled
+           cold requests, post-deadline stragglers) aborts with a typed
+           429 so the router re-prefills — the degradation order is
+           migrate -> re-prefill -> typed error;
         3. release owned resources while the process is still healthy:
            stashed handoff blocks drop, and a cluster-KV-plane replica
            unregisters every published prefix from the index and frees
-           the owned blocks (route dies before the bytes — nobody can
-           fetch from a replica that is about to exit);
+           the owned blocks (route dies before the bytes). Published
+           live_state checkpoints are deliberately NOT freed — a peer
+           must still fetch them; they die with this process (a fetch
+           losing that race sees MigrationLostError, and the leak
+           backstop reclaims never-fetched ones);
         4. stop the stepper (shutdown()).
 
+        Idempotent: a second drain (controller retrying its shutdown
+        hook, a preemption racing a manual drain) returns the first
+        drain's record with ``repeated=True`` — never a double-free.
         Serve's graceful teardown calls this through the replica's
         shutdown hook; it is also directly callable for planned
-        rebalancing. Returns what was drained."""
+        rebalancing. Returns what was drained/migrated."""
+        if mode not in ("abort", "migrate"):
+            raise ValueError(f"drain mode must be 'abort' or 'migrate', got {mode!r}")
+        with self._drain_lock:
+            if self._drain_result is not None:
+                return dict(self._drain_result, repeated=True)
+            res = self._drain_once(timeout_s, mode)
+            self._drain_result = res
+            return dict(res)
+
+    def _drain_once(self, timeout_s: float, mode: str) -> dict:
         from ray_tpu.serve.overload import wait_for_drain
 
+        deadline = time.time() + timeout_s
         self._admission.drain()
-        finished = wait_for_drain(self, timeout_s=timeout_s)
+        migrated: list = []
         aborted = 0
-        if not finished:
-            # deadline passed with work still in flight: stop the stepper
-            # FIRST (joins any in-progress step — no concurrent stepping),
-            # abort what's left, then run ONE cleanup step ourselves so
-            # the aborted finals publish through the normal path and
-            # blocked waiters wake NOW instead of riding out their own
-            # timeouts (abort outputs only surface via the next step)
+        if mode == "migrate":
+            # evacuation: stop the stepper FIRST (quiescent engine under
+            # us), then checkpoint + publish every in-flight request and
+            # hand its waiter the typed resume signal
             self._stop_stepper()
-            try:
-                with self.engine._lock:
-                    rids = [rid for rid, st in self.engine._requests.items() if not st.finished]
-                for rid in rids:
-                    aborted += bool(self.engine.abort_request(rid))
-                self._deliver_outputs(self.engine.step())
-            except Exception:  # noqa: BLE001 — drain is BEST-EFFORT: the
-                # likeliest reason the deadline passed is a broken engine,
-                # and the resource release below must still run; fail any
-                # still-blocked waiters exactly like the stepper-death path
-                import traceback
+            migrated, aborted = self._migrate_inflight(deadline)
+            finished = aborted == 0
+        else:
+            finished = wait_for_drain(self, timeout_s=timeout_s)
+            if not finished:
+                # deadline passed with work still in flight: stop the stepper
+                # FIRST (joins any in-progress step — no concurrent stepping),
+                # abort what's left, then run ONE cleanup step ourselves so
+                # the aborted finals publish through the normal path and
+                # blocked waiters wake NOW instead of riding out their own
+                # timeouts (abort outputs only surface via the next step)
+                self._stop_stepper()
+                try:
+                    with self.engine._lock:
+                        rids = [rid for rid, st in self.engine._requests.items() if not st.finished]
+                    for rid in rids:
+                        aborted += bool(self.engine.abort_request(rid))
+                    self._deliver_outputs(self.engine.step())
+                except Exception:  # noqa: BLE001 — drain is BEST-EFFORT: the
+                    # likeliest reason the deadline passed is a broken engine,
+                    # and the resource release below must still run; fail any
+                    # still-blocked waiters exactly like the stepper-death path
+                    import traceback
 
-                self._fail_all_waiters(traceback.format_exc())
+                    self._fail_all_waiters(traceback.format_exc())
         released = self.engine.release_handoffs()
         plane = getattr(self.engine, "_kv_plane", None)
         unregistered = plane.shutdown() if plane is not None else 0
@@ -396,10 +504,97 @@ class LLMServer:
         self.shutdown()
         return {
             "drained": True,
+            "mode": mode,
             "inflight_finished": finished,
             "aborted": aborted,
+            "migrated": migrated,
             "handoffs_released": released,
             "kvplane_keys_unregistered": unregistered,
+        }
+
+    def _migrate_inflight(self, deadline: float) -> tuple:
+        """Checkpoint + publish every in-flight request (waiters get the
+        typed resume signal); abort with a typed 429 is the per-request
+        fallback. The stepper is already stopped — the engine is
+        quiescent under us. Returns ([{request_id, meta, ref}], n_aborted)."""
+        from ray_tpu.llm import migrate as _mig
+
+        eng = self.engine
+        with eng._lock:
+            rids = [rid for rid, st in eng._requests.items() if not st.finished]
+        migrated: list = []
+        aborted = 0
+        for rid in rids:
+            err = None
+            if time.time() < deadline:
+                try:
+                    state = eng.checkpoint_request(rid)
+                    meta, ref = _mig.publish(state)
+                    err = _mig.RequestMigratedError(rid, meta, ref)
+                except Exception:  # noqa: BLE001 — abort is the fallback leg
+                    err = None
+            if err is not None:
+                migrated.append({"request_id": rid, "meta": err.migration_meta,
+                                 "ref": err.migration_ref})
+                eng.finish_migrated(rid)
+                self._fail_waiter(rid, err)
+            else:
+                aborted += 1
+                tel = getattr(eng, "_tel", None)
+                if tel is not None:
+                    tel.on_migration("aborted")
+                eng.abort_request(rid)
+                # a typed 429 (not a partial result): the router's
+                # re-prefill leg replays the whole request on a peer
+                self._fail_waiter(rid, ReplicaDrainingError(
+                    "replica preempted before this request could checkpoint; "
+                    "re-prefill on a peer", retry_after_s=1.0,
+                ))
+        # one cleanup step publishes the evacuated finals through the
+        # normal path (streams get their sentinels); waiters already woke
+        # with their typed errors
+        try:
+            self._deliver_outputs(self.engine.step())
+        except Exception:  # noqa: BLE001 — best-effort, like the abort drain
+            import traceback
+
+            self._fail_all_waiters(traceback.format_exc())
+        return migrated, aborted
+
+    def preempt(self, deadline_s: float | None = None) -> dict:
+        """Preemption notice: the SIGTERM-with-deadline a TPU fleet's
+        preemptible capacity actually delivers. Evacuates via
+        drain(mode="migrate") bounded by the deadline
+        (LLMConfig.preempt_deadline_s by default); driven by the
+        ``serve.preempt`` chaos site in tests and callable directly by a
+        real signal handler."""
+        d = self._preempt_deadline_s if deadline_s is None else float(deadline_s)
+        return self.drain(timeout_s=d, mode="migrate")
+
+    def resume_from_migration(self, meta: dict, ref, sampling_params: dict | None = None,
+                              timeout_s: float = 300.0) -> dict:
+        """Peer-side splice of a migrated request (llm/migrate.py): fetch
+        the published checkpoint (bounded retry — a dead owner raises
+        MigrationLostError, the router's signal to re-prefill), restore
+        it into this replica's engine, and decode to completion. The
+        returned token_ids are the FULL stream (pre-splice + new): the
+        client sees one uninterrupted result."""
+        from ray_tpu.llm import migrate as _mig
+
+        self._check_alive()
+        # shed BEFORE borrowing the checkpoint: an overloaded peer must
+        # bounce the router onward without touching the block ("no peer
+        # admits them" spends the router's RetryBudget into the abort leg)
+        self._admission.check(int((sampling_params or {}).get("priority", 0)))
+        state = _mig.fetch(ref, meta)
+        rid = self.engine.restore_request(state)
+        self._work.set()
+        out = self._await_finished(rid, timeout_s)
+        return {
+            "request_id": out.request_id,
+            "prompt_token_ids": out.prompt_token_ids,
+            "token_ids": out.token_ids,
+            "finish_reason": out.finish_reason,
         }
 
     def __del__(self):
@@ -582,8 +777,11 @@ class PrefillServer(LLMServer):
         kwargs.setdefault("enable_prefix_caching", False)  # stateless by default
         super().__init__(_replace(llm_config, engine_kwargs=kwargs))
 
-    def _prewarm(self):
+    def _prewarm_compile(self):
         # a prefill replica's hot path is prefill + extract, not decode
+        self.engine.prefill_handoff([1, 2, 3])
+
+    def _prewarm_probe(self):
         self.engine.prefill_handoff([1, 2, 3])
 
     def prefill(self, prompt_token_ids, timeout_s: float = 180.0) -> dict:
@@ -631,12 +829,12 @@ class DecodeServer(LLMServer):
         super().__init__(llm_config)
         self.prefill_handle = prefill_handle
 
-    def _prewarm(self):
-        super()._prewarm()
+    def _prewarm_compile(self):
+        super()._prewarm_compile()
         # warm the handoff admission path too: extract a local block and
         # scatter it back in, compiling the fused scatter-in and the
         # first-token sample for the smallest bucket before the replica
-        # reports RUNNING
+        # reports RUNNING (the EMA probe then re-measures warm)
         from ray_tpu.llm import SamplingParams
 
         kv = self.engine.prefill_handoff([1, 2, 3])
@@ -699,8 +897,14 @@ class DisaggRouterServer:
         def _decode(meta, ref, prompt, sp):
             return decode_handle.generate_from_handoff.remote(meta, ref, sp).result(timeout_s=600.0)
 
+        def _resume(meta, ref, sp):
+            # resume-on-peer (llm/migrate.py): the pow-2 pick may land on
+            # the draining replica again — it sheds typed and the
+            # router's budgeted loop retries
+            return decode_handle.resume_from_migration.remote(meta, ref, sp).result(timeout_s=600.0)
+
         self.router = DisaggRouter(
-            _prefill, _decode, max_attempts=max_attempts,
+            _prefill, _decode, resume=_resume, max_attempts=max_attempts,
             telemetry_tags={"model": llm_config.model_id},
         )
 
@@ -859,9 +1063,15 @@ class KVRouterServer:
         def _submit(replica_id, prompt, sp):
             return handles[replica_id].generate.remote(prompt, sp).result(timeout_s=600.0)
 
+        def _resume_submit(replica_id, meta, ref, sp):
+            # resume-on-peer (llm/migrate.py): splice a preempted
+            # replica's checkpoint on the next-ranked replica
+            return handles[replica_id].resume_from_migration.remote(meta, ref, sp).result(timeout_s=600.0)
+
         self.router = CacheAwareRouter(
             index_handle, _submit, names, block=block,
             cache_weight=cache_weight, load_weight=load_weight, max_attempts=max_attempts,
+            resume_submit=_resume_submit,
             telemetry_tags={"model": llm_config.model_id},
         )
 

@@ -40,9 +40,20 @@ stepper exits (``serve/llm.py LLMServer.drain``).
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from dataclasses import dataclass
+
+# Jitter for 429 retry hints: every shed client sleeping EXACTLY
+# retry_after_s re-arrives as one synchronized herd and re-saturates the
+# replica it just backed off from; ±25% spread de-phases them. A
+# dedicated seeded RNG (never the global one) keeps shed behavior
+# independent of test/chaos seeding while staying deterministic per
+# process. Bounds (0.75x..1.25x the clamped estimate) are locked by
+# tests/test_llm_chaos.py.
+RETRY_JITTER_FRAC = 0.25
+_retry_jitter = random.Random(0x52455452)  # "RETR"
 
 
 class OverloadedError(RuntimeError):
@@ -275,7 +286,8 @@ class AdmissionController:
             if h is None:
                 h = self._b_shed[cls] = self._m_shed.bind({**self._tel.tags, "class": cls})
             h.inc(1.0)
-        retry = min(max(est_wait, 0.25), 30.0)
+        base = min(max(est_wait, 0.25), 30.0)
+        retry = base * (1.0 + _retry_jitter.uniform(-RETRY_JITTER_FRAC, RETRY_JITTER_FRAC))
         err_cls = ReplicaDrainingError if reason == "draining" else OverloadedError
         # shed_class carries the CLAMPED class (what the admission
         # arithmetic used) so routers re-counting the shed label it

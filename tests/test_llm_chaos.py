@@ -629,6 +629,350 @@ def test_chaos_index_death_breaker_and_recovery_over_serve_classes(params, rt, o
             srv2.shutdown()
 
 
+# -------------------------------------------------- preemption & migration
+
+
+def _kv_router_pair(params, **sp_defaults):
+    """Two LLMServer replicas behind a CacheAwareRouter with BOTH legs
+    wired (submit + resume_submit) — the chaos preemption suite's
+    standard fleet. r0 gets the traffic; r1 idles (an idle stepper never
+    reaches the chaos sites, so the preemption notice lands on r0
+    deterministically)."""
+    from ray_tpu.llm.kvplane import CacheAwareRouter, PrefixIndex
+
+    srv0, srv1 = LLMServer(_cfg(params, **sp_defaults)), LLMServer(_cfg(params, **sp_defaults))
+    handles = {"r0": srv0, "r1": srv1}
+
+    def submit(rid, prompt, sp):
+        return handles[rid].generate(prompt, sp, timeout_s=120.0)
+
+    def resume_submit(rid, meta, ref, sp):
+        return handles[rid].resume_from_migration(meta, ref, sp, timeout_s=120.0)
+
+    router = CacheAwareRouter(
+        PrefixIndex(), submit, ["r0", "r1"], max_attempts=3, resume_submit=resume_submit,
+    )
+    return srv0, srv1, router
+
+
+def _wait_tokens(srv, n, deadline_s=30.0):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        with srv.engine._lock:
+            sts = [s for s in srv.engine._requests.values() if not s.finished]
+        if sts and all(len(s.token_ids) >= n for s in sts):
+            return
+        time.sleep(0.003)
+    raise AssertionError(f"replica never reached {n} tokens in flight")
+
+
+@pytest.mark.chaos
+@pytest.mark.migrate
+def test_chaos_preempt_migrates_inflight_to_peer(params, rt, oracle):
+    """The serve.preempt site end to end: a preemption notice lands on
+    the replica actively decoding two requests; drain(mode='migrate')
+    checkpoints BOTH mid-decode, each waiter gets the typed
+    RequestMigratedError, the router splices both checkpoints on the
+    peer, and the clients see byte-identical streams with zero
+    duplicated/dropped tokens at the splice. Bounded wall, zero hangs,
+    and the surviving pool passes the no-silent-corruption re-check."""
+    from ray_tpu.llm.migrate import RequestMigratedError
+
+    srv0, srv1, router = _kv_router_pair(params)
+    try:
+        sp = {"max_tokens": 16, "temperature": 0.0}
+        want = oracle["run"](PROMPT, SamplingParams(max_tokens=16, temperature=0.0))
+        want2 = oracle["run"](SHARED, SamplingParams(max_tokens=16, temperature=0.0))
+        results = {}
+
+        def client_router():
+            # leg 1: the ROUTER handles the whole failover
+            results["a"] = router.generate(list(PROMPT), dict(sp))
+
+        def client_direct():
+            # leg 2: a bare client sees the typed resume signal itself
+            # (the load-balancing tie-break would route a second router
+            # request to the idle peer, so this one pins srv0 directly)
+            try:
+                results["b"] = srv0.generate(list(SHARED), dict(sp), timeout_s=120.0)
+            except Exception as e:  # noqa: BLE001
+                results["b"] = e
+
+        th1 = threading.Thread(target=client_router)
+        th2 = threading.Thread(target=client_direct)
+        th1.start(), th2.start()
+        _wait_tokens(srv0, 4)
+        # the preemption notice: SIGTERM-with-deadline, delivered once
+        chaos.inject("serve.preempt", drop_prob=1.0, max_hits=1)
+        t0 = time.perf_counter()
+        th1.join(timeout=120), th2.join(timeout=120)
+        chaos.clear()
+        assert not th1.is_alive() and not th2.is_alive(), "clients hung across preemption"
+        assert time.perf_counter() - t0 < 120.0
+        # router leg: spliced on the peer, byte-identical, zero dup/drop
+        assert results["a"]["token_ids"] == want
+        st = router.stats()
+        assert st["migrations"] == 1 and st["resumed"] == 1, st
+        # direct leg: the waiter got the typed signal with a live ref and
+        # the peer splices it token-identically
+        err = results["b"]
+        assert isinstance(err, RequestMigratedError), err
+        out2 = srv1.resume_from_migration(err.migration_meta, err.migration_ref, dict(sp))
+        assert out2["token_ids"] == want2
+        assert out2["token_ids"][: err.migration_meta["emitted"]] == want2[: err.migration_meta["emitted"]]
+        assert not srv0._stepper.is_alive()  # the replica actually died
+        # evacuation accounting on the source replica
+        snap = srv0.engine.telemetry()
+        assert sum(1 for r in snap["requests"] if r["reason"] == "migrated") == 2
+        # no silent corruption: the surviving peer still matches the oracle
+        out = srv1.generate(list(PROMPT), {"max_tokens": SP.max_tokens}, timeout_s=120.0)
+        assert out["token_ids"] == oracle["prompt"]
+        # and the dead replica sheds typed (a router retry fails over)
+        with pytest.raises(ReplicaDrainingError):
+            srv0.generate(list(PROMPT), {"max_tokens": 2})
+    finally:
+        srv0.shutdown()
+        srv1.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.migrate
+def test_chaos_preempt_seeded_and_checkpoint_lost(params, rt, oracle):
+    """Seeded sampling migrates token-identically (the ADVANCED key
+    rides the checkpoint), and a checkpoint lost before the fetch
+    degrades to re-prefill — token-identical for a seeded request (the
+    replay re-derives from the seed) — inside the same retry budget."""
+    srv0, srv1, router = _kv_router_pair(params)
+    try:
+        seeded = SamplingParams(max_tokens=12, temperature=0.8, seed=5, top_k=16)
+        want = oracle["run"](PROMPT, seeded)
+        sp = {"max_tokens": 12, "temperature": 0.8, "seed": 5, "top_k": 16}
+        results = {}
+
+        def client():
+            results["out"] = router.generate(list(PROMPT), dict(sp))
+
+        th = threading.Thread(target=client)
+        th.start()
+        _wait_tokens(srv0, 4)
+        chaos.inject("serve.preempt", drop_prob=1.0, max_hits=1)
+        th.join(timeout=120)
+        chaos.clear()
+        assert not th.is_alive()
+        assert results["out"]["token_ids"] == want
+        assert router.stats()["resumed"] == 1
+
+        # second round on the survivor pair: this time the checkpoint is
+        # LOST at the object plane before the peer can fetch it — the
+        # router's resume leg degrades to a full re-prefill, which for a
+        # seeded request replays to the identical stream
+        srv2 = LLMServer(_cfg(params))
+        handles2 = {"r0": srv1, "r1": srv2}
+
+        def submit(rid, prompt, p):
+            return handles2[rid].generate(prompt, p, timeout_s=120.0)
+
+        def resume_submit(rid, meta, ref, p):
+            return handles2[rid].resume_from_migration(meta, ref, p, timeout_s=120.0)
+
+        router2 = CacheAwareRouter(
+            PrefixIndex(), submit, ["r0", "r1"], max_attempts=3, resume_submit=resume_submit,
+        )
+        try:
+            results2 = {}
+
+            def client2():
+                results2["out"] = router2.generate(list(PROMPT), dict(sp))
+
+            th2 = threading.Thread(target=client2)
+            th2.start()
+            _wait_tokens(srv1, 4)
+            chaos.inject("direct.get_owned_view", raises=ObjectLostError, max_hits=8)
+            chaos.inject("serve.preempt", drop_prob=1.0, max_hits=1)
+            t0 = time.perf_counter()
+            th2.join(timeout=120)
+            chaos.clear()
+            assert not th2.is_alive(), "client hung on a lost checkpoint"
+            assert time.perf_counter() - t0 < 120.0
+            assert results2["out"]["token_ids"] == want  # re-prefill replayed the seed
+            assert router2.stats()["migrations"] == 1 and router2.stats()["resumed"] == 0
+        finally:
+            srv2.shutdown()
+    finally:
+        srv0.shutdown()
+        srv1.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.migrate
+def test_preempt_deadline_zero_aborts_typed(params, rt, oracle):
+    """A preemption whose deadline already passed checkpoints NOTHING:
+    every in-flight request aborts with a typed 429 (ReplicaDrainingError
+    — the router's re-prefill signal), never a partial result and never
+    a hang; the oracle-identical completion lands on the peer."""
+    srv0, srv1, router = _kv_router_pair(params)
+    try:
+        results = {}
+
+        def client():
+            results["out"] = router.generate(list(PROMPT), {"max_tokens": 16, "temperature": 0.0})
+
+        th = threading.Thread(target=client)
+        th.start()
+        _wait_tokens(srv0, 2)
+        t0 = time.perf_counter()
+        res = srv0.preempt(deadline_s=0.0)  # SIGTERM with no grace left
+        th.join(timeout=120)
+        assert not th.is_alive()
+        assert time.perf_counter() - t0 < 60.0
+        assert res["mode"] == "migrate" and res["aborted"] == 1 and res["migrated"] == []
+        want = oracle["run"](PROMPT, SamplingParams(max_tokens=16, temperature=0.0))
+        assert results["out"]["token_ids"] == want  # re-prefilled on the peer
+        assert router.stats()["resumed"] == 0
+    finally:
+        srv0.shutdown()
+        srv1.shutdown()
+
+
+def test_drain_and_release_handoffs_idempotent(params, rt):
+    """Calling drain() twice (a controller retrying its shutdown hook
+    races the stepper) and release_handoffs() twice must be no-ops, not
+    double-frees: the second drain returns the first record with
+    ``repeated=True``, the index sees exactly ONE drop_replica, and the
+    plane client never re-frees its owned blocks."""
+    idx = PrefixIndex(ttl_s=30.0)
+    calls = {"drop": 0}
+    real_drop = idx.drop_replica
+
+    def counting_drop(replica):
+        calls["drop"] += 1
+        return real_drop(replica)
+
+    idx.drop_replica = counting_drop
+    plane = KVPlaneClient(idx, "idem0", publish_min_hits=1)
+    srv = KVPlaneServer(
+        LLMConfig(
+            model_config=CFG, params=params, prewarm=False,
+            engine_kwargs={"max_num_seqs": 2, "max_seq_len": 128, "kv_plane": plane},
+        ),
+        idx, "idem0",
+    )
+    out = srv.generate(list(SHARED), {"max_tokens": 4}, timeout_s=120.0)
+    assert out["finish_reason"] in ("length", "stop")
+    # engine-side: release_handoffs twice is (count, then 0), never an error
+    with srv.engine._lock:
+        srv.engine._handoffs["stash"] = {"k": None}  # a stranded stash
+    assert srv.engine.release_handoffs() == 1
+    assert srv.engine.release_handoffs() == 0  # idempotent
+    first = srv.drain(timeout_s=30.0)
+    freed_once = plane.counts["unpublished_blocks"]
+    second = srv.drain(timeout_s=30.0)
+    assert second.get("repeated") is True and second["drained"]
+    assert calls["drop"] == 1, "second drain re-dropped the replica at the index"
+    assert plane.counts["unpublished_blocks"] == freed_once, "double-free of owned blocks"
+    assert plane.shutdown() == 0  # the client's own second shutdown is a no-op
+    assert first["kvplane_keys_unregistered"] >= 1
+
+
+def test_retry_after_jitter_bounds(params):
+    """OverloadedError.retry_after_s is jittered ±25% (seeded) so a shed
+    herd's synchronized retries don't re-saturate the replica: every
+    hint stays inside [0.75, 1.25] x the clamped estimate, and the
+    spread is real (not a constant)."""
+    eng = LLMEngine(CFG, params, max_num_seqs=1, max_seq_len=128)
+    eng._tel.service_ema_s = 10.0
+    for _ in range(2):
+        eng.add_request(list(PROMPT), SamplingParams(max_tokens=2))
+    ac = AdmissionController(eng, AdmissionConfig(max_queue_depth=100, max_queue_wait_s=5.0))
+    base = ac.estimate_queue_wait_s()  # 2 * 10 / 1 = 20, clamped base
+    base = min(max(base, 0.25), 30.0)
+    hints = []
+    for _ in range(40):
+        with pytest.raises(OverloadedError) as ei:
+            ac.check(0)
+        hints.append(ei.value.retry_after_s)
+    assert all(0.75 * base - 1e-9 <= h <= 1.25 * base + 1e-9 for h in hints), hints
+    assert len(set(round(h, 6) for h in hints)) > 1, "jitter is not live"
+    assert max(hints) - min(hints) > 0.01 * base
+
+
+def test_admission_cold_start_seeded_from_prewarm(params):
+    """Admission cold-start: prewarm's compile-heavy request must not
+    poison the service-time EMA (a multi-second 'service time' would
+    shed everything through the est-queue-wait cap), and after prewarm
+    the EMAs are WARM-seeded, so the wait cap is live from the first
+    real request instead of vacuous."""
+    srv = LLMServer(
+        LLMConfig(model_config=CFG, params=params, prewarm=True,
+                  engine_kwargs={"max_num_seqs": 2, "max_seq_len": 128})
+    )
+    try:
+        tel = srv.engine._tel
+        assert tel.service_ema_s > 0.0, "EMA unseeded after prewarm (wait cap vacuous)"
+        assert tel.itl_ema_s > 0.0
+        assert tel.service_ema_s < 2.0, (
+            f"EMA poisoned by compile time: {tel.service_ema_s:.2f}s"
+        )
+        # a compile-scale EMA injected later is RESET by the seeding path
+        tel.service_ema_s = 100.0
+        srv._seed_admission_emas()
+        assert 0.0 < tel.service_ema_s < 2.0
+        # the cap is live, not shedding: an idle replica admits
+        srv._admission.check(0)
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.chaos
+def test_chaos_index_restart_repopulates_via_heartbeat(params, rt, oracle):
+    """Kill and restart a BLANK KVIndexServer mid-traffic: the restarted
+    index knows nobody, the publisher's heartbeat sees fewer keys than
+    it holds (the key-count path) and re-registers every live block,
+    and the peer replica gets REMOTE-tier hits again — full recovery
+    without any republish traffic from scratch."""
+    from ray_tpu.llm.kvplane import PrefixIndex as _PI
+    from ray_tpu.serve.llm import KVIndexServer
+
+    isrv = KVIndexServer(ttl_s=60.0)
+    plane = KVPlaneClient(isrv, "ir0", publish_min_hits=1, heartbeat_every_s=1e6)
+    srv = KVPlaneServer(
+        LLMConfig(
+            model_config=CFG, params=params, prewarm=False,
+            engine_kwargs={"max_num_seqs": 2, "max_seq_len": 128, "kv_plane": plane},
+        ),
+        isrv, "ir0",
+    )
+    srv2 = None
+    try:
+        out = srv.generate(list(SHARED), {"max_tokens": SP.max_tokens}, timeout_s=120.0)
+        assert out["token_ids"] == oracle["shared"]
+        keys_before = isrv.stats()["keys"]
+        assert keys_before >= 1
+        # mid-traffic restart: the deployment handle survives, its state
+        # blanks — exactly a controller replacing a dead index replica
+        isrv.index = _PI(ttl_s=60.0)
+        assert isrv.stats()["keys"] == 0
+        # the heartbeat's key count (0 < published) triggers re-registration
+        plane._last_heartbeat = 0.0
+        plane.maybe_heartbeat()
+        assert isrv.stats()["keys"] == keys_before, "re-registration never happened"
+        # the peer now gets a remote-tier hit off the repopulated index
+        srv2 = KVPlaneServer(
+            LLMConfig(
+                model_config=CFG, params=params, prewarm=False,
+                engine_kwargs={"max_num_seqs": 2, "max_seq_len": 128},
+            ),
+            isrv, "ir1", publish_min_hits=1,
+        )
+        out = srv2.generate(list(SHARED), {"max_tokens": SP.max_tokens}, timeout_s=120.0)
+        assert out["token_ids"] == oracle["shared"]
+        assert srv2.kvplane_stats()["remote"]["hits"] == 1
+    finally:
+        srv.shutdown()
+        if srv2 is not None:
+            srv2.shutdown()
+
+
 @pytest.mark.chaos
 def test_chaos_index_delay_bounded_by_engine_paths(params, rt, oracle):
     """A slow (not dead) index: delay rules on the index RPCs must only
