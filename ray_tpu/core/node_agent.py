@@ -258,7 +258,7 @@ def agent_entry(
                 # reconnect the head wouldn't know this worker anyway
                 try:
                     proc.terminate()
-                    proc.join(timeout=2.0)  # reap: no zombie either
+                    proc.join(timeout=2.0)  # reap: no zombie either  # tpulint: disable=CCR001 — bounded 2s reap; the raced-drain worker must be gone before the registry is released
                 except Exception:
                     pass
                 try:

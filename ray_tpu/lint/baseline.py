@@ -7,17 +7,22 @@ Format (JSON, sorted, diff-friendly):
       "version": 1,
       "tool": "tpulint",
       "entries": {
-        "<sha1[:16]>": {"rule": "TPL004", "path": "ray_tpu/core/x.py",
-                         "context": "Cls.meth", "message": "...", "count": 2}
+        "<sha1[:16]>": {"rule": "CCR001", "path": "ray_tpu/core/x.py",
+                         "context": "Cls.meth", "message": "...", "count": 2,
+                         "why": "deliberate: <justification>"}
       }
     }
 
 ``count`` is how many identical (rule, path, context, message) findings
 are accepted: a new duplicate of an accepted finding still fails the
-check. Fingerprints exclude line numbers, so edits elsewhere in a file
-never churn the baseline; a stale entry (finding fixed — fully or just
-part of its accepted count) is reported so the baseline shrinks over
-time instead of fossilizing into silent headroom for reintroductions.
+check. ``why`` is the hand-written justification for accepting the
+hazard — required by policy for every entry, preserved verbatim across
+``--update-baseline`` runs. Fingerprints exclude line numbers, so edits
+elsewhere in a file never churn the baseline; a stale entry (finding
+fixed — fully or just part of its accepted count) is reported so the
+baseline shrinks over time instead of fossilizing into silent headroom
+for reintroductions. Entries keyed under a retired alias id (TPL004 ->
+CCR006) keep suppressing their finding under the successor id.
 """
 
 from __future__ import annotations
@@ -25,9 +30,24 @@ from __future__ import annotations
 import json
 import os
 from collections import Counter
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
-from ray_tpu.lint.engine import Finding
+from ray_tpu.lint.engine import Finding, RULE_ALIASES
+
+# canonical rule id -> retired alias ids whose fingerprints still count:
+# a baseline accepted under TPL004 keeps suppressing the same finding now
+# reported as CCR006, so absorbing a rule never churns committed baselines
+_ALIASES_OF: dict[str, list[str]] = {}
+for _old, _new in RULE_ALIASES.items():
+    _ALIASES_OF.setdefault(_new, []).append(_old)
+
+
+def candidate_fingerprints(f: Finding) -> list[str]:
+    """The finding's own fingerprint, then fingerprints it would have had
+    under any retired alias id of its rule."""
+    return [f.fingerprint()] + [
+        replace(f, rule=old).fingerprint() for old in _ALIASES_OF.get(f.rule, ())
+    ]
 
 
 @dataclass
@@ -49,7 +69,11 @@ def load(path: str) -> dict[str, dict]:
     return dict(doc.get("entries", {}))
 
 
-def entries_from_findings(findings: list[Finding]) -> dict[str, dict]:
+def entries_from_findings(findings: list[Finding], prior: dict[str, dict] | None = None) -> dict[str, dict]:
+    """Baseline entries for ``findings``. When ``prior`` entries are
+    given, hand-written ``why`` justifications are carried over (matched
+    by fingerprint, alias fingerprints included) so ``--update-baseline``
+    never silently discards the documented reason an entry exists."""
     counts: Counter[str] = Counter(f.fingerprint() for f in findings)
     entries: dict[str, dict] = {}
     for f in findings:
@@ -62,6 +86,12 @@ def entries_from_findings(findings: list[Finding]) -> dict[str, dict]:
                 "message": f.message,
                 "count": counts[fp],
             }
+            if prior:
+                for cand in candidate_fingerprints(f):
+                    why = prior.get(cand, {}).get("why")
+                    if why is not None:
+                        entries[fp]["why"] = why
+                        break
     return entries
 
 
@@ -82,11 +112,12 @@ def diff(findings: list[Finding], entries: dict[str, dict]) -> BaselineDiff:
     budget = {fp: int(e.get("count", 1)) for fp, e in entries.items()}
     used: Counter[str] = Counter()
     for f in findings:
-        fp = f.fingerprint()
-        if budget.get(fp, 0) > 0:
-            budget[fp] -= 1
-            used[fp] += 1
-            out.suppressed += 1
+        for fp in candidate_fingerprints(f):
+            if budget.get(fp, 0) > 0:
+                budget[fp] -= 1
+                used[fp] += 1
+                out.suppressed += 1
+                break
         else:
             out.new.append(f)
     # stale includes PARTIALLY-fixed entries: leaving an unused budget of

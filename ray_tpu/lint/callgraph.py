@@ -1,16 +1,33 @@
-"""Module-level call graph for interprocedural AST rules.
+"""Module-level call graph + blocking-call classifier for
+interprocedural AST rules.
 
-One level deep, by design: rules that follow a call resolve it to a
-definition in the SAME module (bare ``helper(...)`` to a module-level
-def) and inspect that body lexically — they do not chase further calls.
-That catches the dominant refactor pattern (hazard hoisted into a local
-helper, invisible to a purely lexical rule) without building a whole-
-program analysis whose approximations would drown the signal.
+Resolution covers the two intra-module call shapes that matter:
+bare ``helper(...)`` to a module-level def, and ``self.meth(...)`` to a
+method of the enclosing class. ``blocking_effects`` summarizes what a
+callee (transitively, to ``depth`` further resolutions — default 2)
+can block on, so a rule holding a lock-set at a call site can apply the
+classifier THROUGH local helpers without building a whole-program
+analysis whose approximations would drown the signal.
+
+The classifier (``classify_blocking`` / ``classify_device_sync``) is the
+single definition of "a call that can stall the caller" for the concur
+(CCR) rules, and it is deliberately domain-aware: beyond the generic
+shapes (``time.sleep``, thread ``.join()``, zero-arg ``.get()``/
+``.wait()`` without a timeout, ``ray.get``/``ray.wait``) it names this
+codebase's planes — direct-plane owned-object traffic
+(``put_owned``/``get_owned_view``/``free_owned``), index RPCs on
+plane/index/client receivers (``lookup``/``fetch``/``publish``/
+``register``/...), engine-lock entry points on engine receivers
+(``step``/``host_load``/the stats reads), and the device-sync shapes
+that force a host readback (``np.asarray``, ``jax.device_get``,
+``.item()``, ``.block_until_ready()``, ``float(x[i])``).
 """
 
 from __future__ import annotations
 
 import ast
+import re
+from dataclasses import dataclass, replace
 
 from ray_tpu.lint.engine import call_keyword, dotted
 
@@ -19,6 +36,23 @@ from ray_tpu.lint.engine import call_keyword, dotted
 # these, so the two passes cannot drift apart.
 BLOCKING_ATTRS = {"get", "wait"}
 BLOCKING_MODULES = {"ray", "ray_tpu", "rt"}
+
+# receivers that look like a KV-plane client / cluster index handle — an
+# attribute call on one of these is (or proxies) an RPC with a timeout,
+# never plain dict work
+_PLANE_RECV = re.compile(r"(plane|index|client|idx)$", re.IGNORECASE)
+_PLANE_ATTRS = {
+    "lookup", "fetch", "publish", "register", "unregister", "heartbeat",
+    "drop_replica", "report_lost", "match_replicas", "shutdown", "expire",
+}
+# direct-plane owned-object traffic blocks on transport regardless of
+# receiver spelling
+_DIRECT_PLANE_ATTRS = {"put_owned", "get_owned_view", "free_owned"}
+# engine entry points that acquire the ENGINE lock (held for whole
+# serving steps — seconds of prefill): calling one while holding another
+# lock nests lock waits invisibly to the lexical cycle rule
+_ENGINE_RECV = re.compile(r"(^|_)eng(ine)?$", re.IGNORECASE)
+_ENGINE_ATTRS = {"step", "host_load", "kv_cache_stats", "spec_stats", "prefix_cache_stats"}
 
 
 def blocking_ray_call(node: ast.Call) -> tuple[str, bool] | None:
@@ -33,24 +67,189 @@ def blocking_ray_call(node: ast.Call) -> tuple[str, bool] | None:
     return None
 
 
+@dataclass(frozen=True)
+class Effect:
+    """One way a call (or its transitive callees) can stall the caller.
+
+    ``chain`` is the resolved intermediate callees between the call site
+    a rule is looking at and the terminal blocking call (empty for a
+    direct hit); ``node`` is the terminal call's AST node (its file is
+    always the analyzed file — resolution never leaves the module);
+    ``recv`` is the terminal call's dotted receiver ("" for bare calls),
+    which CCR001 uses to exempt the condition-variable ``wait()``-on-
+    the-held-lock pattern."""
+
+    kind: str       # sleep | join | unbounded-get | unbounded-wait | ray-get |
+                    # plane | index-rpc | engine-call | device-sync
+    label: str      # human label, e.g. "self._kv_plane.lookup()"
+    recv: str       # dotted receiver of the terminal call ("" if none)
+    node: ast.Call
+    chain: tuple[str, ...] = ()
+    bounded: bool = False
+
+    def describe(self) -> str:
+        via = f" via {' -> '.join(self.chain)}" if self.chain else ""
+        return f"{self.label} [{self.kind}]{via}"
+
+
+def _is_thread_join(call: ast.Call) -> bool:
+    """``x.join()`` / ``x.join(5.0)`` / ``x.join(timeout=...)`` — a
+    thread-style join. ``sep.join(parts)`` (str.join) always passes a
+    non-numeric positional iterable, so it never matches."""
+    if len(call.args) > 1:
+        return False
+    if len(call.args) == 1:
+        a = call.args[0]
+        if not (isinstance(a, ast.Constant) and isinstance(a.value, (int, float))):
+            return False
+    return True
+
+
+def classify_blocking(call: ast.Call) -> Effect | None:
+    """Classify one call as a blocking shape (see module docstring), or
+    None. Device syncs are classified separately (classify_device_sync):
+    CCR001 (blocking under lock) and CCR002 (hot-path sync) own
+    different halves of the taxonomy."""
+    name = dotted(call.func)
+    if name == "time.sleep":
+        return Effect("sleep", "time.sleep()", "", call, bounded=True)
+    hit = blocking_ray_call(call)
+    if hit is not None:
+        return Effect("ray-get", f"{hit[0]}()", name.split(".")[0], call, bounded=hit[1])
+    if isinstance(call.func, ast.Name) and call.func.id == "index_call":
+        return Effect("index-rpc", "index_call()", "", call, bounded=True)
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    recv = dotted(call.func.value) or ""
+    rlast = recv.split(".")[-1] if recv else ""
+    label = f"{recv}.{attr}()" if recv else f".{attr}()"
+    if attr in _DIRECT_PLANE_ATTRS:
+        return Effect("plane", label, recv, call)
+    if attr == "join" and _is_thread_join(call):
+        # string-literal receivers are str.join even with 0 args
+        if isinstance(call.func.value, (ast.Constant, ast.JoinedStr)):
+            return None
+        return Effect("join", label, recv, call, bounded=bool(call.args or call.keywords))
+    if attr in ("get", "wait") and not call.args and call_keyword(call, "timeout") is None:
+        # zero-arg get/wait with no timeout: queue.get()/event.wait()
+        # block forever (dict.get/os.wait shapes all take positionals)
+        return Effect(f"unbounded-{attr}", label, recv, call)
+    if rlast and _PLANE_RECV.search(rlast) and attr in _PLANE_ATTRS:
+        return Effect("index-rpc", label, recv, call, bounded=True)
+    if rlast and _ENGINE_RECV.search(rlast) and attr in _ENGINE_ATTRS:
+        return Effect("engine-call", label, recv, call)
+    return None
+
+
+def classify_device_sync(call: ast.Call) -> Effect | None:
+    """Device-to-host sync shapes: the calls that force the host to wait
+    for device work (and pull bytes over PCIe/ICI). ``float(x[i])``
+    matches only a SUBSCRIPT argument — the scalar-readback idiom —
+    because ``float(name)`` over host state is everywhere and benign."""
+    name = dotted(call.func)
+    if name is not None:
+        parts = name.split(".")
+        if parts[0] in ("np", "numpy") and parts[-1] in ("asarray", "array"):
+            return Effect("device-sync", f"{name}()", "", call)
+        if name == "jax.device_get":
+            return Effect("device-sync", "jax.device_get()", "", call)
+    if isinstance(call.func, ast.Name) and call.func.id == "float":
+        if len(call.args) == 1 and isinstance(call.args[0], ast.Subscript):
+            sl = call.args[0].slice
+            # string-keyed subscripts are host dict lookups, not lanes
+            if not (isinstance(sl, ast.Constant) and isinstance(sl.value, str)):
+                return Effect("device-sync", "float(<subscript>)", "", call)
+    if isinstance(call.func, ast.Attribute):
+        recv = dotted(call.func.value) or ""
+        if call.func.attr == "item" and not call.args:
+            return Effect("device-sync", f"{recv}.item()" if recv else ".item()", recv, call)
+        if call.func.attr == "block_until_ready":
+            return Effect(
+                "device-sync", f"{recv}.block_until_ready()" if recv else ".block_until_ready()", recv, call
+            )
+    return None
+
+
 class CallGraph:
-    """Resolves intra-module calls and answers the per-callee questions
-    the interprocedural rules ask."""
+    """Resolves intra-module calls (module-level defs and same-class
+    methods) and answers the per-callee questions the interprocedural
+    rules ask."""
 
     def __init__(self, tree: ast.Module):
         self.module_fns: dict[str, ast.FunctionDef | ast.AsyncFunctionDef] = {}
+        self.methods: dict[tuple[str, str], ast.FunctionDef | ast.AsyncFunctionDef] = {}
+        self.owner_class: dict[int, str] = {}  # id(def node) -> class name
         for node in tree.body:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self.module_fns[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.methods[(node.name, sub.name)] = sub
+                        self.owner_class[id(sub)] = node.name
+        self._effects_memo: dict[tuple[int, int], tuple[Effect, ...]] = {}
 
-    def resolve(self, call: ast.Call) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
-        """``helper(...)`` -> the module-level def, else None. Attribute
-        calls (``self.x()``, ``mod.f()``) are out of scope: methods are
-        already visited in their defining class's context, and foreign
-        modules are other files."""
+    def resolve(
+        self, call: ast.Call, cls: str | None = None
+    ) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+        """``helper(...)`` -> the module-level def; ``self.meth(...)``
+        (given the enclosing class) -> the method def; else None.
+        Foreign-object attribute calls (``mod.f()``, ``handle.x()``) stay
+        unresolved — classify_blocking names the ones that matter."""
         if isinstance(call.func, ast.Name):
             return self.module_fns.get(call.func.id)
+        if (
+            cls is not None
+            and isinstance(call.func, ast.Attribute)
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id == "self"
+        ):
+            return self.methods.get((cls, call.func.attr))
         return None
+
+    def class_of(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> str | None:
+        return self.owner_class.get(id(fn))
+
+    def blocking_effects(
+        self, fn: ast.FunctionDef | ast.AsyncFunctionDef, depth: int = 2
+    ) -> list[Effect]:
+        """Every blocking/device-sync Effect reachable from ``fn``'s
+        lexical body, following resolvable calls up to ``depth`` further
+        levels (classification itself is free: a classified call at the
+        deepest resolved body still reports). Memoized; cycle-safe (the
+        depth budget bounds recursion)."""
+        key = (id(fn), depth)
+        memo = self._effects_memo.get(key)
+        if memo is not None:
+            return list(memo)
+        self._effects_memo[key] = ()  # cut self-recursion while computing
+        out: list[Effect] = []
+        seen: set[tuple[str, str, tuple[str, ...], int]] = set()
+
+        def add(eff: Effect) -> None:
+            # per-SITE identity: two np.asarray sites in one callee are two
+            # effects (each needs its own anchor for inline disables)
+            k = (eff.kind, eff.label, eff.chain, id(eff.node))
+            if k not in seen:
+                seen.add(k)
+                out.append(eff)
+
+        cls = self.class_of(fn)
+        for node in _walk_body(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            eff = classify_blocking(node) or classify_device_sync(node)
+            if eff is not None:
+                add(eff)
+                continue
+            if depth > 0:
+                callee = self.resolve(node, cls)
+                if callee is not None and callee is not fn:
+                    for sub in self.blocking_effects(callee, depth - 1):
+                        add(replace(sub, chain=(callee.name,) + sub.chain))
+        self._effects_memo[key] = tuple(out)
+        return out
 
     def blocking_calls(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[tuple[ast.Call, str, bool]]:
         """(call node, dotted name, bounded?) for every ray.get()/
@@ -89,7 +288,7 @@ def _walk_body(fn: ast.FunctionDef | ast.AsyncFunctionDef):
     stack: list[ast.AST] = list(fn.body)
     while stack:
         node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
             continue
         yield node
         stack.extend(ast.iter_child_nodes(node))

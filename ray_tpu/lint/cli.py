@@ -31,19 +31,22 @@ import os
 import sys
 
 from ray_tpu.lint import baseline as baseline_mod
-from ray_tpu.lint.engine import lint_paths
+from ray_tpu.lint.engine import canonical_rule, lint_paths
 from ray_tpu.lint.rules import all_rules, rule_catalog
 
 
 def _coverage(paths: list[str], root: str, rule_ids: set[str]):
-    """(rule, path) -> bool: could this run have re-found it?"""
+    """(rule, path) -> bool: could this run have re-found it? Rules are
+    compared canonically, so a baseline entry keyed under a retired alias
+    id (TPL004) is covered whenever its successor (CCR006) ran."""
     rel_roots = []
     for p in paths:
         rel = os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
         rel_roots.append("" if rel == "." else rel)
+    canon_ids = {canonical_rule(r) for r in rule_ids}
 
     def covered(rule: str, path: str) -> bool:
-        if rule not in rule_ids:
+        if canonical_rule(rule) not in canon_ids:
             return False
         return any(r == "" or path == r or path.startswith(r + "/") for r in rel_roots)
 
@@ -60,7 +63,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--baseline", default=None, help="baseline JSON (default: ray_tpu/lint/baseline.json)")
     p.add_argument("--no-baseline", action="store_true", help="report every finding; ignore the baseline")
     p.add_argument("--update-baseline", action="store_true", help="accept current findings into the baseline and exit 0")
-    p.add_argument("--select", default=None, help="comma-separated rule ids/names to run (default: all)")
+    p.add_argument("--select", default=None, help="comma-separated rule ids/names to run (default: all; alias ids like TPL004 resolve)")
+    p.add_argument("--concur", action="store_true", help="run only the CCR concurrency-discipline rules")
     p.add_argument("--jax", action="store_true", help="also trace registered entry points and run the JXC jaxpr rules")
     p.add_argument("--format", choices=("text", "json"), default="text",
                    help="json = one finding per line (JSON Lines)")
@@ -72,6 +76,8 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
+        # all three catalogs, uniformly: TPL+CCR (rule_catalog spans the
+        # merged AST registry) and JXC
         from ray_tpu.lint.jaxcheck import jax_rule_catalog
 
         for rid, name, summary in rule_catalog() + jax_rule_catalog():
@@ -79,6 +85,10 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     select = {s.strip() for s in args.select.split(",") if s.strip()} if args.select else None
+    if args.concur:
+        from ray_tpu.lint.concur import concur_rule_ids
+
+        select = (select or set()) | concur_rule_ids() if select else concur_rule_ids()
     rules = all_rules(select)
     root = os.path.abspath(args.root or os.getcwd())
 
@@ -128,7 +138,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.update_baseline:
         prior = baseline_mod.load(bl_path)
         kept = {fp: e for fp, e in prior.items() if not covered(e.get("rule"), e.get("path", ""))}
-        merged = {**kept, **baseline_mod.entries_from_findings(findings)}
+        merged = {**kept, **baseline_mod.entries_from_findings(findings, prior=prior)}
         n = baseline_mod.save_entries(bl_path, merged)
         print(
             f"tpulint: wrote {n} baseline entries ({len(findings)} findings, "

@@ -1,0 +1,11 @@
+"""Concurrency-discipline analyzer (CCR catalog): lock-set dataflow,
+blocking-under-lock, guarded-by field annotations, and hot-path
+device-sync reachability. See ``rules`` for the catalog and ``lockset``
+for the dataflow core and annotation syntaxes."""
+
+from ray_tpu.lint.concur.rules import (  # noqa: F401
+    CONCUR_RULES,
+    all_concur_rules,
+    concur_rule_catalog,
+    concur_rule_ids,
+)

@@ -1,0 +1,244 @@
+"""Lock-set dataflow over one function body, plus the two annotation
+syntaxes the CCR rules consume.
+
+The lock-set is LEXICAL (RacerD-style "syntactic locks"): a lock key is
+a Name/Attribute chain whose final segment looks lock-ish, normalized so
+``self.X`` inside class C keys as ``C.X`` (methods of one class share
+keys, distinct classes don't alias). Held-ness flows through:
+
+- ``with <lock>:`` items (including multi-item ``with a, b:``);
+- standalone ``<lock>.acquire()`` statements, held for the remainder of
+  the enclosing block (released early by a matching ``.release()``) —
+  deliberately block-scoped, not function-scoped, so hand-over-hand
+  chained locking (gcs.py) contributes exactly the region it covers;
+- ``# holds-lock: <lock>`` on a ``def`` line, which seeds the entry
+  lock-set: the documented caller-holds-lock contract for ``_locked``
+  helpers, made machine-readable.
+
+Field annotations: ``# guarded-by: <lock>`` on a ``self.X = ...`` line
+in a class body declares that writes to ``self.X`` (and mutator calls on
+it) require ``<lock>`` in the lock-set — enforced by CCR003. A bare lock
+name is self-relative (``_lock`` in class C means ``C._lock``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ray_tpu.lint.engine import dotted
+
+_LOCKISH = re.compile(r"(?:^|_)(lock|mutex|mu|cond|cv|sem)$", re.IGNORECASE)
+GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+HOLDS_LOCK_RE = re.compile(r"#\s*holds-lock:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+# container/dict/set/deque/queue methods that mutate their receiver —
+# the write shapes CCR003 checks beyond plain assignment
+MUTATOR_ATTRS = {
+    "append", "appendleft", "extend", "add", "update", "setdefault",
+    "pop", "popleft", "popitem", "remove", "discard", "clear", "insert", "put",
+}
+
+
+def lockish(name: str) -> bool:
+    return bool(_LOCKISH.search(name.split(".")[-1]))
+
+
+def lock_key(expr: ast.AST, cls: str | None) -> str | None:
+    """Normalized lock key for a lock-ish Name/Attribute chain, or None."""
+    name = dotted(expr)
+    if name is None or not lockish(name):
+        return None
+    return normalize_lock_name(name, cls)
+
+
+def normalize_lock_name(name: str, cls: str | None) -> str:
+    """``self.X`` (or bare ``X``, as written in annotations) inside class
+    ``cls`` -> ``cls.X``; anything else keeps its dotted spelling."""
+    if cls:
+        if name.startswith("self."):
+            return f"{cls}.{name[len('self.'):]}"
+        if "." not in name:
+            return f"{cls}.{name}"
+    return name
+
+
+def holds_locks(
+    lines: list[str], fn: ast.FunctionDef | ast.AsyncFunctionDef, cls: str | None
+) -> frozenset[str]:
+    """Lock keys from ``# holds-lock:`` comments on the def line."""
+    if not (1 <= fn.lineno <= len(lines)):
+        return frozenset()
+    return frozenset(
+        normalize_lock_name(m, cls) for m in HOLDS_LOCK_RE.findall(lines[fn.lineno - 1])
+    )
+
+
+def guarded_fields(lines: list[str], tree: ast.Module) -> dict[str, dict[str, str]]:
+    """{class name: {attr: lock key}} from ``# guarded-by:`` comments on
+    ``self.X = ...`` / class-level ``X = ...`` / AnnAssign lines anywhere
+    in the class (conventionally ``__init__``)."""
+    out: dict[str, dict[str, str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        fields: dict[str, str] = {}
+        for sub in ast.walk(node):
+            if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                continue
+            if not (1 <= sub.lineno <= len(lines)):
+                continue
+            m = GUARDED_BY_RE.search(lines[sub.lineno - 1])
+            if m is None:
+                continue
+            targets = sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+            for t in targets:
+                attr = self_attr_root(t)
+                if attr is None and isinstance(t, ast.Name):
+                    attr = t.id
+                if attr is not None:
+                    fields[attr] = normalize_lock_name(m.group(1), node.name)
+        if fields:
+            out[node.name] = fields
+    return out
+
+
+def self_attr_root(expr: ast.AST) -> str | None:
+    """The attribute X when ``expr`` is a chain rooted at ``self.X``
+    (``self.X``, ``self.X[k]``, ``self.X.y[k]``, ...); None otherwise."""
+    while isinstance(expr, (ast.Subscript, ast.Attribute)):
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            return expr.attr
+        expr = expr.value
+    return None
+
+
+def _stmt_lock_call(stmt: ast.stmt, which: str, cls: str | None) -> str | None:
+    """Lock key when ``stmt`` is a standalone ``<lock>.acquire()`` /
+    ``<lock>.release()`` expression statement (``which`` picks the
+    method). Conditional acquires (``if lock.acquire(False):``) are not
+    Expr statements and don't match."""
+    if not (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)):
+        return None
+    call = stmt.value
+    if not (isinstance(call.func, ast.Attribute) and call.func.attr == which):
+        return None
+    return lock_key(call.func.value, cls)
+
+
+def acquire_key(stmt: ast.stmt, cls: str | None) -> str | None:
+    return _stmt_lock_call(stmt, "acquire", cls)
+
+
+def release_key(stmt: ast.stmt, cls: str | None) -> str | None:
+    return _stmt_lock_call(stmt, "release", cls)
+
+
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _expr_nodes(node: ast.AST) -> Iterator[ast.AST]:
+    """Pre-order walk (parents before children) skipping nested defs and
+    lambdas — their bodies run on a different activation, under whatever
+    locks THAT caller holds."""
+    stack: list[ast.AST] = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _SCOPE_BARRIERS):
+            continue
+        yield n
+        stack.extend(reversed(list(ast.iter_child_nodes(n))))
+
+
+def iter_held(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+    cls: str | None,
+    seed: frozenset[str] = frozenset(),
+) -> Iterator[tuple[ast.AST, frozenset[str]]]:
+    """Yield ``(node, held lock keys)`` for every AST node in ``fn``'s
+    lexical body, pre-order (a call is yielded before its argument
+    sub-calls, so rules can anchor at the outermost call of a lock
+    scope). ``seed`` is the entry lock-set (``# holds-lock:``)."""
+
+    def walk_block(stmts: list[ast.stmt], held: frozenset[str]) -> Iterator[tuple[ast.AST, frozenset[str]]]:
+        for stmt in stmts:
+            if isinstance(stmt, _SCOPE_BARRIERS):
+                continue  # analyzed as its own function
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                keys: set[str] = set()
+                for item in stmt.items:
+                    yield from ((n, held) for n in _expr_nodes(item.context_expr))
+                    if item.optional_vars is not None:
+                        yield from ((n, held) for n in _expr_nodes(item.optional_vars))
+                    k = lock_key(item.context_expr, cls)
+                    if k is not None:
+                        keys.add(k)
+                yield from walk_block(stmt.body, held | frozenset(keys))
+                continue
+            ak = acquire_key(stmt, cls)
+            if ak is not None:
+                yield from ((n, held) for n in _expr_nodes(stmt))
+                held = held | {ak}
+                continue
+            rk = release_key(stmt, cls)
+            if rk is not None:
+                yield from ((n, held) for n in _expr_nodes(stmt))
+                held = held - {rk}
+                continue
+            # compound statements: expression parts under the current
+            # lock-set, statement-list fields recursed (each child block
+            # starts from this statement's held set)
+            blocks: list[list[ast.stmt]] = []
+            exprs: list[ast.AST] = [stmt]
+            simple = True
+            for name, value in ast.iter_fields(stmt):
+                if isinstance(value, list) and value and isinstance(value[0], ast.stmt):
+                    blocks.append(value)
+                    simple = False
+                elif isinstance(value, list) and value and isinstance(value[0], (ast.ExceptHandler, ast.match_case)):
+                    simple = False
+                    for sub in value:
+                        blocks.append(sub.body)
+                        for sn, sv in ast.iter_fields(sub):
+                            if isinstance(sv, ast.AST):
+                                exprs.append(sv)
+            if simple:
+                yield from ((n, held) for n in _expr_nodes(stmt))
+                continue
+            yield (stmt, held)
+            for name, value in ast.iter_fields(stmt):
+                if isinstance(value, ast.AST) and not isinstance(value, ast.stmt):
+                    exprs.append(value)
+                elif isinstance(value, list) and value and isinstance(value[0], ast.expr):
+                    exprs.extend(value)
+            for e in exprs[1:]:
+                yield from ((n, held) for n in _expr_nodes(e))
+            for b in blocks:
+                yield from walk_block(b, held)
+
+    yield from walk_block(fn.body, frozenset(seed))
+
+
+def iter_functions(tree: ast.Module) -> Iterator[tuple[ast.FunctionDef | ast.AsyncFunctionDef, str | None, str]]:
+    """Every function def in the module (including nested ones), with its
+    enclosing class name (None outside a class — nested defs inside a
+    method report the method's class, since ``self`` still binds to it)
+    and dotted qualname."""
+
+    def walk(node: ast.AST, cls: str | None, scope: list[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, child.name, scope + [child.name])
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(scope + [child.name])
+                yield child, cls, qual
+                yield from walk(child, cls, scope + [child.name])
+            elif isinstance(child, (ast.If, ast.Try, ast.With, ast.AsyncWith, ast.For, ast.While)):
+                yield from walk(child, cls, scope)
+
+    yield from walk(tree, None, [])

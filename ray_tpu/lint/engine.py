@@ -11,9 +11,11 @@ Design notes:
   (rule, path, enclosing scope, message); multiple identical findings in
   one scope are disambiguated by count, not index, so reordering inside
   a function never churns the baseline.
-- ``# tpulint: disable=TPL004`` (or ``=all``) on the flagged line
+- ``# tpulint: disable=CCR001`` (or ``=all``) on the flagged line
   suppresses in-source, for hazards that are deliberate and locally
   explainable; the baseline is for accepted pre-existing debt instead.
+  Retired ids listed in ``RULE_ALIASES`` (``TPL004`` -> ``CCR006``)
+  still suppress their successor rule.
 """
 
 from __future__ import annotations
@@ -26,6 +28,16 @@ from dataclasses import dataclass, field
 from typing import Iterable, Iterator
 
 _SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+
+# Retired rule ids that live on as aliases of their successor: old inline
+# disables, --select args, and baseline entries keep working verbatim.
+# TPL004 (lock-order cycles) moved into the concur catalog as CCR006.
+RULE_ALIASES = {"TPL004": "CCR006"}
+
+
+def canonical_rule(rule_id: str) -> str:
+    """Map a (possibly retired) rule id to its canonical catalog id."""
+    return RULE_ALIASES.get(rule_id, rule_id)
 
 
 @dataclass(frozen=True)
@@ -180,7 +192,8 @@ def finding_suppressed(lines: list[str], f: Finding) -> bool:
     spec = m.group(1)
     if spec.strip() == "all":
         return True
-    return f.rule in {s.strip() for s in spec.split(",")}
+    ids = {canonical_rule(s.strip()) for s in spec.split(",")}
+    return canonical_rule(f.rule) in ids
 
 
 def _suppressed(ctx: FileContext, f: Finding) -> bool:

@@ -525,7 +525,7 @@ class LLMEngine:
 
         self._slots: list[RequestState | None] = [None] * B
         self._waiting: deque[RequestState] = deque()
-        self._requests: dict[str, RequestState] = {}
+        self._requests: dict[str, RequestState] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._auto_id = 0
         self._prefix_cache = (
@@ -539,6 +539,13 @@ class LLMEngine:
             "hits": 0, "tokens_saved": 0, "fetched_bytes": 0,
             "lost": 0, "published_blocks": 0, "published_bytes": 0,
         }
+        # publishes minted under the engine lock (admission self-heal,
+        # remote-fetch republish, prefill store) are deferred here and
+        # flushed at the step tail AFTER the lock is released: a publish
+        # is serialization + put_owned + a 10s-timeout index RPC, and
+        # paying that under self._lock would stall every add_request/
+        # abort/stats caller behind the plane (tpulint CCR001)
+        self._plane_offers: list[tuple] = []
         if kv_plane is not None:
             if self._prefix_cache is None:
                 raise ValueError(
@@ -1641,27 +1648,47 @@ class LLMEngine:
         return (k_w, v_w, n_p, k_sc, v_sc)
 
     def _plane_publish(self, prompt, ks, vs, new_keys=None, pad=None, proven_reuse=False):
-        """Publish a prefix block to the cluster plane (owned object +
-        index registration). ``new_keys`` scopes registration to the
-        boundaries the local cache just minted (the store path); None
-        lets the client cover every still-unpublished boundary (the
-        local-hit self-heal after a transient publish failure).
-        ``proven_reuse`` bypasses the client's publish_min_hits policy
-        (the remote-fetch republish path). Failures degrade silently —
-        the client counts them; serving never depends on the plane."""
+        """Queue a prefix-block publish for the cluster plane. Every
+        caller runs under the engine lock (admission self-heal, the
+        remote-fetch republish, the prefill store path), so the actual
+        publish — serialization, ``put_owned``, a timeout-bounded index
+        RPC — is deferred to ``_flush_plane_offers()`` at the step tail,
+        outside the lock. The offer holds references to the same arrays
+        the prefix cache just stored, so nothing is copied and the block
+        is still published by the time ``step()`` returns."""
         block = self._prefix_cache.block
         n_max = (len(prompt) // block) * block
         if n_max < block:
             return
-        pad = int(ks.shape[1]) if pad is None else pad
-        nbytes = self._kv_plane.publish(
-            [int(t) for t in prompt[:n_max]], ks[:, :pad], vs[:, :pad],
-            bounds=None if new_keys is None else [(n, key) for key, n in new_keys],
-            proven_reuse=proven_reuse,
-        )
-        if nbytes:
-            self._plane_stats["published_blocks"] += 1
-            self._plane_stats["published_bytes"] += nbytes
+        self._plane_offers.append((list(prompt), ks, vs, new_keys, pad, proven_reuse))
+
+    def _flush_plane_offers(self):
+        """Publish queued prefix blocks (owned object + index
+        registration) — called from the step tail with the engine lock
+        RELEASED. ``new_keys`` scopes registration to the boundaries the
+        local cache just minted (the store path); None lets the client
+        cover every still-unpublished boundary (the local-hit self-heal
+        after a transient publish failure). ``proven_reuse`` bypasses the
+        client's publish_min_hits policy (the remote-fetch republish
+        path). Failures degrade silently — the client counts them;
+        serving never depends on the plane."""
+        if not self._plane_offers:
+            return
+        with self._lock:
+            offers, self._plane_offers = self._plane_offers, []
+        block = self._prefix_cache.block
+        for prompt, ks, vs, new_keys, pad, proven_reuse in offers:
+            n_max = (len(prompt) // block) * block
+            pad = int(ks.shape[1]) if pad is None else pad
+            nbytes = self._kv_plane.publish(
+                [int(t) for t in prompt[:n_max]], ks[:, :pad], vs[:, :pad],
+                bounds=None if new_keys is None else [(n, key) for key, n in new_keys],
+                proven_reuse=proven_reuse,
+            )
+            if nbytes:
+                with self._lock:
+                    self._plane_stats["published_blocks"] += 1
+                    self._plane_stats["published_bytes"] += nbytes
 
     def _stage_prefill(self, wave: list) -> list:
         """PREFILL stage (execution): run the admission wave's forwards.
@@ -1895,7 +1922,7 @@ class LLMEngine:
         self._top_k[slot] = p.top_k
         self._top_p[slot] = p.top_p
         if p.seed is not None:
-            self._keys[slot] = np.asarray(jax.random.key_data(jax.random.PRNGKey(p.seed)))
+            self._keys[slot] = np.asarray(jax.random.key_data(jax.random.PRNGKey(p.seed)))  # tpulint: disable=CCR002 — seeded lane key init: host PRNG material, one-time per admission
         elif self._device_resident:
             # the lane's key lives on device (advanced by every fused
             # step); pull its current value for the first-token sample.
@@ -1903,7 +1930,7 @@ class LLMEngine:
             # pending — the price of exact key parity with the sync
             # oracle, paid only on seedless admissions and bounded by one
             # step per admission (the prefill about to run dwarfs it).
-            self._keys[slot] = np.asarray(self._dkeys[slot])
+            self._keys[slot] = np.asarray(self._dkeys[slot])  # tpulint: disable=CCR002 — documented first-sample key pull: bounded one pending step per seedless admission
         tok, logp, key = self._sample(
             logits,
             jnp.asarray(self._keys[slot : slot + 1]),
@@ -1911,7 +1938,7 @@ class LLMEngine:
             jnp.asarray(self._top_k[slot : slot + 1]),
             jnp.asarray(self._top_p[slot : slot + 1]),
         )
-        self._keys[slot] = np.asarray(key[0])
+        self._keys[slot] = np.asarray(key[0])  # tpulint: disable=CCR002 — post-sample key readback rides the prefill's own sync point
         token = int(tok[0])
         if self._device_resident:
             # lane delta: first input token, advanced key, sampling params
@@ -1929,7 +1956,7 @@ class LLMEngine:
                 np.float32(p.top_p),
             )
         spec_hist = (st.prompt_token_ids + st.token_ids + [token]) if self._spec_cfg is not None else None
-        self._emit(st, token, float(logp[0]))
+        self._emit(st, token, float(logp[0]))  # tpulint: disable=CCR002 — first-token emit: prefill output is already host-synced here
         if spec_hist is not None:
             self._spec_admit(st, slot, spec_hist)
 
@@ -1956,7 +1983,7 @@ class LLMEngine:
         # the checkpointed key, NEVER re-derived from the seed: a seeded
         # lane's key advanced once per sample at the source, and the
         # oracle's post-splice draws continue that sequence
-        self._keys[slot] = np.asarray(rs["rng_key"], np.uint32)
+        self._keys[slot] = np.asarray(rs["rng_key"], np.uint32)  # tpulint: disable=CCR002 — checkpoint splice: rs is host state from llm/migrate.py, not a device array
         token = int(st.token_ids[-1])
         self._next_tokens[slot] = token
         if self._device_resident:
@@ -2087,8 +2114,11 @@ class LLMEngine:
                 if tel is not None:
                     tel.on_step(t0, len(admitted), self._step_emitted, self._last_spec_drain)
             if self._kv_plane is not None:
-                # refresh the cluster-index lease (throttled, outside the
-                # engine lock — a slow index can never stall admissions)
+                # publish the step's minted prefix blocks and refresh the
+                # cluster-index lease (throttled) — both outside the
+                # engine lock, so a slow plane/index can never stall
+                # admissions or any lock-holding caller
+                self._flush_plane_offers()
                 self._kv_plane.maybe_heartbeat()
             return outs
         except BaseException as exc:
@@ -2166,13 +2196,13 @@ class LLMEngine:
         if pending is None:
             return []
         toks_d, logps_d, lanes = pending
-        toks = np.asarray(toks_d)
-        logps = np.asarray(logps_d)
+        toks = np.asarray(toks_d)  # tpulint: disable=CCR002 — sanctioned one-step-delayed drain readback (overlaps next step's compute)
+        logps = np.asarray(logps_d)  # tpulint: disable=CCR002 — sanctioned one-step-delayed drain readback (overlaps next step's compute)
         emitted = []
         for st, slot in lanes:
             if st.finished:
                 continue  # aborted (or finished) between dispatch and drain
-            self._emit(st, int(toks[slot]), float(logps[slot]))
+            self._emit(st, int(toks[slot]), float(logps[slot]))  # tpulint: disable=CCR002 — reads the already-drained host array
             emitted.append(st)
         return emitted
 
@@ -2245,9 +2275,9 @@ class LLMEngine:
         if pending is None:
             return []
         emit_d, logps_d, acc_d, lanes = pending
-        emit = np.asarray(emit_d)
-        logps = np.asarray(logps_d)
-        acc = np.asarray(acc_d)
+        emit = np.asarray(emit_d)  # tpulint: disable=CCR002 — sanctioned one-round-delayed spec drain readback
+        logps = np.asarray(logps_d)  # tpulint: disable=CCR002 — sanctioned one-round-delayed spec drain readback
+        acc = np.asarray(acc_d)  # tpulint: disable=CCR002 — sanctioned one-round-delayed spec drain readback
         row_cap = (
             self._pcfg.max_pages_per_seq * self._pcfg.page_size if self.kv_layout == "paged" else None
         )
@@ -2270,7 +2300,7 @@ class LLMEngine:
             self._spec_accepted += a
             self._spec_lane_rounds += 1
             for i in range(min(n_new, cap)):
-                self._emit(st, int(emit[slot, i]), float(logps[slot, i]))
+                self._emit(st, int(emit[slot, i]), float(logps[slot, i]))  # tpulint: disable=CCR002 — reads the already-drained host array
                 self._spec_emitted += 1
                 if st.finished:
                     break
@@ -2322,14 +2352,14 @@ class LLMEngine:
             jnp.asarray(self._top_k),
             jnp.asarray(self._top_p),
         )
-        toks = np.asarray(toks)
-        logps = np.asarray(logps)
-        self._keys = np.array(keys)
+        toks = np.asarray(toks)  # tpulint: disable=CCR002 — sync mode: the whole point is an in-step readback
+        logps = np.asarray(logps)  # tpulint: disable=CCR002 — sync mode: the whole point is an in-step readback
+        self._keys = np.array(keys)  # tpulint: disable=CCR002 — sync mode: the whole point is an in-step readback
         for st in active:
-            self._emit(st, int(toks[st.slot]), float(logps[st.slot]))
+            self._emit(st, int(toks[st.slot]), float(logps[st.slot]))  # tpulint: disable=CCR002 — sync mode: reads the just-synced host array
         return active
 
-    def _build_outputs(self, reported: list) -> list[RequestOutput]:
+    def _build_outputs(self, reported: list) -> list[RequestOutput]:  # holds-lock: _lock
         """Per-request deltas for everything that changed this step."""
         outputs: list[RequestOutput] = []
         seen: set = set()

@@ -105,7 +105,7 @@ class KVPlaneClient:
         self.publish_min_hits = max(1, int(publish_min_hits))
         # boundary key -> publish-offer count (stores + local-hit
         # re-offers); bounded — see _note_seen
-        self._seen: dict[bytes, int] = {}
+        self._seen: dict[bytes, int] = {}  # guarded-by: _lock
         # circuit breaker: repeated index failures open it for a cooldown
         # so a DEAD index costs one timeout, not one per admission under
         # the engine lock (heartbeats keep probing and close it on success)
@@ -113,8 +113,8 @@ class KVPlaneClient:
         self._down_until = 0.0
         self._shutdown = False
         self._lock = threading.Lock()
-        self._published: dict[bytes, tuple] = {}  # boundary key -> (n, meta, ref)
-        self._ref_keys: dict[bytes, set] = {}  # ref id -> live boundary keys
+        self._published: dict[bytes, tuple] = {}  # boundary key -> (n, meta, ref); guarded-by: _lock
+        self._ref_keys: dict[bytes, set] = {}  # ref id -> live boundary keys; guarded-by: _lock
         self._evict_q = None  # lazy: SimpleQueue + daemon worker on first evict
         self._last_heartbeat = 0.0
         # attach() fills these from the engine's config
@@ -275,7 +275,7 @@ class KVPlaneClient:
         self.counts["published_bytes"] += int(meta["nbytes"])
         return int(meta["nbytes"])
 
-    def _note_seen(self, key: bytes) -> int:
+    def _note_seen(self, key: bytes) -> int:  # holds-lock: _lock
         """Bump and return a boundary key's sighting count (caller holds
         the lock). The map holds only keys the policy still needs —
         publish() drops a key's count the moment it ships — and is

@@ -98,16 +98,16 @@ class PrefixIndex:
         self._now = time_fn or time.time
         self._lock = threading.Lock()
         # key -> {replica -> {"n": int, "meta": dict, "ref": ObjectRef}}
-        self._entries: dict[bytes, dict[str, dict]] = {}
+        self._entries: dict[bytes, dict[str, dict]] = {}  # guarded-by: _lock
         # replica -> {"last_seen": float, "keys": set[bytes]}
-        self._replicas: dict[str, dict] = {}
-        self.counts = {
+        self._replicas: dict[str, dict] = {}  # guarded-by: _lock
+        self.counts = {  # guarded-by: _lock
             "registered": 0, "unregistered": 0, "expired": 0,
             "lookups": 0, "hits": 0, "lost_reports": 0,
         }
 
     # -- liveness ----------------------------------------------------------
-    def _touch(self, replica: str) -> None:
+    def _touch(self, replica: str) -> None:  # holds-lock: _lock
         rec = self._replicas.setdefault(replica, {"last_seen": 0.0, "keys": set()})
         rec["last_seen"] = self._now()
 
@@ -137,7 +137,7 @@ class PrefixIndex:
             self.counts["expired"] += n
             return n
 
-    def _drop_replica_locked(self, replica: str) -> int:
+    def _drop_replica_locked(self, replica: str) -> int:  # holds-lock: _lock
         rec = self._replicas.pop(replica, None)
         if rec is None:
             return 0

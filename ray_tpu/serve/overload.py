@@ -202,11 +202,11 @@ class AdmissionController:
         self.engine = engine
         self.cfg = cfg if cfg is not None else AdmissionConfig()
         self._lock = threading.Lock()
-        self.counts = {
+        self.counts = {  # guarded-by: _lock
             "admitted": 0, "shed_depth": 0, "shed_backlog": 0,
             "shed_wait": 0, "shed_slots": 0, "shed_draining": 0,
         }
-        self.shed_by_class: dict[int, int] = {}
+        self.shed_by_class: dict[int, int] = {}  # guarded-by: _lock
         self._draining = False
         # pre-bound metric handles (llm/telemetry.py catalog); shed-class
         # handles bind lazily (class cardinality is tiny)
@@ -336,12 +336,17 @@ class AdmissionController:
         self.check(len(self.cfg.class_fracs) - 1)
 
     def stats(self) -> dict:
+        # estimate BEFORE taking the lock: it may fall through to
+        # engine.host_load(), which waits on the ENGINE lock (held for
+        # whole serving steps) — computing it under self._lock would stall
+        # every ingress check()/record_outcome() behind a step boundary
+        wait_est = round(self.estimate_queue_wait_s(), 4)
         with self._lock:
             return {
                 **self.counts,
                 "shed_by_class": dict(self.shed_by_class),
                 "draining": self._draining,
-                "queue_wait_est_s": round(self.estimate_queue_wait_s(), 4),
+                "queue_wait_est_s": wait_est,
             }
 
 

@@ -92,7 +92,7 @@ class TrainWorker:
                 self._error = (e, traceback.format_exc())
                 self._status = "error"
 
-        self._thread = threading.Thread(target=target, name="rt-train-loop", daemon=True)
+        self._thread = threading.Thread(target=target, name="rt-train-loop", daemon=True)  # tpulint: disable=CCR005 — joined two lines down; writes are sequenced-before the join's return
         self._thread.start()
         self._thread.join()
         if self._status == "error":

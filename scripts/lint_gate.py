@@ -9,11 +9,18 @@ touches and fail on NEW findings.
 Semantics match the tier-1 self-check exactly — same baseline, same
 fingerprints — so the gate can never pass a change tier-1 would fail:
 
-- changed ``.py`` files under ray_tpu/ get the AST rules;
+- changed ``.py`` files under ray_tpu/ get the AST rules — both the TPL
+  catalog and the CCR concurrency-discipline pass (lock-set dataflow,
+  blocking-under-lock, guarded-by, hot-path device-sync): CCR rules live
+  in the default registry, so incremental runs cover changed files and
+  ``--all`` covers the whole tree with no separate invocation to forget;
 - the jaxpr pass (``--jax``) runs whenever a changed file is a
   registered entry module (or any file under ray_tpu/, since an edited
   helper can change a traced program) — it is cheap (abstract tracing,
   no compiles);
+- the baseline-policy check runs unconditionally: every accepted entry
+  in ray_tpu/lint/baseline.json must carry a hand-written ``why`` —
+  debt without a recorded justification fails the push;
 - deleting a finding's file surfaces as a STALE baseline entry, which
   also fails: run ``python -m ray_tpu.lint ray_tpu --update-baseline``
   and commit the shrunk baseline.
@@ -236,6 +243,31 @@ def check_chaos_safety() -> list[str]:
     return problems
 
 
+def check_baseline_policy() -> list[str]:
+    """Baseline-policy gate: every accepted finding in the committed
+    baseline must carry a non-empty hand-written ``why``. The baseline is
+    the ledger of deliberate hazards (e.g. the ROADMAP item-3a admission
+    fetch under the engine lock) — an entry without its justification is
+    indistinguishable from debt someone forgot to fix, and
+    ``--update-baseline`` preserves ``why`` fields, so this can only fire
+    on a NEW unjustified acceptance."""
+    import json as _json
+
+    path = os.path.join(ROOT, "ray_tpu", "lint", "baseline.json")
+    try:
+        entries = _json.load(open(path, encoding="utf-8")).get("entries", {})
+    except FileNotFoundError:
+        return []
+    except Exception as e:  # noqa: BLE001
+        return [f"baseline: {path} failed to parse: {type(e).__name__}: {e}"]
+    return [
+        f"baseline: entry {fp} ({e.get('rule')} {e.get('path')}) has no 'why' — "
+        "every accepted hazard needs its justification recorded in-line"
+        for fp, e in sorted(entries.items())
+        if not str(e.get("why", "")).strip()
+    ]
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--base", default=None, help="git ref to diff against (default: origin/main, main, HEAD~1)")
@@ -245,11 +277,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("git_hook_args", nargs="*", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
-    # the telemetry and chaos-safety gates are import-time cheap: run
-    # them unconditionally (a broken metric catalog, dashboard panel, or
-    # reachable chaos injection fails the push regardless of which file
+    # the telemetry, chaos-safety and baseline-policy gates are
+    # import-time cheap: run them unconditionally (a broken metric
+    # catalog, dashboard panel, reachable chaos injection, or an
+    # unjustified baseline entry fails the push regardless of which file
     # introduced it)
-    telemetry_problems = check_telemetry() + check_chaos_safety()
+    telemetry_problems = check_telemetry() + check_chaos_safety() + check_baseline_policy()
     for prob in telemetry_problems:
         print(f"lint_gate: {prob}", file=sys.stderr)
 

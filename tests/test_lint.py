@@ -146,6 +146,75 @@ def test_module_entrypoint_and_rt_wiring():
     assert r2.returncode == 0, r2.stdout + r2.stderr
 
 
+# ============================================================ concur gate
+def test_ccr_self_check_clean_modulo_baseline():
+    """The concurrency-discipline pass over ray_tpu/ itself: every
+    blocking-under-lock / hot-path-sync hazard is either fixed or a
+    baseline entry with a hand-written why (the deliberate ones: the
+    admission-path prefix fetch of ROADMAP item 3a, the controller
+    reconcile loop, drain idempotency). Any NEW CCR finding fails tier-1."""
+    from ray_tpu.lint.concur import all_concur_rules, concur_rule_ids
+
+    findings = lint_paths([PKG], root=ROOT, rules=all_concur_rules())
+    ccr_ids = concur_rule_ids() | {"TPL004"}
+    entries = {fp: e for fp, e in bl.load(bl.default_baseline_path()).items()
+               if e["rule"] in ccr_ids}
+    d = bl.diff(findings, entries)
+    assert d.new == [], (
+        "NEW concurrency hazards in ray_tpu/ (fix, inline-disable with a "
+        "rationale, or accept with --update-baseline + a why):\n"
+        + "\n".join(f.render() for f in d.new)
+    )
+    assert d.stale == [], d.stale
+    # the deliberate hazards stay TRACKED, not invisible: the ledger holds
+    # the admission-fetch (item 3a) entries among others
+    assert d.suppressed >= 9
+
+
+def test_ccr_baseline_tracks_item_3a_admission_fetch():
+    # ISSUE policy: the admission-path object-plane fetch is accepted
+    # DEBT with a roadmap pointer, not a fix — the entry must exist, cite
+    # the roadmap item in its why, and sit on the engine's admission path
+    entries = bl.load(bl.default_baseline_path())
+    hits = [e for e in entries.values()
+            if e["rule"] == "CCR001" and "3a" in e.get("why", "")]
+    assert hits, "item-3a admission-fetch baseline entry went missing"
+    assert all("engine" in e["path"] for e in hits)
+
+
+def test_cli_select_ccr001_runs_only_that_rule(tmp_path, capsys):
+    # one file with a CCR001 shape AND a TPL002 shape: --select=CCR001
+    # must report only the former, and the JSONL rule id must carry the
+    # catalog-correct id (satellite: select/list-rules span all catalogs)
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n\n"
+        "class Pump:\n"
+        "    def tick(self, actor):\n"
+        "        actor.ping.remote()\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.5)\n"
+    )
+    assert lint_main([str(bad), "--root", str(tmp_path), "--no-baseline",
+                      "--select", "CCR001", "--format=json"]) == 1
+    docs = [json.loads(ln) for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert docs and {d["rule"] for d in docs} == {"CCR001"}
+    # without the select, the same file trips both catalogs
+    assert lint_main([str(bad), "--root", str(tmp_path), "--no-baseline",
+                      "--format=json"]) == 1
+    docs = [json.loads(ln) for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert {"CCR001", "TPL002"} <= {d["rule"] for d in docs}
+
+
+def test_cli_concur_flag_scopes_to_ccr_catalog(tmp_path, capsys):
+    # --concur over the tree runs clean against the committed baseline
+    assert lint_main([PKG, "--root", ROOT, "--concur"]) == 0
+    # and it implies the CCR selection: a TPL002 drop is NOT reported
+    bad = tmp_path / "bad.py"
+    bad.write_text("def kick(actor):\n    actor.ping.remote()\n")
+    assert lint_main([str(bad), "--root", str(tmp_path), "--no-baseline", "--concur"]) == 0
+
+
 # ============================================================ jaxcheck gate
 def test_jaxcheck_self_check_runs_clean():
     """The jaxpr-level pass over every registered entry point must be
@@ -222,8 +291,9 @@ def test_cli_jax_flag_and_rt_wiring():
 def test_cli_list_rules_includes_jax_catalog(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rid in ("TPL001", "TPL007", "JXC001", "JXC006"):
+    for rid in ("TPL001", "TPL007", "CCR001", "CCR006", "JXC001", "JXC006"):
         assert rid in out
+    assert "TPL004" not in out.replace("alias: TPL004", "")  # retired id only as alias
 
 
 def test_lint_gate_script_noop_without_changes(tmp_path):

@@ -152,29 +152,32 @@ def test_tpl003_silent_when_constructed_inside_or_shadowed():
     """, "TPL003") == []
 
 
-# ------------------------------------------------------------------ TPL004
-def test_tpl004_flags_abba_inversion():
-    out = run("""
-        import threading
+# ------------------------------------------- CCR006 (absorbed TPL004)
+ABBA_SRC = """
+    import threading
 
-        a_lock = threading.Lock()
-        b_lock = threading.Lock()
+    a_lock = threading.Lock()
+    b_lock = threading.Lock()
 
-        def fwd():
-            with a_lock:
-                with b_lock:
-                    pass
-
-        def rev():
+    def fwd():
+        with a_lock:
             with b_lock:
-                with a_lock:
-                    pass
-    """, "TPL004")
+                pass
+
+    def rev():
+        with b_lock:
+            with a_lock:
+                pass
+"""
+
+
+def test_ccr006_flags_abba_inversion():
+    out = run(ABBA_SRC, "CCR006")
     assert len(out) == 1
     assert "a_lock" in out[0].message and "b_lock" in out[0].message
 
 
-def test_tpl004_flags_self_lock_inversion_across_methods():
+def test_ccr006_flags_self_lock_inversion_across_methods():
     out = run("""
         class Registry:
             def put(self):
@@ -186,11 +189,11 @@ def test_tpl004_flags_self_lock_inversion_across_methods():
                 with self._conns_lock:
                     with self._lock:
                         pass
-    """, "TPL004")
+    """, "CCR006")
     assert len(out) == 1
 
 
-def test_tpl004_silent_on_consistent_order_and_multi_item_with():
+def test_ccr006_silent_on_consistent_order_and_multi_item_with():
     assert run("""
         import threading
 
@@ -205,10 +208,10 @@ def test_tpl004_silent_on_consistent_order_and_multi_item_with():
             with a_lock:
                 with b_lock:
                     pass
-    """, "TPL004") == []
+    """, "CCR006") == []
 
 
-def test_tpl004_nesting_does_not_cross_function_boundaries():
+def test_ccr006_nesting_does_not_cross_function_boundaries():
     # a nested def's body starts with an empty held-set: this is the
     # dynamic sanitizer's territory, not lexical nesting
     assert run("""
@@ -223,7 +226,37 @@ def test_tpl004_nesting_does_not_cross_function_boundaries():
             with b_lock:
                 with a_lock:
                     pass
-    """, "TPL004") == []
+    """, "CCR006") == []
+
+
+# ------------------------------------- TPL004 -> CCR006 alias contract
+def test_tpl004_alias_select_runs_ccr006():
+    # pre-absorption --select specs keep working; the finding carries the
+    # CANONICAL id (the baseline handles old-id fingerprints separately)
+    rules = all_rules({"TPL004"})
+    assert [r.id for r in rules] == ["CCR006"]
+    out = lint_source(textwrap.dedent(ABBA_SRC), path="fixture.py", rules=rules)
+    assert [f.rule for f in out] == ["CCR006"]
+
+
+def test_tpl004_alias_inline_disable_suppresses_ccr006():
+    src = textwrap.dedent(ABBA_SRC)
+    f = [x for x in lint_source(src, path="fixture.py") if x.rule == "CCR006"][0]
+    lines = src.splitlines()
+    lines[f.line - 1] += "  # tpulint: disable=TPL004"
+    patched = "\n".join(lines)
+    assert [x for x in lint_source(patched, path="fixture.py") if x.rule == "CCR006"] == []
+
+
+def test_tpl004_alias_baseline_entry_suppresses_ccr006_finding():
+    # an entry accepted under the OLD id (old-id fingerprint and all)
+    # still suppresses the finding now reported as CCR006
+    f = run(ABBA_SRC, "CCR006")[0]
+    old = Finding("TPL004", f.path, f.line, f.col, f.message, f.context)
+    entries = bl.entries_from_findings([old])
+    assert set(entries) == {old.fingerprint()} != {f.fingerprint()}
+    d = bl.diff([f], entries)
+    assert d.new == [] and d.suppressed == 1 and d.stale == []
 
 
 # ------------------------------------------------------------------ TPL005
@@ -934,3 +967,312 @@ def test_jxcerr_on_rule_crash_instead_of_lint_crash():
     spec = _spec(_jx_div, {"b": lambda: ((_f32(8, 8), 2), {})}, varying={"n": (2, 0)})
     fs = run_jaxcheck(root=_ROOT, entries=[spec])
     assert any(f.rule == "JXCERR" and "JXC004" in f.message for f in fs), fs
+
+
+# ------------------------------------------------------------------ CCR001
+def test_ccr001_flags_sleep_under_lock():
+    out = run("""
+        import time
+
+        class Pump:
+            def tick(self):
+                with self._lock:
+                    time.sleep(0.5)
+    """, "CCR001")
+    assert len(out) == 1
+    assert "_lock" in out[0].message and out[0].context == "Pump.tick"
+
+
+def test_ccr001_flags_unbounded_queue_get_under_lock():
+    out = run("""
+        class Pump:
+            def tick(self):
+                with self._lock:
+                    item = self._q.get()
+    """, "CCR001")
+    assert len(out) == 1
+
+
+def test_ccr001_flags_index_rpc_under_lock_transitively():
+    # the blocking call hides one hop away: tick -> _refresh -> index RPC
+    out = run("""
+        class Client:
+            def _refresh(self):
+                return self._index.lookup(b"k")
+
+            def tick(self):
+                with self._lock:
+                    return self._refresh()
+    """, "CCR001")
+    assert len(out) == 1
+    assert "via" in out[0].message
+
+
+def test_ccr001_holds_lock_annotation_seeds_held_set():
+    out = run("""
+        import time
+
+        class Pump:
+            def _drain_locked(self):  # holds-lock: _lock
+                time.sleep(0.1)
+    """, "CCR001")
+    assert len(out) == 1
+
+
+def test_ccr001_silent_outside_lock_and_on_condvar_wait():
+    # sleep after release, and cv.wait() ON the held lock (the one
+    # blocking-while-holding shape that is the POINT of a condvar)
+    assert run("""
+        import time
+
+        class Pump:
+            def tick(self):
+                with self._lock:
+                    n = 1
+                time.sleep(0.5)
+
+            def park(self):
+                with self._cv:
+                    self._cv.wait()
+    """, "CCR001") == []
+
+
+# ------------------------------------------------------------------ CCR002
+def test_ccr002_flags_device_sync_in_hot_root():
+    out = run("""
+        import numpy as np
+
+        class Engine:
+            def step(self):
+                return np.asarray(self._logits)
+    """, "CCR002")
+    assert len(out) == 1
+    assert "step" in out[0].message
+
+
+def test_ccr002_flags_sync_reachable_from_stage_helper():
+    out = run("""
+        class Engine:
+            def _readback(self):
+                return float(self._host[0])
+
+            def _stage_sample(self):
+                return self._readback()
+    """, "CCR002")
+    assert len(out) == 1
+    assert "_stage_sample" in out[0].message
+
+
+def test_ccr002_silent_off_hot_path_and_on_host_dict_float():
+    # float(d["key"]) is a host dict lookup, not a device readback; and
+    # a cold-path method may sync freely
+    assert run("""
+        import numpy as np
+
+        class Engine:
+            def debug_dump(self):
+                return np.asarray(self._logits)
+
+            def step(self):
+                return float(self._cfg["temp"])
+    """, "CCR002") == []
+
+
+# ------------------------------------------------------------------ CCR003
+GUARDED_SRC = """
+    import threading
+
+    class Index:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._entries = {{}}  # guarded-by: _lock
+
+        def put(self, k, v):
+            {body}
+"""
+
+
+def test_ccr003_flags_unguarded_write_to_declared_field():
+    out = run(GUARDED_SRC.format(body="self._entries[k] = v"), "CCR003")
+    assert len(out) == 1
+    assert "_entries" in out[0].message and "guarded-by" in out[0].message
+
+
+def test_ccr003_flags_unguarded_mutator_call():
+    out = run(GUARDED_SRC.format(body="self._entries.pop(k, None)"), "CCR003")
+    assert len(out) == 1
+
+
+def test_ccr003_silent_under_lock_in_init_and_with_holds_lock():
+    assert run("""
+        import threading
+
+        class Index:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._entries = {}  # guarded-by: _lock
+
+            def put(self, k, v):
+                with self._lock:
+                    self._entries[k] = v
+
+            def _put_locked(self, k, v):  # holds-lock: _lock
+                self._entries[k] = v
+    """, "CCR003") == []
+
+
+# ------------------------------------------------------------------ CCR004
+def test_ccr004_flags_manual_acquire_without_try_finally():
+    out = run("""
+        class Agent:
+            def reap(self):
+                self._lock.acquire()
+                self._work()
+                self._lock.release()
+    """, "CCR004")
+    assert len(out) == 1
+
+
+def test_ccr004_silent_on_try_finally_and_hand_over_hand():
+    # classic try/finally, plus the chained-locking shape where acquire
+    # is the LAST statement of a with-body and the try/finally is the
+    # with's next sibling (gcs-style hand-over-hand traversal)
+    assert run("""
+        class Agent:
+            def reap(self):
+                self._lock.acquire()
+                try:
+                    self._work()
+                finally:
+                    self._lock.release()
+
+            def walk(self, nxt):
+                with self._lock:
+                    nxt.acquire()
+                try:
+                    self._visit(nxt)
+                finally:
+                    nxt.release()
+    """, "CCR004") == []
+
+
+# ------------------------------------------------------------------ CCR005
+def test_ccr005_flags_thread_mutating_captured_state_unguarded():
+    out = run("""
+        import threading
+
+        def pump(items):
+            done = []
+
+            def worker():
+                done.append(len(items))
+
+            t = threading.Thread(target=worker)
+            t.start()
+            return done
+    """, "CCR005")
+    assert len(out) == 1
+    assert "done" in out[0].message
+
+
+def test_ccr005_silent_when_guarded_or_bound_method_target():
+    assert run("""
+        import threading
+
+        def pump(items, lock):
+            done = []
+
+            def worker():
+                with lock:
+                    done.append(len(items))
+
+            threading.Thread(target=worker).start()
+
+        class Pool:
+            def spawn(self):
+                threading.Thread(target=self._run).start()
+    """, "CCR005") == []
+
+
+# --------------------------- fix-regression fixtures (mutation-style) ---
+# These replicate the PRE-fix shapes of the two serving-plane true
+# positives this analyzer caught, so re-introducing either hazard makes
+# CCR001 fire again even if the tree-wide self-check baseline drifts.
+
+def test_ccr001_refires_on_stats_estimate_under_admission_lock():
+    # pre-fix AdmissionController.stats(): queue-wait estimate computed
+    # UNDER the admission lock; the estimate falls through to
+    # engine.host_load(), which waits on the engine lock
+    pre_fix = run("""
+        import threading
+
+        class AdmissionController:
+            def _estimate(self):
+                return self.engine.host_load()
+
+            def stats(self):
+                with self._lock:
+                    return {"queue_wait_est_s": self._estimate()}
+    """, "CCR001")
+    assert len(pre_fix) == 1 and "via" in pre_fix[0].message
+
+    # the shipped fix: hoist the estimate above the lock
+    assert run("""
+        import threading
+
+        class AdmissionController:
+            def _estimate(self):
+                return self.engine.host_load()
+
+            def stats(self):
+                est = self._estimate()
+                with self._lock:
+                    return {"queue_wait_est_s": est}
+    """, "CCR001") == []
+
+
+def test_ccr001_refires_on_plane_publish_under_engine_lock():
+    # pre-fix LLMEngine._plane_publish: serialization + object-plane put
+    # + a 10s-timeout index register RPC, all inside the engine lock
+    pre_fix = run("""
+        class LLMEngine:
+            def _plane_publish(self, ks):
+                self._kv_plane.publish(ks)
+
+            def _stage_admission(self):
+                with self._lock:
+                    self._plane_publish([1])
+    """, "CCR001")
+    assert len(pre_fix) == 1
+
+    # the shipped fix: enqueue under the lock, publish at step tail
+    assert run("""
+        class LLMEngine:
+            def _stage_admission(self):
+                with self._lock:
+                    self._plane_offers.append([1])
+
+            def _flush_plane_offers(self):
+                offers, self._plane_offers = self._plane_offers, []
+                for ks in offers:
+                    self._kv_plane.publish(ks)
+    """, "CCR001") == []
+
+
+# ------------------------------------------------ baseline "why" policy
+def test_update_baseline_preserves_prior_why():
+    f = Finding("CCR001", "ray_tpu/x.py", 3, 4, "sleep [sleep] while holding C._lock", "C.m")
+    prior = bl.entries_from_findings([f])
+    prior[f.fingerprint()]["why"] = "accepted debt: tracked in ROADMAP"
+    fresh = bl.entries_from_findings([f], prior=prior)
+    assert fresh[f.fingerprint()]["why"] == "accepted debt: tracked in ROADMAP"
+
+
+def test_update_baseline_carries_why_across_rule_alias():
+    # entry hand-annotated under TPL004, regenerated after the rename
+    new = Finding("CCR006", "ray_tpu/x.py", 3, 4, "lock-order inversion", "")
+    old = Finding("TPL004", new.path, new.line, new.col, new.message, new.context)
+    prior = bl.entries_from_findings([old])
+    prior[old.fingerprint()]["why"] = "two-phase shutdown, documented"
+    fresh = bl.entries_from_findings([new], prior=prior)
+    assert fresh[new.fingerprint()]["why"] == "two-phase shutdown, documented"

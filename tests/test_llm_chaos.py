@@ -203,6 +203,31 @@ def test_admission_check_is_cheap(params):
     assert time.perf_counter() - t0 < 1.0
 
 
+def test_stats_estimates_queue_wait_outside_admission_lock(params):
+    """Regression for the CCR001 fix in AdmissionController.stats(): the
+    queue-wait estimate falls through to engine.host_load(), which waits
+    on the ENGINE lock (held for whole serving steps) — it must be
+    computed BEFORE taking the admission lock, or every ingress
+    check()/record_outcome() stalls behind a step boundary."""
+    eng = LLMEngine(CFG, params, max_num_seqs=1, max_seq_len=128)
+    eng._tel.service_ema_s = 10.0
+    eng.add_request(list(PROMPT), SamplingParams(max_tokens=2))
+    ac = AdmissionController(eng)
+    real_host_load = eng.host_load
+    held_at_host_load = []
+
+    def guarded():
+        held_at_host_load.append(ac._lock.locked())
+        return real_host_load()
+
+    eng.host_load = guarded
+    stats = ac.stats()
+    assert stats["queue_wait_est_s"] == pytest.approx(10.0)
+    assert held_at_host_load, "stats() stopped reading the live load snapshot"
+    assert not any(held_at_host_load), \
+        "stats() called engine.host_load() while holding the admission lock"
+
+
 def test_http_429_mapping_and_priority_plumbing():
     """OverloadedError carries 429 + retry-after through the proxy
     mapping, directly and through a wire-wrapped cause chain; the OpenAI

@@ -346,6 +346,32 @@ def test_publish_min_hits_policy(params, rt):
     assert idx.match_replicas(boundary_keys(SHARED + [0], 64)).keys() == {"A", "B"}
 
 
+def test_publish_runs_with_engine_lock_released(params, rt):
+    """Regression for the CCR001 fix in LLMEngine._plane_publish: the
+    actual publish — serialization, put_owned, a 10s-timeout index
+    register RPC — must run at the step tail with the engine lock
+    RELEASED (a slow plane/index must never stall admissions or any
+    lock-holding caller), while the block is still published by the time
+    step() returns (the contract every kvplane test above leans on)."""
+    idx = PrefixIndex()
+    client = _client(idx, "A")
+    eng = _engine(params, client)
+    real_publish = client.publish
+    held_at_publish = []
+
+    def guarded(*a, **kw):
+        held_at_publish.append(eng._lock.locked())
+        return real_publish(*a, **kw)
+
+    client.publish = guarded
+    eng.generate(SHARED + [5, 6], SP)
+    assert held_at_publish, "the minted prefix block was never offered to the plane"
+    assert not any(held_at_publish), \
+        "kv_plane.publish() ran while the engine lock was held"
+    assert eng.prefix_cache_stats()["remote"]["published_blocks"] == 1
+    assert idx.stats()["keys"] == 1  # registered by the time generate() returned
+
+
 def test_blocked_follower_still_hits_leaders_same_wave_store(params):
     """A leader and a shared-prefix follower arriving together, pool too
     small for both: the follower's first resolution MISSES (the leader's
