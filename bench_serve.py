@@ -1091,6 +1091,408 @@ def bench_kvplane(cfg, prompt_len: int, gen_len: int, n_replicas: int = 2,
     return rec
 
 
+def bench_kvplane_async(cfg, prompt_len: int, gen_len: int, n_prefixes: int = 4,
+                        fetch_delay_ms: float = 25.0) -> dict:
+    """Async vs sync-under-lock cluster-tier fetch A/B (ROADMAP item 3a).
+
+    A VICTIM request decodes a long stream on engine B while shared-
+    prefix followers arrive whose blocks live on engine A. SYNC arm (the
+    pre-async behavior, reconstructed by resolving the fetch inline at
+    admission): every fetch rides the engine lock, so the victim's
+    decode stalls behind each transfer — its ITL tail IS the fetch cost.
+    ASYNC arm (the shipped path): admission launches the fetch on the
+    engine's worker and keeps stepping; the victim never notices.
+
+    A fixed delay is added to BOTH arms' client fetch, standing in for
+    the multi-MB cross-host transfer a real fleet pays (tiny CPU blocks
+    fetch in microseconds — the A/B measures WHERE the cost lands, not
+    how big it is). The delay is ``fetch_delay_ms`` floored at 2.5x the
+    measured decode step wall, so a fetch span always outlasts a step:
+    the overlap evidence counts step records whose end timestamp falls
+    INSIDE a fetch span, which only a step running CONCURRENTLY with
+    the fetch can produce (sync is 0 by construction — the fetch blocks
+    the only stepping thread, and the blocked step ends after the span
+    closes). Victim ITL and follower TTFT come from the flight
+    recorder."""
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu.llm.engine import LLMEngine
+    from ray_tpu.llm.kvplane import KVPlaneClient, PrefixIndex
+    from ray_tpu.llm.sampling import SamplingParams
+
+    prefix_len = max(128, prompt_len)
+    suffix_len, gen = 8, min(gen_len, 8)
+    max_seq = 1 << (prefix_len + suffix_len + gen + 16 - 1).bit_length()
+    rng = np.random.default_rng(11)
+    # +1 warm prefix: each arm serves it once before the victim starts, so
+    # the fetch+scatter+suffix-prefill programs compile OUTSIDE the
+    # measured phase (a compile under the lock would swamp both arms' ITL)
+    prefixes = [
+        [int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=prefix_len)]
+        for _ in range(n_prefixes + 1)
+    ]
+    warm_prefix, prefixes = prefixes[0], prefixes[1:]
+    victim_prompt = [int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=suffix_len)]
+    victim_sp = SamplingParams(max_tokens=48, temperature=0.0)
+    sp = SamplingParams(max_tokens=gen, temperature=0.0)
+
+    def _sfx():
+        return [int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=suffix_len)]
+
+    rt.init(num_cpus=2)
+    try:
+        index = PrefixIndex()
+        a = LLMEngine(cfg, kv_plane=KVPlaneClient(index, "A", publish_min_hits=1),
+                      max_num_seqs=2, max_seq_len=max_seq)
+        for p in [warm_prefix] + prefixes:
+            a.generate(p + _sfx(), sp)  # A holds + registered every prefix
+        # size the simulated transfer off the model's actual decode step
+        # wall (A's flight recorder) — the span must outlast a step for
+        # the end-timestamp overlap evidence to resolve at any scale
+        walls = sorted(
+            s["wall_ms"] for s in a._tel.recorder.snapshot()["steps"]
+            if s.get("phase") == "decode"
+        )
+        step_wall_ms = walls[len(walls) // 2] if walls else 0.0
+        delay_s = max(fetch_delay_ms, 2.5 * step_wall_ms) / 1e3
+
+        def _arm(async_mode: bool) -> dict:
+            cb = KVPlaneClient(index, f"B-{'async' if async_mode else 'sync'}",
+                               publish_min_hits=1)
+            orig_fetch = cb.fetch
+
+            def slow_fetch(hit):
+                time.sleep(delay_s)
+                return orig_fetch(hit)
+
+            cb.fetch = slow_fetch
+            b = LLMEngine(cfg, kv_plane=cb, max_num_seqs=n_prefixes + 1,
+                          max_seq_len=max_seq)
+            if not async_mode:
+                # sync-under-lock reconstruction: mint the same record
+                # _launch_prefix_fetch would, but resolve it INLINE on
+                # the admission thread (which holds the engine lock) —
+                # the record is done before admission reads it, so it
+                # splices in the same wave, exactly the pre-item-3a flow
+                def launch_inline(request_id, prompt):
+                    rec = {
+                        "request_id": request_id, "done": False, "error": False,
+                        "lost": False, "pref": None, "restore": None,
+                        "nbytes": 0, "n_p": 0, "t0": time.time(), "t1": 0.0,
+                        "deadline": time.time() + b.prefix_fetch_deadline_s,
+                    }
+                    b._fetch_state[request_id] = rec
+                    b._run_prefix_fetch(rec, [int(t) for t in prompt])
+                    return rec
+
+                b._launch_prefix_fetch = launch_inline
+            # compile outside the timed region: victim's prefill/decode
+            # buckets, and the full remote-hit path (fetch via the arm's
+            # launch + scatter-in + suffix prefill) through warm_prefix
+            warm_v = [int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=suffix_len)]
+            b.generate(warm_v, SamplingParams(max_tokens=2, temperature=0.0))
+            b.generate(warm_prefix + _sfx(), SamplingParams(max_tokens=2, temperature=0.0))
+            vid = b.add_request(victim_prompt, victim_sp)
+            while True:  # victim decoding before any follower arrives
+                with b._lock:
+                    if len(b._requests[vid].token_ids) >= 2:
+                        break
+                b.step()
+            for p in prefixes:
+                b.add_request(p + _sfx(), sp)
+            while b.has_unfinished():
+                b.step()
+            snap = b._tel.recorder.snapshot()
+            itls, ttfts = [], []
+            for rec in snap["requests"]:
+                if rec["prompt_tokens"] == len(victim_prompt) and rec["itl_s"]:
+                    itls = list(rec["itl_s"])
+                elif rec["prompt_tokens"] == prefix_len + suffix_len and rec["ttft_s"] is not None:
+                    ttfts.append(rec["ttft_s"])
+            # only the measured followers' spans: drop the warm request's
+            spans = [f for f in snap["fetches"] if f["hit"]][-n_prefixes:]
+            overlapped = sum(
+                1 for f in spans
+                if any(f["t0"] <= s["t"] <= f["t1"] for s in snap["steps"])
+            )
+            remote = b.prefix_cache_stats()["remote"]
+            return {
+                "victim_itl_ms_p50": _pct(itls, 0.50),
+                "victim_itl_ms_p99": _pct(itls, 0.99),
+                "follower_ttft_ms_p50": _pct(ttfts, 0.50),
+                "remote_hits": remote["hits"],
+                "fetch_spans": len(spans),
+                "fetch_spans_overlapping_steps": overlapped,
+                "telemetry": True,  # provenance: flight-recorder-sourced
+            }
+
+        sync = _arm(False)
+        async_ = _arm(True)
+    finally:
+        rt.shutdown()
+    speed = (sync["victim_itl_ms_p99"] / async_["victim_itl_ms_p99"]) if async_["victim_itl_ms_p99"] else None
+    rec = {
+        "metric": "engine_kvplane_async_ab",
+        **_device_info(),
+        "kv_dtype": cfg.dtype,
+        "tp": 1,
+        "tp_collective": "fp",
+        "kvplane": True,
+        "workload": (
+            f"victim decode stream (48 tokens) on B while {n_prefixes} shared-prefix followers "
+            f"(len {prefix_len}) fetch remote blocks from A at +{round(delay_s * 1e3, 1)} ms "
+            f"simulated transfer each (2.5x median decode step wall); sync arm resolves the "
+            f"fetch inline under the engine lock"
+        ),
+        "fetch_delay_ms": round(delay_s * 1e3, 1),
+        "decode_step_wall_ms": round(step_wall_ms, 2),
+        "sync_under_lock": sync,
+        "async_fetch": async_,
+        "victim_itl_p99_speedup": round(speed, 2) if speed else None,
+    }
+    print(
+        f"  victim ITL p50/p99 sync {sync['victim_itl_ms_p50']}/{sync['victim_itl_ms_p99']} ms "
+        f"-> async {async_['victim_itl_ms_p50']}/{async_['victim_itl_ms_p99']} ms "
+        f"({rec['victim_itl_p99_speedup']}x p99); overlap evidence: "
+        f"{async_['fetch_spans_overlapping_steps']}/{async_['fetch_spans']} async fetch spans "
+        f"contain step records (sync: {sync['fetch_spans_overlapping_steps']})",
+        flush=True,
+    )
+    return rec
+
+
+def bench_kvplane_prefetch(cfg, prompt_len: int, gen_len: int, n_prefixes: int = 4) -> dict:
+    """Predictive-prefetch hit-rate uplift A/B (ROADMAP item 3b): the
+    fleet's hot system prompts land on replica B BEFORE its first
+    request. Baseline arm: B serves one request per hot prefix cold —
+    every hit is a REMOTE fetch at admission time. Prefetch arm: a
+    heartbeat prefetch round (index top_hot over router-accrued demand)
+    pulls the blocks into B's local cache first, so the same traffic is
+    all LOCAL-tier hits, attributed as ``prefetch_hits``."""
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu.llm.engine import LLMEngine
+    from ray_tpu.llm.kvplane import KVPlaneClient, PrefixIndex, boundary_keys
+    from ray_tpu.llm.sampling import SamplingParams
+
+    prefix_len = max(128, prompt_len)
+    suffix_len, gen = 8, min(gen_len, 8)
+    max_seq = 1 << (prefix_len + suffix_len + gen + 16 - 1).bit_length()
+    rng = np.random.default_rng(13)
+    prefixes = [
+        [int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=prefix_len)]
+        for _ in range(n_prefixes)
+    ]
+    sp = SamplingParams(max_tokens=gen, temperature=0.0)
+
+    def _sfx():
+        return [int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=suffix_len)]
+
+    rt.init(num_cpus=2)
+    try:
+        index = PrefixIndex()
+        a = LLMEngine(cfg, kv_plane=KVPlaneClient(index, "A", publish_min_hits=1),
+                      max_num_seqs=2, max_seq_len=max_seq)
+        for p in prefixes:
+            a.generate(p + _sfx(), sp)
+        # router-shaped demand: every match_replicas scores bump the keys
+        blk = a._prefix_cache.block
+        for p in prefixes:
+            for _ in range(3):
+                index.match_replicas(boundary_keys(p + [1] * suffix_len, blk))
+
+        def _arm(prefetch: bool) -> dict:
+            cb = KVPlaneClient(index, f"B-{'pf' if prefetch else 'cold'}",
+                               publish_min_hits=1,
+                               prefetch_k=n_prefixes if prefetch else 0,
+                               heartbeat_every_s=0.0)
+            b = LLMEngine(cfg, kv_plane=cb, max_num_seqs=2, max_seq_len=max_seq)
+            warm = [int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=prefix_len)]
+            b.generate(warm + _sfx(), SamplingParams(max_tokens=2, temperature=0.0))
+            b.generate(warm + _sfx() + [1], SamplingParams(max_tokens=2, temperature=0.0))
+            if prefetch:
+                cb.maybe_heartbeat()  # one prefetch round on the worker
+                t = cb._prefetch_thread
+                if t is not None:
+                    t.join(120.0)
+                cb.prefetch_k = 0  # freeze: the measured phase stays fixed
+            s0 = b.prefix_cache_stats()
+            for p in prefixes:
+                b.generate(p + _sfx(), sp)
+            s1 = b.prefix_cache_stats()
+            remote = {k: s1["remote"][k] - s0["remote"][k] for k in s1["remote"]}
+            local_hits = s1["local"]["hits"] - s0["local"]["hits"]
+            # true TTFT from the flight recorder: the measured requests
+            # are the LAST n_prefixes records at the hit prompt shape
+            # (the warm request shares the length — slice it off)
+            ttfts = [
+                rec["ttft_s"]
+                for rec in b._tel.recorder.snapshot()["requests"]
+                if rec["prompt_tokens"] == prefix_len + suffix_len
+                and rec["ttft_s"] is not None
+            ][-n_prefixes:]
+            return {
+                "requests": n_prefixes,
+                "local_hits": local_hits,
+                "remote_hits": remote["hits"],
+                "prefetch_hits": remote["prefetch_hits"],
+                "prefetched_blocks": s1["remote"]["prefetched_blocks"],
+                "local_hit_rate": round(local_hits / n_prefixes, 3),
+                "ttft_ms_p50": _pct(ttfts, 0.50),
+                "telemetry": True,  # provenance: flight-recorder-sourced
+            }
+
+        cold = _arm(False)
+        pf = _arm(True)
+    finally:
+        rt.shutdown()
+    rec = {
+        "metric": "engine_kvplane_prefetch_ab",
+        **_device_info(),
+        "kv_dtype": cfg.dtype,
+        "tp": 1,
+        "tp_collective": "fp",
+        "kvplane": True,
+        "workload": (
+            f"{n_prefixes} hot system prompts (len {prefix_len}) published on A with router "
+            f"demand; B serves one request per prefix, cold vs after one heartbeat prefetch round"
+        ),
+        "cold_baseline": cold,
+        "prefetch": pf,
+        "local_hit_rate_uplift": round(pf["local_hit_rate"] - cold["local_hit_rate"], 3),
+        "ttft_p50_speedup": (
+            round(cold["ttft_ms_p50"] / pf["ttft_ms_p50"], 2) if pf["ttft_ms_p50"] else None
+        ),
+    }
+    print(
+        f"  cold: {cold['remote_hits']} remote hits (local rate {cold['local_hit_rate']}, "
+        f"TTFT p50 {cold['ttft_ms_p50']} ms) -> prefetch: {pf['prefetch_hits']} "
+        f"prefetch-converted local hits (local rate {pf['local_hit_rate']}, uplift "
+        f"{rec['local_hit_rate_uplift']}, TTFT p50 {pf['ttft_ms_p50']} ms, "
+        f"{rec['ttft_p50_speedup']}x)",
+        flush=True,
+    )
+    return rec
+
+
+def bench_conversation_resume(cfg, prompt_len: int, gen_lens=(16, 48, 128),
+                              max_num_seqs: int = 4) -> dict:
+    """Tiered conversation KV A/B (ROADMAP item 3c): time-to-next-token
+    when an idle conversation returns, at several history lengths G.
+
+    - RESUME arm: the conversation decoded G tokens, went idle, and was
+      suspended (KV spilled out of HBM through the migration codec,
+      slot/pages freed). resume_suspended scatters the block back in:
+      TTNT = resume call -> token G+1; recomputed tokens = 0.
+    - RE-PREFILL arm (the no-tiering baseline): the conversation was
+      simply evicted; the returning user pays a full prompt prefill plus
+      G recomputed decode steps to reach the same token.
+
+    Resume cost is ~flat in G (one scatter + one step); re-prefill grows
+    linearly — at fleet scale the gap is why effective KV capacity is
+    DRAM, not HBM."""
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu.llm.engine import LLMEngine
+    from ray_tpu.llm.sampling import SamplingParams
+
+    rng = np.random.default_rng(5)
+    prompt = [int(x) for x in rng.integers(1, cfg.vocab_size - 1, size=prompt_len)]
+    gen_lens = [g for g in gen_lens if prompt_len + g + 9 <= cfg.max_seq_len] or [8]
+
+    def _run_until(eng, rid, n_tokens):
+        while True:
+            with eng._lock:
+                st = eng._requests.get(rid)
+                if st is None or st.finished or len(st.token_ids) >= n_tokens:
+                    return
+            eng.step()
+
+    rt.init(num_cpus=2)
+    try:
+        eng = LLMEngine(cfg, max_num_seqs=max_num_seqs, max_seq_len=cfg.max_seq_len,
+                        enable_prefix_caching=False)
+        warm_sp = SamplingParams(temperature=0.0, max_tokens=3)
+        eng.generate(prompt, warm_sp)
+        # warm the suspend/resume cycle at EVERY row's history length:
+        # each G can land in a different checkpoint-block bucket, and the
+        # restore scatter compiles per bucket width (bench_migrate's
+        # warm-every-bucket discipline)
+        for g in gen_lens:
+            wid = eng.add_request(prompt, SamplingParams(temperature=0.0, max_tokens=g + 8))
+            _run_until(eng, wid, g)
+            eng.suspend_request(wid, publish=False)
+            eng.resume_suspended(wid)
+            _run_until(eng, wid, g + 2)
+            eng.abort_request(wid)
+            while eng.has_unfinished():
+                eng.step()
+
+        rows = []
+        for g in gen_lens:
+            sp = SamplingParams(temperature=0.0, max_tokens=g + 8)
+            # --- suspend/resume arm ---
+            rid = eng.add_request(prompt, sp)
+            _run_until(eng, rid, g)
+            t0 = time.perf_counter()
+            info = eng.suspend_request(rid)  # DRAM + object plane
+            suspend_ms = (time.perf_counter() - t0) * 1e3
+            emitted = len(eng._suspended[rid]["state"]["emitted_token_ids"])
+            t0 = time.perf_counter()
+            eng.resume_suspended(rid)
+            _run_until(eng, rid, emitted + 1)
+            ttnt_resume = time.perf_counter() - t0
+            eng.abort_request(rid)
+            while eng.has_unfinished():
+                eng.step()
+            # --- re-prefill arm (evicted conversation) ---
+            t0 = time.perf_counter()
+            rid2 = eng.add_request(prompt, sp)
+            _run_until(eng, rid2, g + 1)
+            ttnt_reprefill = time.perf_counter() - t0
+            eng.abort_request(rid2)
+            while eng.has_unfinished():
+                eng.step()
+            rows.append({
+                "gen_history": g,
+                "resume_ttnt_ms": round(ttnt_resume * 1e3, 2),
+                "reprefill_ttnt_ms": round(ttnt_reprefill * 1e3, 2),
+                "speedup": round(ttnt_reprefill / ttnt_resume, 2) if ttnt_resume else None,
+                "suspend_ms": round(suspend_ms, 2),
+                "spilled_bytes": int(info["nbytes"]),
+                "published": info["published"],
+                "recomputed_tokens_resume": 0,
+                "recomputed_tokens_reprefill": g,
+            })
+            print(
+                f"  G={g}: resume TTNT {rows[-1]['resume_ttnt_ms']} ms "
+                f"({rows[-1]['spilled_bytes'] >> 10} KiB spilled) vs re-prefill "
+                f"{rows[-1]['reprefill_ttnt_ms']} ms ({rows[-1]['speedup']}x, "
+                f"{g} tokens recomputed)",
+                flush=True,
+            )
+        spill = eng.suspend_stats()
+    finally:
+        rt.shutdown()
+    return {
+        "metric": "engine_conversation_resume_ab",
+        **_device_info(),
+        "kv_dtype": str(eng.kv_dtype),
+        "tp": 1,
+        "tp_collective": "fp",
+        "workload": (
+            f"prompt {prompt_len}, conversation idles after G generated tokens; TTNT = return -> "
+            f"token G+1 (resume: scatter-in from the DRAM/object-plane tier; re-prefill: full "
+            f"prompt prefill + G recomputed decode steps)"
+        ),
+        "suspend_stats": spill,
+        "rows": rows,
+    }
+
+
 def bench_overload(cfg, max_num_seqs: int = 4, stream_gen: int = 96, n_phases: int = 3,
                    arrivals_per_phase: int = 8) -> dict:
     """Overload A/B (serve/overload.py): an OPEN-LOOP ramp of
@@ -1602,6 +2004,9 @@ def main(argv=None):
     benches.append(("engine_tp_ab", lambda: bench_tp(cfg, prompt_len, gen_len, repeats=args.repeats)))
     benches.append(("engine_disagg_ab", lambda: bench_disagg(cfg, prompt_len, gen_len)))
     benches.append(("engine_kvplane_ab", lambda: bench_kvplane(cfg, prompt_len, gen_len)))
+    benches.append(("engine_kvplane_async_ab", lambda: bench_kvplane_async(cfg, prompt_len, gen_len)))
+    benches.append(("engine_kvplane_prefetch_ab", lambda: bench_kvplane_prefetch(cfg, prompt_len, gen_len)))
+    benches.append(("engine_conversation_resume_ab", lambda: bench_conversation_resume(cfg, prompt_len)))
     benches.append(("engine_overload_ab", lambda: bench_overload(cfg)))
     benches.append(("engine_migrate_ab", lambda: bench_migrate(cfg, prompt_len)))
     benches.append(("full_stack", lambda: bench_full_stack(cfg, prompt_len, gen_len, args.concurrency, args.tiny or args.small)))

@@ -15,6 +15,15 @@ direct.get_owned_view  borrow-get of an owned object (handoff/prefix/
 handoff.put         disagg/kvplane handoff publish (codec -> owned object)
 handoff.fetch       bounded-retry handoff fetch (each ATTEMPT is a hit)
 kvplane.index       every cluster prefix-index RPC (filter with methods=)
+kvplane.prefetch    one predictive-prefetch round (client worker thread):
+                    a DROP rule skips the round outright, a delay rule
+                    models slow hot-block transfers, a raises rule faults
+                    mid-round — all must leave serving token-identical
+                    (prefetch is opportunism, never load-bearing)
+llm.suspend         engine.suspend_request's spill decision (tiered
+                    conversation KV): a DROP/raises rule degrades to a
+                    typed MigrationError with the conversation still
+                    RUNNING untouched; a delay rule models slow spill
 serve.step          the serve replica's stepper tick (stall = delay rule,
                     kill = raises rule: the stepper dies exactly like a
                     replica crash — waiters fail, health check trips)
@@ -75,6 +84,8 @@ SITES = frozenset({
     "handoff.put",
     "handoff.fetch",
     "kvplane.index",
+    "kvplane.prefetch",
+    "llm.suspend",
     "serve.step",
     "serve.preempt",
 })

@@ -138,6 +138,17 @@ def grafana_dashboard_json(client=None, *, datasource: str = "Prometheus", title
         ("histogram_quantile(0.99, sum by (le) (rate(rt_llm_migration_splice_s_bucket[5m])))", "splice p99 (s)"),
         ("rate(rt_llm_migration_bytes_total[1m])", "checkpoint B/s"),
     ], w=12, x=0)
+    add("Serving: KV tiering", [
+        # latency-hiding KV plane v2 (ROADMAP item 3): the async fetch
+        # span p99 (transfers overlapping serving steps — compare against
+        # the ITL panel: a healthy fleet's fetch p99 exceeding ITL is
+        # FINE, that's the latency being hidden), the predictive
+        # prefetcher's remote->local conversion rate, and the
+        # conversation-KV spill volume leaving HBM for the DRAM tier
+        ("histogram_quantile(0.99, sum by (le) (rate(rt_llm_prefix_fetch_overlap_s_bucket[5m])))", "async fetch p99 (s)"),
+        ("rate(rt_llm_prefix_prefetch_hits_total[5m])", "prefetch-converted hits/s"),
+        ("rate(rt_llm_kv_spilled_bytes_total[1m])", "KV spill B/s"),
+    ], w=12, x=12)
 
     # -- one panel per registered metric (user Counters/Gauges/Histograms) --
     try:

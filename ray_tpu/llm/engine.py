@@ -299,6 +299,7 @@ class LLMEngine:
         prefix_cache_bytes: int = 256 << 20,
         prefix_block: int = 64,
         kv_plane=None,
+        prefix_fetch_deadline_s: float = 2.0,
         kv_layout: str = "slots",
         num_pages: int | None = None,
         page_size: int = 64,
@@ -363,13 +364,20 @@ class LLMEngine:
         kv_plane (llm.kvplane.KVPlaneClient | None): joins this engine to
         the CLUSTER prefix tier (ray_tpu/llm/kvplane/). Freshly cached
         prefixes publish as owned objects on the direct plane; a local
-        prefix-cache miss consults the cluster index, fetches the longest
-        live remote block (bounded retry — an evicted/lost block degrades
-        to local prefill, never a hang), scatter-ins through the existing
-        fused insert/transparent-requant path, and re-stores + republishes
-        locally so the next hit is local-tier. Requires
-        enable_prefix_caching=True (the plane IS the cache's cluster
-        tier). prefix_cache_stats() grows local/remote hit tiers."""
+        prefix-cache miss LAUNCHES the cluster lookup+fetch on the
+        engine's fetch worker — never under the engine lock — and the
+        result splices in at a later admission wave, overlapping the
+        transfer with the current wave's prefill/decode work. A landed
+        block (bounded retry — an evicted/lost block degrades to local
+        prefill, never a hang) scatter-ins through the existing fused
+        insert/transparent-requant path and re-stores + republishes
+        locally so the next hit is local-tier.
+        ``prefix_fetch_deadline_s`` bounds how long an admission defers
+        a request on its in-flight fetch: past it the request degrades
+        to a plain local prefill and the late result is discarded.
+        Requires enable_prefix_caching=True (the plane IS the cache's
+        cluster tier). prefix_cache_stats() grows local/remote hit
+        tiers."""
         import jax
         import jax.numpy as jnp
 
@@ -535,10 +543,40 @@ class LLMEngine:
         # remote hits, deregister on eviction. Remote-tier counters live
         # here (the PrefixCache keeps its local-tier ones).
         self._kv_plane = kv_plane
+        # the FULL counter set is seeded here — including the failure and
+        # async/prefetch legs — so prefix_cache_stats() tiers never change
+        # shape before/after the first error (no lazy .get() minting)
         self._plane_stats = {
             "hits": 0, "tokens_saved": 0, "fetched_bytes": 0,
             "lost": 0, "published_blocks": 0, "published_bytes": 0,
+            "errors": 0, "abandoned": 0,
+            "prefetched_blocks": 0, "prefetched_bytes": 0, "prefetch_hits": 0,
         }
+        # ASYNC cluster-tier fetch (ROADMAP item 3a): admission LAUNCHES
+        # lookup+fetch+validate on the fetch worker and keeps planning;
+        # the result splices in at a later wave. _fetch_state maps
+        # request_id -> in-flight record, guarded-by: _lock; the record
+        # dict itself is FILLED by the worker thread (plain assignments,
+        # "done" flipped last — atomic under the GIL) and only read at
+        # admission once "done" is observed.
+        self.prefix_fetch_deadline_s = float(prefix_fetch_deadline_s)
+        self._fetch_state: dict[str, dict] = {}
+        self._fetch_q = None  # lazy: SimpleQueue + daemon worker on first launch
+        self._fetch_thread = None
+        # deadline-abandoned fetch records awaiting their worker's
+        # terminal resolution: reaped (stats credit only — the request
+        # already prefilled locally) at admission and on a stats read.
+        # Without the reap, a client fetch budget above the engine
+        # deadline means lost/errors are never counted under async.
+        self._fetch_zombies: list[dict] = []  # guarded-by: _lock
+        # boundary keys minted by the predictive prefetcher
+        # (adopt_prefetched): local hits on them count as prefetch hits
+        self._prefetched_keys: set[bytes] = set()  # guarded-by: _lock
+        # tiered conversation KV (ROADMAP item 3c): suspended
+        # conversations spilled out of HBM — request_id -> {"state" (host
+        # DRAM tier), "meta", "ref" (object-plane tier), "nbytes", "t"}
+        self._suspended: dict[str, dict] = {}  # guarded-by: _lock
+        self._suspend_stats = {"suspended": 0, "resumed": 0, "spilled_bytes": 0, "dropped": 0}
         # publishes minted under the engine lock (admission self-heal,
         # remote-fetch republish, prefill store) are deferred here and
         # flushed at the step tail AFTER the lock is released: a publish
@@ -886,10 +924,11 @@ class LLMEngine:
         with self._lock:
             if self._prefix_cache is None:
                 return {}
+            self._reap_fetch_zombies_locked()
             out = self._prefix_cache.stats()
             out["local"] = {"hits": out["hits"], "tokens_saved": out["tokens_saved"]}
             if self._kv_plane is not None:
-                out["remote"] = dict(self._plane_stats)
+                out["remote"] = dict(self._plane_stats, inflight_fetches=len(self._fetch_state))
                 out["plane"] = self._kv_plane.stats()
             return out
 
@@ -1103,107 +1142,223 @@ class LLMEngine:
         generated tokens (its live key existed only on a bound lane; a
         cold re-admission would resample the suffix — the router's
         re-prefill leg is the token-identical fallback there)."""
+        with self._lock:
+            return self._checkpoint_locked(request_id)
+
+    def _checkpoint_locked(self, request_id: str) -> dict:
+        # holds-lock: _lock — shared by checkpoint_request (migration)
+        # and suspend_request (tiered conversation KV), which must
+        # checkpoint AND finish under ONE lock acquisition so no decode
+        # step can advance the state between snapshot and retirement
         from ray_tpu.llm.migrate import LIVE_KIND, MigrationError
 
-        with self._lock:
-            st = self._requests.get(request_id)
-            if st is None or st.finished:
-                raise MigrationError(f"request {request_id!r} is not in flight")
-            if st.prefill_only:
-                raise MigrationError("prefill-only requests hand off, they do not migrate")
-            if st.out_queue is not None:
-                raise MigrationError(
-                    "streaming requests cannot migrate (the consumer holds a live token queue)"
-                )
-            if self._device_resident and self._pending is not None:
-                prev, self._pending = self._pending, None
-                if self._spec_cfg is not None:
-                    self._drain_spec(prev)
-                else:
-                    self._drain(prev)
-                if st.finished:
-                    raise MigrationError(
-                        f"request {request_id!r} finished while settling the in-flight step"
-                    )
-            p = st.params
-            state: dict = {
-                "kind": LIVE_KIND,
-                "prompt_token_ids": list(st.prompt_token_ids),
-                "emitted_token_ids": list(st.token_ids),
-                "emitted_logprobs": [float(x) for x in st.logprobs],
-                "sampling": {
-                    "max_tokens": int(p.max_tokens),
-                    "temperature": float(p.temperature),
-                    "top_k": int(p.top_k),
-                    "top_p": float(p.top_p),
-                    "stop_token_ids": [int(t) for t in p.stop_token_ids],
-                    "seed": None if p.seed is None else int(p.seed),
-                    "logprobs": bool(p.logprobs),
-                    "priority": int(p.priority),
-                },
-                "spec": None,
-            }
-            if st.t_submit:
-                state["submitted_at"] = float(st.t_submit)
-            if st.trace is not None:
-                state["trace"] = {"trace_id": st.trace[0], "parent_id": st.trace[1]}
+        st = self._requests.get(request_id)
+        if st is None or st.finished:
+            raise MigrationError(f"request {request_id!r} is not in flight")
+        if st.prefill_only:
+            raise MigrationError("prefill-only requests hand off, they do not migrate")
+        if st.out_queue is not None:
+            raise MigrationError(
+                "streaming requests cannot migrate (the consumer holds a live token queue)"
+            )
+        if self._device_resident and self._pending is not None:
+            prev, self._pending = self._pending, None
             if self._spec_cfg is not None:
-                exp = self._controller.export(request_id)
-                if exp is not None:
-                    state["spec"] = {"ema": exp[0], "k": int(exp[1])}
-            if st.slot < 0:
-                # COLD checkpoint: the request is waiting (queued or
-                # recompute-preempted) — no bound lane, no live KV/key.
-                # The peer re-admits prompt+generated exactly like a
-                # local recompute preemption: token-identical for greedy
-                # (and for fresh requests with nothing generated yet).
-                if st.token_ids and p.temperature > 0.0:
-                    raise MigrationError(
-                        "cannot cold-checkpoint a sampled request with generated tokens "
-                        "(its live PRNG key exists only on a bound lane); the router's "
-                        "re-prefill leg is the token-identical fallback"
-                    )
-                if self._tel is not None:
-                    self._tel.on_migration("checkpointed", 0)
-                return state
-            slot = st.slot
-            l = len(st.prompt_token_ids) + len(st.token_ids) - 1
-            # the authoritative cache length must agree with the host
-            # view before the block can claim to cover l positions
-            if self.kv_layout == "paged":
-                l_auth = int(self._lengths[slot])
+                self._drain_spec(prev)
             else:
-                l_auth = int(np.asarray(self.cache["length"][slot]))
-            if l_auth != l:
+                self._drain(prev)
+            if st.finished:
                 raise MigrationError(
-                    f"inconsistent decode state for {request_id!r}: cache length "
-                    f"{l_auth} != prompt + emitted - 1 = {l}"
+                    f"request {request_id!r} finished while settling the in-flight step"
                 )
-            T = _bucket(l, self.prefill_buckets)
-            if self.kv_layout == "paged":
-                page = self._pcfg.page_size
-                # table cells past the allocated pages are 0 (trash):
-                # the gather's tail is garbage the peer masks by length
-                row = np.asarray(self._tables[slot][: T // page], np.int32)
-                out = self._extract_paged(self.pool, row)
-            else:
-                out = self._extract_slots(self.cache, np.int32(slot), T)
-            state.update(k=np.asarray(out[0]), v=np.asarray(out[1]), n=l)
-            if len(out) == 4:
-                state.update(k_scale=np.asarray(out[2]), v_scale=np.asarray(out[3]))
-            # the LIVE key: on the device-resident loop it advanced on
-            # device (seeded lanes included — restore must continue the
-            # sequence, never reset from the seed); sync keeps it on host
-            if self._device_resident:
-                state["rng_key"] = np.asarray(self._dkeys[slot]).astype(np.uint32)
-            else:
-                state["rng_key"] = np.asarray(self._keys[slot], np.uint32)
+        p = st.params
+        state: dict = {
+            "kind": LIVE_KIND,
+            "prompt_token_ids": list(st.prompt_token_ids),
+            "emitted_token_ids": list(st.token_ids),
+            "emitted_logprobs": [float(x) for x in st.logprobs],
+            "sampling": {
+                "max_tokens": int(p.max_tokens),
+                "temperature": float(p.temperature),
+                "top_k": int(p.top_k),
+                "top_p": float(p.top_p),
+                "stop_token_ids": [int(t) for t in p.stop_token_ids],
+                "seed": None if p.seed is None else int(p.seed),
+                "logprobs": bool(p.logprobs),
+                "priority": int(p.priority),
+            },
+            "spec": None,
+        }
+        if st.t_submit:
+            state["submitted_at"] = float(st.t_submit)
+        if st.trace is not None:
+            state["trace"] = {"trace_id": st.trace[0], "parent_id": st.trace[1]}
+        if self._spec_cfg is not None:
+            exp = self._controller.export(request_id)
+            if exp is not None:
+                state["spec"] = {"ema": exp[0], "k": int(exp[1])}
+        if st.slot < 0:
+            # COLD checkpoint: the request is waiting (queued or
+            # recompute-preempted) — no bound lane, no live KV/key.
+            # The peer re-admits prompt+generated exactly like a
+            # local recompute preemption: token-identical for greedy
+            # (and for fresh requests with nothing generated yet).
+            if st.token_ids and p.temperature > 0.0:
+                raise MigrationError(
+                    "cannot cold-checkpoint a sampled request with generated tokens "
+                    "(its live PRNG key exists only on a bound lane); the router's "
+                    "re-prefill leg is the token-identical fallback"
+                )
             if self._tel is not None:
-                nbytes = int(state["k"].nbytes + state["v"].nbytes)
-                if state.get("k_scale") is not None:
-                    nbytes += int(state["k_scale"].nbytes + state["v_scale"].nbytes)
-                self._tel.on_migration("checkpointed", nbytes)
+                self._tel.on_migration("checkpointed", 0)
             return state
+        slot = st.slot
+        l = len(st.prompt_token_ids) + len(st.token_ids) - 1
+        # the authoritative cache length must agree with the host
+        # view before the block can claim to cover l positions
+        if self.kv_layout == "paged":
+            l_auth = int(self._lengths[slot])
+        else:
+            l_auth = int(np.asarray(self.cache["length"][slot]))
+        if l_auth != l:
+            raise MigrationError(
+                f"inconsistent decode state for {request_id!r}: cache length "
+                f"{l_auth} != prompt + emitted - 1 = {l}"
+            )
+        T = _bucket(l, self.prefill_buckets)
+        if self.kv_layout == "paged":
+            page = self._pcfg.page_size
+            # table cells past the allocated pages are 0 (trash):
+            # the gather's tail is garbage the peer masks by length
+            row = np.asarray(self._tables[slot][: T // page], np.int32)
+            out = self._extract_paged(self.pool, row)
+        else:
+            out = self._extract_slots(self.cache, np.int32(slot), T)
+        state.update(k=np.asarray(out[0]), v=np.asarray(out[1]), n=l)
+        if len(out) == 4:
+            state.update(k_scale=np.asarray(out[2]), v_scale=np.asarray(out[3]))
+        # the LIVE key: on the device-resident loop it advanced on
+        # device (seeded lanes included — restore must continue the
+        # sequence, never reset from the seed); sync keeps it on host
+        if self._device_resident:
+            state["rng_key"] = np.asarray(self._dkeys[slot]).astype(np.uint32)
+        else:
+            state["rng_key"] = np.asarray(self._keys[slot], np.uint32)
+        if self._tel is not None:
+            nbytes = int(state["k"].nbytes + state["v"].nbytes)
+            if state.get("k_scale") is not None:
+                nbytes += int(state["k_scale"].nbytes + state["v_scale"].nbytes)
+            self._tel.on_migration("checkpointed", nbytes)
+        return state
+
+    # ------------------------------------------------ tiered conversation KV
+
+    def suspend_request(self, request_id: str, *, publish: bool = True) -> dict:
+        """Spill an IDLE conversation's KV out of HBM (ROADMAP item 3c):
+        checkpoint the request through the migration codec (fused
+        extract, int8 wire, live PRNG key) and retire its slot/pages,
+        keeping the state in host DRAM — and, with ``publish=True``, on
+        the object plane too (``migrate.publish``), so any replica can
+        resume it. ``resume_suspended`` scatters the block back in
+        instead of re-prefilling: resume cost is one transfer, flat in
+        history length.
+
+        Checkpoint + retire happen under ONE lock acquisition (no decode
+        step can advance the state in between); the plane publish runs
+        OUTSIDE the lock, and a publish failure degrades to the DRAM
+        tier (ref=None), never an error. Raises MigrationError when the
+        request cannot suspend (unknown/finished, streaming, prefill-
+        only, cold-sampled-with-tokens) or when a chaos rule at
+        ``llm.suspend`` drops the spill decision — in every refusal the
+        conversation is untouched and still RUNNING."""
+        from ray_tpu import chaos
+        from ray_tpu.llm import migrate as _mig
+
+        # the chaos gate sits OUTSIDE the lock and BEFORE the snapshot:
+        # an injected drop/fault models "the spill path is down" and must
+        # degrade to the typed refusal with zero request state mutated
+        try:
+            ok = chaos.apply("llm.suspend")
+        except _mig.MigrationError:
+            raise
+        except Exception as e:  # noqa: BLE001 — injected fault, typed on the way out
+            raise _mig.MigrationError(f"suspend of {request_id!r} faulted: {e}") from e
+        if not ok:
+            raise _mig.MigrationError(f"suspend of {request_id!r} dropped (chaos)")
+        with self._lock:
+            state = self._checkpoint_locked(request_id)
+            st = self._requests[request_id]
+            self._finish(st, "suspended")
+            nbytes = _mig.state_nbytes(state)
+            self._suspend_stats["suspended"] += 1
+            self._suspend_stats["spilled_bytes"] += nbytes
+            rec = {"state": state, "meta": None, "ref": None, "nbytes": nbytes, "t": time.time()}
+            self._suspended[request_id] = rec
+        if self._tel is not None:
+            self._tel.on_kv_spill(nbytes)
+        if publish:
+            try:
+                meta, ref = _mig.publish(state)
+                with self._lock:
+                    rec["ref"], rec["meta"] = ref, meta
+            except Exception:  # noqa: BLE001 — DRAM tier stays valid
+                pass
+        return {"request_id": request_id, "nbytes": nbytes, "published": rec["ref"] is not None}
+
+    def resume_suspended(
+        self, request_id: str, stream: bool = False, out_queue=None
+    ) -> str:
+        """Re-admit a suspended conversation under its ORIGINAL request
+        id: the spilled block scatters back in through the transferred-KV
+        admission path (restore_request — exact PRNG key, no re-prefill,
+        no token re-emission), racing concurrent admission safely
+        because restore just appends to the waiting queue under the
+        lock. Prefers the DRAM copy; falls back to fetching the plane
+        ref. Raises MigrationError for an unknown suspension or when
+        both tiers are gone (MigrationLostError from the fetch)."""
+        from ray_tpu.llm import migrate as _mig
+
+        with self._lock:
+            rec = self._suspended.pop(request_id, None)
+        if rec is None:
+            raise _mig.MigrationError(f"no suspended conversation {request_id!r}")
+        state = rec["state"]
+        if state is None:
+            try:
+                state = _mig.fetch(rec["ref"], rec["meta"])
+            except Exception:
+                with self._lock:
+                    self._suspend_stats["dropped"] += 1
+                raise
+        try:
+            rid = self.restore_request(
+                state, request_id=request_id, stream=stream, out_queue=out_queue
+            )
+        except Exception:
+            with self._lock:  # refused restore: keep the record claimable
+                self._suspended.setdefault(request_id, rec)
+            raise
+        with self._lock:
+            self._suspend_stats["resumed"] += 1
+        return rid
+
+    def suspended_requests(self) -> list:
+        """Request ids currently spilled to the conversation-KV tier."""
+        with self._lock:
+            return sorted(self._suspended)
+
+    def drop_suspended(self, request_id: str) -> bool:
+        """Discard a suspended conversation (client gone, TTL expired):
+        frees the DRAM copy; the plane ref ages out with its owner."""
+        with self._lock:
+            rec = self._suspended.pop(request_id, None)
+            if rec is not None:
+                self._suspend_stats["dropped"] += 1
+            return rec is not None
+
+    def suspend_stats(self) -> dict:
+        with self._lock:
+            return dict(self._suspend_stats, held=len(self._suspended))
 
     def finish_migrated(self, request_id: str) -> bool:
         """Finish a checkpointed request locally with reason "migrated"
@@ -1315,6 +1470,9 @@ class LLMEngine:
     def _finish(self, st: RequestState, reason: str):
         st.finished = True
         st.finish_reason = reason
+        # a prefix fetch still in flight for this request is orphaned:
+        # drop the record (the worker's writes into it become no-ops)
+        self._fetch_state.pop(st.request_id, None)
         if self._tel is not None:
             self._tel.on_finish(st, reason)
         if st.prefill_only and reason != "handoff":
@@ -1465,7 +1623,7 @@ class LLMEngine:
             return None
         return need
 
-    def _stage_admission(self) -> list:
+    def _stage_admission(self) -> list:  # holds-lock: _lock (step pipeline)
         """ADMISSION stage (planning only, no forwards): admit every
         waiting request that fits right now (FIFO; a head-of-line request
         that cannot get pages blocks the wave — vLLM semantics: waiting
@@ -1474,10 +1632,17 @@ class LLMEngine:
         returns the wave of (st, slot, pref, pages, prompt) plans the
         prefill stage executes."""
         wave: list[tuple] = []  # (st, slot, pref, pages, prompt)
+        if self._fetch_zombies:
+            self._reap_fetch_zombies_locked()
+        # requests skipped THIS wave on an in-flight async prefix fetch:
+        # re-queued at the front (original order) after the loop so they
+        # keep FIFO priority without blocking followers behind a transfer
+        deferred: list[RequestState] = []
         while self._waiting and None in self._slots:
             st = self._waiting[0]
             if st.finished:  # aborted while waiting
                 self._waiting.popleft()
+                self._fetch_state.pop(st.request_id, None)
                 continue
             slot = self._slots.index(None)
             # preempted sequences resume with generated tokens as prompt tail
@@ -1509,6 +1674,16 @@ class LLMEngine:
                         pref = local + (None, None)
                         if self._tel is not None:
                             self._tel.on_prefix_hit("local", local[2])
+                        if self._prefetched_keys:
+                            # attribution: a hit served by a block the
+                            # predictive prefetcher pulled in ahead of
+                            # demand (cheap: only computed while any
+                            # prefetched key is live in the cache)
+                            kb = prefix_key(token_bytes(tuple(int(t) for t in prompt)), local[2])
+                            if kb in self._prefetched_keys:
+                                self._plane_stats["prefetch_hits"] += 1
+                                if self._tel is not None:
+                                    self._tel.on_prefetch_hit()
                         if self._kv_plane is not None:
                             # publish self-heal: a boundary whose original
                             # publish failed transiently would otherwise
@@ -1518,10 +1693,37 @@ class LLMEngine:
                             # no-op in steady state
                             self._plane_publish(prompt[: local[2]], local[0], local[1])
                     elif self._kv_plane is not None:
-                        # cluster tier: longest live remote block, fetched
-                        # over the object plane; any failure inside
-                        # degrades to a plain local prefill (pref = None)
-                        pref = self._fetch_remote_prefix(prompt)
+                        # cluster tier, ASYNC (ROADMAP item 3a): the
+                        # lookup+fetch runs on the engine's fetch worker,
+                        # NEVER under this lock. First sight launches it
+                        # and DEFERS the request (followers keep
+                        # admitting, their prefills overlap the
+                        # transfer); a landed result splices in here; a
+                        # fetch outliving its deadline abandons to a
+                        # plain local prefill — zero hangs by
+                        # construction. Any failure inside degrades to
+                        # pref = None.
+                        rec = self._fetch_state.get(st.request_id)
+                        if rec is None and not self._kv_plane.index_down():
+                            rec = self._launch_prefix_fetch(st.request_id, prompt)
+                        if rec is not None:
+                            if rec["done"]:
+                                pref = self._splice_prefix_fetch(st, rec, prompt)
+                            elif time.time() < rec["deadline"]:
+                                self._waiting.popleft()
+                                deferred.append(st)
+                                continue
+                            else:
+                                # wedged plane: abandon the fetch. The
+                                # record moves to the zombie list so the
+                                # worker's TERMINAL resolution still
+                                # lands in the stats (with the default
+                                # client fetch budget above the engine
+                                # deadline, lost/errors would otherwise
+                                # NEVER be credited under async)
+                                self._fetch_state.pop(st.request_id, None)
+                                self._plane_stats["abandoned"] += 1
+                                self._fetch_zombies.append(rec)
                     if pref is None:
                         # plane engines re-check after a short lease: a
                         # PEER's publish can't bump the local generation
@@ -1544,6 +1746,8 @@ class LLMEngine:
             st.cached_pref = None  # admission consumes the cached resolution
             self._slots[slot] = st  # reserve; _bind_slot fills the rest
             wave.append((st, slot, pref, pages, prompt))
+        for st in reversed(deferred):
+            self._waiting.appendleft(st)  # original FIFO order restored
         return wave
 
     def _prefix_fits(self, n_p: int, prompt_len: int) -> bool:
@@ -1555,27 +1759,73 @@ class LLMEngine:
         disagree on admissibility."""
         return n_p + _bucket(prompt_len - n_p, self.prefill_buckets) <= self.max_seq_len
 
-    def _fetch_remote_prefix(self, prompt):
-        """Cluster-tier prefix resolution (llm/kvplane/): longest live
-        remote block for this prompt's boundary keys, fetched over the
-        object plane with a bounded retry budget. Returns a pref tuple
-        ``(k, v, n_valid, k_scale, v_scale)`` ready for the existing
-        fused insert/transparent-requant admission path, or None — EVERY
-        failure mode (index down, block evicted, owner dead, token
-        mismatch, a dequant/re-store error post-fetch) degrades to a
-        plain local prefill, never an engine error or a hang.
+    # ------------------------------------------------ async cluster fetch
 
-        On success the block is also RE-STORED into the local PrefixCache
-        and republished under this replica (when the wire dtype
-        round-trips byte-identically), so the next shared-prefix request
-        here is a local-tier hit."""
+    def _ensure_fetch_worker(self):  # holds-lock: _lock (via admission)
+        if self._fetch_thread is not None and self._fetch_thread.is_alive():
+            return
+        self._fetch_q = queue.SimpleQueue()
+        t = threading.Thread(target=self._fetch_worker, daemon=True, name="llm-prefix-fetch")
+        self._fetch_thread = t
+        t.start()
+
+    def _fetch_worker(self):
+        """Drains prefix-fetch jobs OFF the engine lock: the index RPC,
+        the multi-MB object-plane transfer, the token verification and
+        the dequant all run here while step() keeps prefilling/decoding —
+        the transfer overlaps compute instead of serializing admission
+        (ROADMAP item 3a; "The Big Send-off" schedules transfers against
+        compute the same way)."""
+        while True:
+            job = self._fetch_q.get()
+            if job is None:
+                return
+            rec, prompt = job
+            try:
+                self._run_prefix_fetch(rec, prompt)
+            except BaseException:  # noqa: BLE001 — a dying worker would wedge every deferral
+                rec["error"] = True
+                rec["done"] = True
+
+    def _launch_prefix_fetch(self, request_id: str, prompt) -> dict:
+        """Mint the in-flight record and hand the job to the fetch
+        worker (called at admission, under the engine lock — the launch
+        is a queue put, nothing blocking). The record is the ONLY shared
+        state: the worker fills it lock-free and flips ``done`` last;
+        admission reads it once ``done`` is observed, or abandons it at
+        ``deadline`` (a wedged plane degrades to local prefill)."""
+        rec = {
+            "request_id": request_id, "done": False, "error": False, "lost": False,
+            "pref": None, "restore": None, "nbytes": 0, "n_p": 0,
+            "t0": time.time(), "t1": 0.0,
+            "deadline": time.time() + self.prefix_fetch_deadline_s,
+        }
+        self._fetch_state[request_id] = rec
+        self._ensure_fetch_worker()
+        self._fetch_q.put((rec, [int(t) for t in prompt]))
+        return rec
+
+    def _run_prefix_fetch(self, rec: dict, prompt: list) -> None:
+        """One cluster-tier resolution, STRICTLY lock-free (runs on the
+        fetch worker; a bench's synchronous shim may call it inline):
+        candidates, index lookup, object-plane fetch, token verify and
+        dequant fill ``rec`` — every engine-state mutation (counters,
+        cache re-store, republish) waits for ``_splice_prefix_fetch``
+        under the lock. EVERY failure mode (index down, block evicted,
+        owner dead, token mismatch, dequant error) degrades to a plain
+        local prefill, never an engine error or a hang."""
         try:
-            return self._fetch_remote_prefix_inner(prompt)
+            self._resolve_remote_prefix(rec, prompt)
         except Exception:  # noqa: BLE001 — the plane is an accelerator, never a dependency
-            self._plane_stats["errors"] = self._plane_stats.get("errors", 0) + 1
-            return None
+            rec["error"] = True
+        rec["t1"] = time.time()
+        if self._tel is not None:
+            # the fetch span lands in the flight recorder: overlap with
+            # concurrent step records is the item-3a evidence
+            self._tel.on_prefix_fetch(rec["t0"], rec["t1"], rec["n_p"], rec["pref"] is not None)
+        rec["done"] = True
 
-    def _fetch_remote_prefix_inner(self, prompt):
+    def _resolve_remote_prefix(self, rec: dict, prompt: list) -> None:
         from ray_tpu.llm.kvplane.index import boundary_keys
 
         block = self._prefix_cache.block
@@ -1586,10 +1836,10 @@ class LLMEngine:
             if self._prefix_fits(n, len(prompt))
         ]
         if not cands:
-            return None
+            return
         hit = self._kv_plane.lookup(cands)
         if hit is None:
-            return None
+            return
         # producer-bucket width gate BEFORE the transfer: the routed
         # meta already carries the block shape, so a producer whose
         # bucket ladder is narrower than our pad for this boundary
@@ -1597,45 +1847,90 @@ class LLMEngine:
         # fetch discarded post-hoc
         shape = tuple(hit.get("meta", {}).get("shape") or ())
         if len(shape) > 1 and shape[1] < _bucket(int(hit["n"]), self.prefill_buckets):
-            return None
+            return
         payload = self._kv_plane.fetch(hit)
         if payload is None:
             # evicted/lost remote block after the bounded retries: the
             # client already reported the dead route to the index
-            self._plane_stats["lost"] += 1
-            return None
+            rec["lost"] = True
+            return
         n_p = int(hit["n"])
         # token-for-token verification — the same collision guarantee the
         # local cache keeps: a hash collision (or a stale publish) must
-        # never serve a foreign prompt's KV
+        # never serve a foreign prompt's KV. The prompt snapshot is the
+        # launch-time one, which cannot drift: only token-less requests
+        # (st.prefilled is None, no generated tokens) ever launch.
         if payload["n"] < n_p or payload["prompt_token_ids"][:n_p] != [int(t) for t in prompt[:n_p]]:
-            return None
+            return
         pad = _bucket(n_p, self.prefill_buckets)
         if payload["k"].shape[1] < pad:
-            return None  # producer's bucket ladder narrower than ours
+            return  # producer's bucket ladder narrower than ours
         k_w, v_w = payload["k"][:, :pad], payload["v"][:, :pad]
         k_sc, v_sc = payload.get("k_scale"), payload.get("v_scale")
         if k_sc is not None:
             k_sc, v_sc = k_sc[:, :, :pad], v_sc[:, :, :pad]
         wire_int8 = str(k_w.dtype) == "int8"
-        nbytes = int(hit.get("meta", {}).get("nbytes") or (k_w.nbytes + v_w.nbytes))
-        self._plane_stats["hits"] += 1
-        self._plane_stats["tokens_saved"] += n_p
-        self._plane_stats["fetched_bytes"] += nbytes
-        if self._tel is not None:
-            self._tel.on_prefix_hit("remote", n_p, nbytes)
-        # local re-store + republish, but only when a later local hit
-        # reproduces the same cache bytes: fp wire re-inserts exactly;
-        # int8 wire dequantized re-quantizes byte-identically into an
-        # int8 cache (kv_quant idempotence) — an fp cache re-storing a
-        # dequantized int8 block would drift from its own prefill oracle
+        rec["n_p"] = n_p
+        rec["nbytes"] = int(hit.get("meta", {}).get("nbytes") or (k_w.nbytes + v_w.nbytes))
+        # dequant for the local re-store is PURE compute — do it here on
+        # the worker; only when a later local hit reproduces the same
+        # cache bytes: fp wire re-inserts exactly; int8 wire dequantized
+        # re-quantizes byte-identically into an int8 cache (kv_quant
+        # idempotence) — an fp cache re-storing a dequantized int8 block
+        # would drift from its own prefill oracle
         if wire_int8 == self.kv_quant:
             import jax.numpy as jnp
 
             if wire_int8:
-                k_fp, v_fp = self._kv_plane.dequantize_wire(k_w, v_w, k_sc, v_sc)
+                rec["restore"] = self._kv_plane.dequantize_wire(k_w, v_w, k_sc, v_sc)
             else:
-                k_fp, v_fp = jnp.asarray(k_w), jnp.asarray(v_w)
+                rec["restore"] = (jnp.asarray(k_w), jnp.asarray(v_w))
+        rec["pref"] = (k_w, v_w, n_p, k_sc, v_sc)
+
+    def _reap_fetch_zombies_locked(self) -> None:  # holds-lock: _lock
+        # credit the terminal resolution of
+        # deadline-abandoned fetches once the worker finishes. A landed
+        # hit counts NOTHING here (the request already prefilled locally
+        # and the bytes are discarded — "abandoned" is its record);
+        # lost/error keep their meaning: the plane lost a routed block /
+        # the resolution faulted, whether or not anyone waited for it.
+        if not self._fetch_zombies:
+            return
+        live = []
+        for rec in self._fetch_zombies:
+            if not rec["done"]:
+                live.append(rec)
+            elif rec["error"]:
+                self._plane_stats["errors"] += 1
+            elif rec["lost"]:
+                self._plane_stats["lost"] += 1
+        self._fetch_zombies = live
+
+    def _splice_prefix_fetch(self, st: RequestState, rec: dict, prompt):
+        """Apply a landed fetch at admission (under the engine lock):
+        counters and telemetry, the local PrefixCache re-store, and the
+        republish offer — everything the lock-free worker deferred.
+        Returns the pref tuple ``(k, v, n_valid, k_scale, v_scale)`` for
+        the fused insert/transparent-requant path, or None (miss/lost/
+        error: the request degrades to a plain local prefill)."""
+        self._fetch_state.pop(st.request_id, None)
+        if rec["error"]:
+            self._plane_stats["errors"] += 1
+            return None
+        if rec["lost"]:
+            self._plane_stats["lost"] += 1
+            return None
+        pref = rec["pref"]
+        if pref is None:
+            return None
+        n_p = int(pref[2])
+        self._plane_stats["hits"] += 1
+        self._plane_stats["tokens_saved"] += n_p
+        self._plane_stats["fetched_bytes"] += rec["nbytes"]
+        if self._tel is not None:
+            self._tel.on_prefix_hit("remote", n_p, rec["nbytes"])
+        if rec["restore"] is not None:
+            k_fp, v_fp = rec["restore"]
             stored = self._prefix_cache.store(prompt[:n_p], k_fp, v_fp, self.prefill_buckets)
             if stored is not None:
                 # proven_reuse: THIS replica just fetched the block over
@@ -1645,7 +1940,38 @@ class LLMEngine:
                 # this replica's own local hits re-prove what the
                 # cluster already demonstrated)
                 self._plane_publish(prompt[:n_p], k_fp, v_fp, *stored, proven_reuse=True)
-        return (k_w, v_w, n_p, k_sc, v_sc)
+        return pref
+
+    def adopt_prefetched(self, prompt_token_ids, k_fp, v_fp) -> int:
+        """Install a PREDICTIVELY fetched hot block into the local prefix
+        cache (KVPlaneClient's prefetch worker, ROADMAP item 3b): the
+        fleet's top-k demanded prefixes become LOCAL-tier hits before any
+        request here asks for them. ``k_fp``/``v_fp`` are float arrays
+        (the worker already dequantized an int8 wire); the cache store
+        re-quantizes under kv_quant exactly like a remote-fetch re-store,
+        so later local hits reproduce the prefill oracle byte-for-byte.
+        Returns the adopted bytes (0 when the cache refused — duplicate,
+        too-wide block, prefix caching off). The boundary keys minted
+        here are remembered so the FIRST local hit they serve counts as a
+        prefetch hit (the uplift evidence), and the block republishes
+        under this replica (proven_reuse — the fleet demanded it)."""
+        ids = [int(t) for t in prompt_token_ids]
+        with self._lock:
+            if self._prefix_cache is None or not ids:
+                return 0
+            stored = self._prefix_cache.store(ids, k_fp, v_fp, self.prefill_buckets)
+            if stored is None:
+                return 0
+            nbytes = int(k_fp.nbytes + v_fp.nbytes)
+            self._plane_stats["prefetched_blocks"] += 1
+            self._plane_stats["prefetched_bytes"] += nbytes
+            self._prefetched_keys.add(prefix_key(token_bytes(ids), len(ids)))
+            self._plane_publish(ids, k_fp, v_fp, *stored, proven_reuse=True)
+        # the publish itself (owned object + index RPC) runs lock-free,
+        # same as the step tail — the prefetch worker is not a stepper,
+        # so nobody else would flush this offer promptly
+        self._flush_plane_offers()
+        return nbytes
 
     def _plane_publish(self, prompt, ks, vs, new_keys=None, pad=None, proven_reuse=False):
         """Queue a prefix-block publish for the cluster plane. Every

@@ -80,6 +80,7 @@ class KVPlaneClient:
         index_down_cooldown_s: float = 30.0,
         publish: bool = True,
         publish_min_hits: int = 2,
+        prefetch_k: int = 0,
     ):
         """``publish_min_hits``: capacity-aware publication policy — a
         boundary key is offered to ``publish()`` once per local-cache
@@ -90,7 +91,16 @@ class KVPlaneClient:
         once-seen prefix costs nothing; the second touch — the first
         evidence of reuse — publishes it. 1 restores publish-on-store.
         Skips are counted in ``stats()['published_skipped']`` (surfaced
-        through ``prefix_cache_stats()``'s plane tier)."""
+        through ``prefix_cache_stats()``'s plane tier).
+
+        ``prefetch_k``: predictive prefetch — every heartbeat tick also
+        asks the index for the fleet's ``k`` hottest prefix blocks
+        (``PrefixIndex.top_hot``, decayed demand) and pulls the ones this
+        replica doesn't hold into its local PrefixCache on a worker
+        thread, so the next shared-prefix request is a LOCAL-tier hit
+        instead of a remote fetch. 0 (default) disables: prefetch spends
+        fetch bandwidth ahead of demand, which is a per-deployment choice
+        (serve/llm.py's KVPlaneServer exposes it as ``prefetch_k``)."""
         import os
 
         self._index = index
@@ -117,7 +127,12 @@ class KVPlaneClient:
         self._ref_keys: dict[bytes, set] = {}  # ref id -> live boundary keys; guarded-by: _lock
         self._evict_q = None  # lazy: SimpleQueue + daemon worker on first evict
         self._last_heartbeat = 0.0
+        # predictive prefetch (heartbeat-piggybacked): one round in
+        # flight at a time, on its own daemon thread — never the stepper
+        self.prefetch_k = max(0, int(prefetch_k))
+        self._prefetch_thread = None
         # attach() fills these from the engine's config
+        self._engine = None
         self._wire_int8 = False
         self._compute_dtype = "float32"
         self._block = 64
@@ -127,13 +142,18 @@ class KVPlaneClient:
             "published_skipped": 0,
             "fetches": 0, "fetched_bytes": 0, "fetch_lost": 0,
             "index_errors": 0, "publish_errors": 0,
+            "prefetch_rounds": 0, "prefetch_blocks": 0, "prefetch_bytes": 0,
+            "prefetch_skipped": 0, "prefetch_errors": 0,
         }
 
     # -- engine wiring -----------------------------------------------------
     def attach(self, engine) -> None:
         """Bind the client to its engine's cache format: int8-cache
         engines publish int8 wire blocks (fused quantize, ~half the
-        bytes); fp engines publish at the block's own dtype."""
+        bytes); fp engines publish at the block's own dtype. The engine
+        handle also feeds the predictive prefetcher: adopted hot blocks
+        store into the engine's PrefixCache via adopt_prefetched()."""
+        self._engine = engine
         self._wire_int8 = bool(engine.kv_quant)
         self._compute_dtype = str(engine.config.dtype)
         if engine._prefix_cache is not None:
@@ -169,7 +189,11 @@ class KVPlaneClient:
         idle wait). The heartbeat reply carries the index's key count for
         this replica: fewer than we hold published means the index pruned
         us (partition outliving the lease) — re-register every live block
-        so pruned entries can never stay unroutable forever."""
+        so pruned entries can never stay unroutable forever.
+
+        Each heartbeat tick also piggybacks one PREDICTIVE PREFETCH round
+        (prefetch_k > 0): the index's top-k hottest prefix blocks pull
+        into the local PrefixCache on a daemon worker, ahead of demand."""
         now = time.time()
         if now - self._last_heartbeat < self.heartbeat_every_s:
             return
@@ -184,6 +208,81 @@ class KVPlaneClient:
             )
         if entries:
             self._safe_call("register", self.replica_id, entries)
+        self._maybe_prefetch()
+
+    # -- predictive prefetch -----------------------------------------------
+    def _maybe_prefetch(self) -> None:
+        """Kick one prefetch round on a daemon worker (at most one in
+        flight; a still-running round means the previous tick's transfers
+        haven't landed — skip, don't queue). Called from the heartbeat
+        path, i.e. the engine's step tail or the serve stepper's idle
+        wait, with NO lock held — the round's index RPC, multi-MB fetches
+        and dequant/store must never ride the serving thread."""
+        if self.prefetch_k <= 0 or self._engine is None or self._shutdown or self.index_down():
+            return
+        t = self._prefetch_thread
+        if t is not None and t.is_alive():
+            return
+        t = threading.Thread(target=self._prefetch_round, daemon=True, name="kvplane-prefetch")
+        self._prefetch_thread = t
+        t.start()
+
+    def _prefetch_round(self) -> None:
+        """One predictive-prefetch round: ask the index for the fleet's
+        hottest live blocks (PrefixIndex.top_hot — decayed demand), pull
+        every block this replica doesn't already hold, and adopt it into
+        the engine's local PrefixCache (remote tier -> local tier, before
+        any request asks). EVERY failure degrades to "no prefetch this
+        round" — counted, never raised; the demand path is unaffected.
+
+        Chaos plane (site ``kvplane.prefetch``): tests inject drops,
+        delays and faults HERE — prefetch is background opportunism, so
+        any injected failure must leave serving token-identical."""
+        from ray_tpu import chaos
+
+        try:
+            if not chaos.apply("kvplane.prefetch"):
+                self.counts["prefetch_skipped"] += 1
+                return
+            self.counts["prefetch_rounds"] += 1
+            hot = self._safe_call("top_hot", self.prefetch_k, self.replica_id, default=None)
+            for hit in hot or ():
+                if self._shutdown:
+                    return
+                with self._lock:
+                    if bytes(hit["key"]) in self._published:
+                        continue  # already hold + registered these bytes
+                payload = self.fetch(hit)
+                if payload is None:
+                    continue  # lost/evicted: fetch() already reported the route
+                self._adopt_payload(hit, payload)
+        except BaseException:  # noqa: BLE001 — prefetch is opportunistic, never load-bearing
+            self.counts["prefetch_errors"] += 1
+
+    def _adopt_payload(self, hit: dict, payload: dict) -> int:
+        """Hand one fetched hot block to the engine's PrefixCache (same
+        wire-compatibility rule as the demand path's re-store: the cache
+        bytes a later local hit serves must equal what a local prefill
+        would have produced, so a wire/cache dtype mismatch skips)."""
+        import jax.numpy as jnp
+
+        wire_int8 = str(payload["k"].dtype) == "int8"
+        if wire_int8 != self._wire_int8:
+            return 0  # re-store would drift from the local prefill oracle
+        n = int(hit["n"])
+        if int(payload["n"]) < n:
+            return 0
+        if wire_int8:
+            k_fp, v_fp = self.dequantize_wire(
+                payload["k"], payload["v"], payload["k_scale"], payload["v_scale"]
+            )
+        else:
+            k_fp, v_fp = jnp.asarray(payload["k"]), jnp.asarray(payload["v"])
+        nb = int(self._engine.adopt_prefetched(payload["prompt_token_ids"][:n], k_fp, v_fp))
+        if nb:
+            self.counts["prefetch_blocks"] += 1
+            self.counts["prefetch_bytes"] += nb
+        return nb
 
     # -- publish -----------------------------------------------------------
     def publish(self, prefix_ids, k_blk, v_blk, bounds: list | None = None,

@@ -93,17 +93,24 @@ class PrefixIndex:
     KV bytes — refs and small meta dicts only). ``time_fn`` is injectable
     for staleness tests."""
 
-    def __init__(self, *, ttl_s: float = 30.0, time_fn=None):
+    def __init__(self, *, ttl_s: float = 30.0, time_fn=None, demand_halflife_s: float = 30.0):
         self.ttl_s = float(ttl_s)
+        self.demand_halflife_s = float(demand_halflife_s)
         self._now = time_fn or time.time
         self._lock = threading.Lock()
         # key -> {replica -> {"n": int, "meta": dict, "ref": ObjectRef}}
         self._entries: dict[bytes, dict[str, dict]] = {}  # guarded-by: _lock
         # replica -> {"last_seen": float, "keys": set[bytes]}
         self._replicas: dict[str, dict] = {}  # guarded-by: _lock
+        # key -> decayed demand score: every router match / miss lookup
+        # that queries a boundary key bumps it; scores HALVE every
+        # demand_halflife_s so top_hot tracks the current workload, not
+        # all-time popularity (guarded-by: _lock)
+        self._demand: dict[bytes, float] = {}
+        self._demand_decayed = self._now()
         self.counts = {  # guarded-by: _lock
             "registered": 0, "unregistered": 0, "expired": 0,
-            "lookups": 0, "hits": 0, "lost_reports": 0,
+            "lookups": 0, "hits": 0, "lost_reports": 0, "top_hot_calls": 0,
         }
 
     # -- liveness ----------------------------------------------------------
@@ -203,6 +210,62 @@ class PrefixIndex:
                 if not holders:
                     del self._entries[bytes(key)]
 
+    # -- demand ------------------------------------------------------------
+    def _bump_demand_locked(self, keys) -> None:  # holds-lock: _lock
+        now = self._now()
+        # lazy exponential decay: halve every halflife elapsed since the
+        # last decay tick, dropping dust so the dict tracks the live
+        # working set instead of growing with every prompt ever seen
+        if now - self._demand_decayed >= self.demand_halflife_s:
+            halvings = int((now - self._demand_decayed) // self.demand_halflife_s)
+            self._demand_decayed += halvings * self.demand_halflife_s
+            scale = 0.5 ** min(halvings, 64)
+            self._demand = {k: s for k, s in ((k, s * scale) for k, s in self._demand.items()) if s >= 0.0625}
+        for _n, key in keys:
+            key = bytes(key)
+            self._demand[key] = self._demand.get(key, 0.0) + 1.0
+
+    def top_hot(self, k: int = 4, exclude: str | None = None) -> list:
+        """The fleet's ``k`` hottest LIVE prefix blocks by decayed demand
+        — the predictive-prefetch feed (client.maybe_heartbeat): a replica
+        pulls these into its local PrefixCache before they are requested,
+        turning remote-tier hits into local-tier hits. Entries shaped like
+        ``lookup`` hits ({"key","n","replica","meta","ref"}) so the client
+        fetches them through the same path. ``exclude`` drops blocks the
+        asking replica already holds (it published them); boundary keys
+        aliasing the SAME published ref dedup to the longest one, since a
+        single fetch + local store re-mints every shorter boundary."""
+        with self._lock:
+            self.counts["top_hot_calls"] += 1
+            now = self._now()
+            cands: list = []
+            for key, score in self._demand.items():
+                holders = self._entries.get(key)
+                if not holders:
+                    continue
+                if exclude is not None and exclude in holders:
+                    continue  # the asker already owns a copy of these bytes
+                live = [(rep, e) for rep, e in holders.items() if self._alive(rep, now)]
+                if not live:
+                    continue
+                rep, e = max(live, key=lambda it: self._replicas[it[0]]["last_seen"])
+                cands.append((score, int(e["n"]), key, rep, e))
+            # hottest first; equal-demand boundary aliases of one prompt
+            # resolve to the longest (its fetch covers the shorter ones)
+            cands.sort(key=lambda it: (-it[0], -it[1]))
+            out: list = []
+            picked: set = set()
+            for score, n, key, rep, e in cands:
+                alias = (rep, id(e["ref"]))
+                if alias in picked:
+                    continue  # shorter boundary of an already-picked block
+                picked.add(alias)
+                out.append({"key": bytes(key), "n": n, "replica": rep,
+                            "meta": dict(e["meta"]), "ref": e["ref"], "demand": score})
+                if len(out) >= int(k):
+                    break
+            return out
+
     # -- lookup ------------------------------------------------------------
     def lookup(self, keys: list, exclude: str | None = None, requester: str | None = None):
         """Longest live match for a prompt's boundary ``[(n, key)]`` list
@@ -214,6 +277,7 @@ class PrefixIndex:
             if requester is not None:
                 self._touch(requester)
             self.counts["lookups"] += 1
+            self._bump_demand_locked(keys)
             now = self._now()
             for n, key in reversed(list(keys)):
                 holders = self._entries.get(bytes(key))
@@ -237,6 +301,7 @@ class PrefixIndex:
         the router's cache-aware scoring input. Dead replicas never
         appear (the 'router never routes to them' staleness contract)."""
         with self._lock:
+            self._bump_demand_locked(keys)
             now = self._now()
             out: dict[str, int] = {}
             for n, key in keys:
@@ -251,6 +316,7 @@ class PrefixIndex:
             return {
                 **self.counts,
                 "keys": len(self._entries),
+                "demand_keys": len(self._demand),
                 "replicas_live": sum(1 for r in self._replicas if self._alive(r, now)),
                 "replicas_known": len(self._replicas),
             }

@@ -115,6 +115,20 @@ class CacheAwareRouter:
                 self.stats_counts["index_errors"] += 1
             return {}
 
+    def hot_prefixes(self, k: int = 4) -> list:
+        """The fleet's top-k demanded prefix blocks (index.top_hot) —
+        the same view replicas prefetch from, exposed router-side for
+        dashboards and placement decisions. [] when the index is down."""
+        from ray_tpu.llm.kvplane.client import index_call
+
+        try:
+            return index_call(self._index, "top_hot", int(k), None,
+                              timeout_s=self.index_timeout_s) or []
+        except BaseException:  # noqa: BLE001
+            with self._lock:
+                self.stats_counts["index_errors"] += 1
+            return []
+
     def route(self, prompt_token_ids) -> tuple:
         """(ranked replica ids, matches dict) for a prompt — exposed for
         tests and for callers that submit through their own transport."""

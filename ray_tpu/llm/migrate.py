@@ -46,6 +46,15 @@ raises ``MigrationLostError`` after its bounded retries — the routers'
 signal to fall back to re-prefill, never a hang. Loss injection rides
 the existing ``direct.put_owned`` / ``direct.get_owned_view`` chaos
 sites; the preemption NOTICE itself is the ``serve.preempt`` site.
+
+**Second consumer — tiered conversation KV.** The same codec now also
+carries *idle eviction* (``engine.suspend_request`` / ``resume_suspended``,
+ROADMAP item 3c): an idle conversation's state spills out of HBM to host
+DRAM (and, via ``publish``, the object plane), and resume scatters the
+block back in instead of re-prefilling. Nothing wire-level changes —
+suspension is a migration whose source and destination may be the same
+replica, so every validation, the splice-dedup contract and the typed
+loss/degradation order above apply verbatim.
 """
 
 from __future__ import annotations
@@ -310,6 +319,17 @@ def meta_of(state: dict) -> dict:
         "prompt_tokens": len(state.get("prompt_token_ids", [])),
         "nbytes": nbytes,
     }
+
+
+def state_nbytes(state: dict) -> int:
+    """KV payload size of a live_state dict (0 for a cold checkpoint) —
+    the spill/transfer accounting both consumers report."""
+    if state.get("k") is None:
+        return 0
+    nbytes = int(state["k"].nbytes + state["v"].nbytes)
+    if state.get("k_scale") is not None:
+        nbytes += int(state["k_scale"].nbytes + state["v_scale"].nbytes)
+    return nbytes
 
 
 def publish(state: dict):

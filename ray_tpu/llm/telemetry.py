@@ -188,6 +188,23 @@ METRICS: dict[str, dict] = {
         "kind": "histogram", "tags": _SERVE_TAGS, "boundaries": _LATENCY_BOUNDARIES,
         "desc": "splice latency: restore ingress -> first post-splice token on the peer",
     },
+    # latency-hiding KV plane v2 (ROADMAP item 3): the async fetch span
+    # (runs on the engine's fetch worker, overlapping prefill/decode
+    # steps — the histogram is what the A/B bench reads), predictive
+    # prefetch attribution (a local-tier hit served by a block pulled in
+    # ahead of demand), and the tiered-conversation-KV spill volume.
+    "rt_llm_prefix_fetch_overlap_s": {
+        "kind": "histogram", "tags": _SERVE_TAGS, "boundaries": _LATENCY_BOUNDARIES,
+        "desc": "async remote prefix fetch span (launch -> result landed), overlapped with serving steps",
+    },
+    "rt_llm_prefix_prefetch_hits_total": {
+        "kind": "counter", "tags": _SERVE_TAGS,
+        "desc": "local prefix hits served by predictively prefetched blocks (remote->local conversion)",
+    },
+    "rt_llm_kv_spilled_bytes_total": {
+        "kind": "counter", "tags": _SERVE_TAGS,
+        "desc": "conversation KV bytes spilled out of HBM by suspend_request (tiered conversation KV)",
+    },
 }
 
 _instruments: dict = {}
@@ -246,6 +263,10 @@ class FlightRecorder:
     def __init__(self, max_steps: int = 512, max_requests: int = 256):
         self.steps: deque = deque(maxlen=max_steps)
         self.requests: deque = deque(maxlen=max_requests)
+        # async prefix-fetch spans (engine fetch worker): cross-checking
+        # a fetch record's [t0, t1] against step records' timestamps is
+        # the item-3a overlap evidence the bench and tests read
+        self.fetches: deque = deque(maxlen=max_requests)
         self._lock = threading.Lock()
         self._entries: dict[str, tuple] = {}  # name -> (fn, warm_size or None)
         self.recompiles: dict[str, int] = {}
@@ -292,10 +313,15 @@ class FlightRecorder:
         with self._lock:
             self.requests.append(rec)
 
+    def record_fetch(self, rec: dict) -> None:
+        with self._lock:
+            self.fetches.append(rec)
+
     def snapshot(self) -> dict:
         with self._lock:
             rows = list(self.steps)
             reqs = [dict(r) for r in self.requests]
+            fetches = [dict(r) for r in self.fetches]
             count = self.step_count
             recs = dict(self.recompiles)
         steps = []
@@ -303,7 +329,8 @@ class FlightRecorder:
             d = dict(zip(self.STEP_FIELDS, row))
             # drop layout-/mode-inapplicable fields (None) for readability
             steps.append({k: v for k, v in d.items() if v is not None})
-        return {"step_count": count, "steps": steps, "requests": reqs, "recompiles": recs}
+        return {"step_count": count, "steps": steps, "requests": reqs,
+                "fetches": fetches, "recompiles": recs}
 
     def dump_jsonl(self, path: str, header: dict | None = None) -> str:
         """Write the ring as JSONL (one header line, then one line per
@@ -365,6 +392,9 @@ class EngineTelemetry:
             for tier in ("local", "remote")
         }
         self._b_pfx_bytes = self.m["rt_llm_prefix_fetch_bytes_total"].bind(self.tags)
+        self._b_pfx_prefetch = self.m["rt_llm_prefix_prefetch_hits_total"].bind(self.tags)
+        self._b_fetch_overlap = self.m["rt_llm_prefix_fetch_overlap_s"].bind(self.tags)
+        self._b_spill = self.m["rt_llm_kv_spilled_bytes_total"].bind(self.tags)
         # materialize the sentinel series at 0 so a dashboard can alert
         # on ANY increase (a series that only appears on the first
         # recompile is invisible to a rate()/increase() alert rule)
@@ -575,6 +605,31 @@ class EngineTelemetry:
         self._b_pfx_tokens[tier].inc(float(tokens))
         if nbytes:
             self._b_pfx_bytes.inc(float(nbytes))
+
+    def on_prefetch_hit(self) -> None:
+        """A local-tier admission hit was served by a block the
+        predictive prefetcher pulled in ahead of demand — the
+        remote->local conversion the prefetch A/B bench measures.
+        Rides alongside the tier="local" on_prefix_hit for the same
+        admission."""
+        self._b_pfx_prefetch.inc(1.0)
+
+    def on_kv_spill(self, nbytes: int) -> None:
+        """suspend_request spilled a conversation's KV out of HBM
+        (tiered conversation KV). Once per suspension, never per step."""
+        self._b_spill.inc(float(nbytes))
+
+    def on_prefix_fetch(self, t0: float, t1: float, tokens: int, hit: bool) -> None:
+        """An async remote prefix fetch span closed. Called from the
+        engine's FETCH WORKER thread — the one entry point not under the
+        engine lock; the instruments and the recorder ring carry their
+        own thread-safety. The recorded [t0, t1] span is the overlap
+        evidence: tests/bench cross-check it against concurrent step
+        records."""
+        self._b_fetch_overlap.observe(max(t1 - t0, 0.0))
+        self.recorder.record_fetch(
+            {"t0": float(t0), "t1": float(t1), "tokens": int(tokens), "hit": bool(hit)}
+        )
 
     def on_handoff_extract(self, st, payload: dict, t_start: float) -> None:
         """Prefill side: the KV block left the cache into a handoff stash.

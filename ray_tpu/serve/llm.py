@@ -597,6 +597,37 @@ class LLMServer:
             "finish_reason": out.finish_reason,
         }
 
+    def suspend_request(self, request_id: str, publish: bool = True) -> dict:
+        """Tiered conversation KV (llm/engine.suspend_request): spill one
+        in-flight conversation's KV out of HBM to host DRAM + the object
+        plane, freeing its slot/pages for active traffic. The request
+        finishes locally with reason "suspended" (a blocked ``generate``
+        waiter sees that reason, mirroring the migration signal);
+        ``resume_suspended`` continues it later with zero recomputed
+        tokens. Raises MigrationError when the request cannot suspend —
+        the conversation is then untouched and still running."""
+        self._check_alive()
+        res = self.engine.suspend_request(request_id, publish=publish)
+        self._work.set()  # let the stepper reap the retirement promptly
+        return res
+
+    def resume_suspended(self, request_id: str, timeout_s: float = 300.0) -> dict:
+        """Re-admit a suspended conversation (scatter-in, no re-prefill)
+        and block until it finishes — the resume twin of ``generate``."""
+        self._check_alive()
+        rid = self.engine.resume_suspended(request_id)
+        self._work.set()
+        out = self._await_finished(rid, timeout_s)
+        return {
+            "request_id": out.request_id,
+            "prompt_token_ids": out.prompt_token_ids,
+            "token_ids": out.token_ids,
+            "finish_reason": out.finish_reason,
+        }
+
+    def suspended_requests(self) -> list:
+        return self.engine.suspended_requests()
+
     def __del__(self):
         try:
             self.shutdown()
@@ -993,6 +1024,9 @@ class KVIndexServer:
     def match_replicas(self, keys):
         return self.index.match_replicas(keys)
 
+    def top_hot(self, k=4, exclude=None):
+        return self.index.top_hot(k, exclude)
+
     def expire(self):
         return self.index.expire()
 
@@ -1011,7 +1045,7 @@ class KVPlaneServer(LLMServer):
     cache-aware scores and the index's entries name the same thing."""
 
     def __init__(self, llm_config: LLMConfig, index_handle, replica_name: str,
-                 publish_min_hits: int = 2):
+                 publish_min_hits: int = 2, prefetch_k: int = 0):
         from dataclasses import replace as _replace
 
         from ray_tpu.llm.kvplane import KVPlaneClient
@@ -1024,10 +1058,14 @@ class KVPlaneServer(LLMServer):
             default_tags(self.telemetry_stage, model=llm_config.model_id, replica=self.replica_name),
         )
         # publish_min_hits: the client's capacity-aware publication policy
-        # (publish a prefix only once it shows reuse; 1 = publish-on-store)
+        # (publish a prefix only once it shows reuse; 1 = publish-on-store).
+        # prefetch_k > 0 turns on predictive prefetch: each heartbeat tick
+        # pulls the fleet's top-k demanded prefix blocks into the local
+        # cache ahead of demand (remote-tier hits become local-tier).
         kwargs.setdefault(
             "kv_plane",
-            KVPlaneClient(index_handle, self.replica_name, publish_min_hits=publish_min_hits),
+            KVPlaneClient(index_handle, self.replica_name,
+                          publish_min_hits=publish_min_hits, prefetch_k=prefetch_k),
         )
         super().__init__(_replace(llm_config, engine_kwargs=kwargs))
 
@@ -1098,14 +1136,17 @@ def build_kvplane_deployment(
     cache_weight: float = 1.0,
     load_weight: float = 0.1,
     max_attempts: int = 2,
+    prefetch_k: int = 0,
 ):
     """-> Application: cache-aware router over ``num_replicas`` engine
     replicas sharing one cluster prefix index (llm/kvplane/). Replicas
     are SINGLE-replica deployments (``{name}-r<i>``) so the router can
     target the specific replica its score picked — the whole point of
     cache-aware routing; a pow-2 pick inside one deployment would throw
-    the affinity away. Call ``.generate`` on the returned handle exactly
-    like the monolithic deployment."""
+    the affinity away. ``prefetch_k`` > 0 arms predictive prefetch on
+    every replica (each heartbeat pulls the fleet's top-k demanded
+    prefixes into the local cache). Call ``.generate`` on the returned
+    handle exactly like the monolithic deployment."""
     from ray_tpu import serve
 
     health = {"health_check_timeout_s": 180.0, "health_check_period_s": 2.0}
@@ -1120,7 +1161,7 @@ def build_kvplane_deployment(
             serve.deployment(
                 name=rn, num_replicas=1,
                 max_ongoing_requests=llm_config.max_ongoing_requests, **health,
-            )(KVPlaneServer).bind(llm_config, index_app, rn)
+            )(KVPlaneServer).bind(llm_config, index_app, rn, prefetch_k=prefetch_k)
         )
     router_dep = serve.deployment(
         name=f"{name}-router",

@@ -245,12 +245,16 @@ def check_chaos_safety() -> list[str]:
 
 def check_baseline_policy() -> list[str]:
     """Baseline-policy gate: every accepted finding in the committed
-    baseline must carry a non-empty hand-written ``why``. The baseline is
-    the ledger of deliberate hazards (e.g. the ROADMAP item-3a admission
-    fetch under the engine lock) — an entry without its justification is
-    indistinguishable from debt someone forgot to fix, and
-    ``--update-baseline`` preserves ``why`` fields, so this can only fire
-    on a NEW unjustified acceptance."""
+    baseline must carry a non-empty hand-written ``why`` (the ledger of
+    deliberate hazards — an entry without its justification is
+    indistinguishable from debt someone forgot to fix; ``--update-baseline``
+    preserves ``why`` fields, so this can only fire on a NEW unjustified
+    acceptance). Entries citing a ROADMAP item as *accepted debt* get an
+    extra liveness check: their file must still exist — debt whose code
+    is gone is a stale suppression that would mask a regression
+    reintroducing the hazard (the item-3a admission-fetch entries were
+    retired this way when the fetch moved off the engine lock; the CCR
+    stale-drop pass in tier-1 enforces the rule-level half)."""
     import json as _json
 
     path = os.path.join(ROOT, "ray_tpu", "lint", "baseline.json")
@@ -260,12 +264,22 @@ def check_baseline_policy() -> list[str]:
         return []
     except Exception as e:  # noqa: BLE001
         return [f"baseline: {path} failed to parse: {type(e).__name__}: {e}"]
-    return [
+    problems = [
         f"baseline: entry {fp} ({e.get('rule')} {e.get('path')}) has no 'why' — "
         "every accepted hazard needs its justification recorded in-line"
         for fp, e in sorted(entries.items())
         if not str(e.get("why", "")).strip()
     ]
+    for fp, e in sorted(entries.items()):
+        why = str(e.get("why", ""))
+        if "accepted debt" in why or "ROADMAP item" in why:
+            target = os.path.join(ROOT, str(e.get("path", "")))
+            if not os.path.exists(target):
+                problems.append(
+                    f"baseline: roadmap-debt entry {fp} points at missing file "
+                    f"{e.get('path')!r} — retire the entry with the fix that removed it"
+                )
+    return problems
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -151,8 +151,10 @@ def test_ccr_self_check_clean_modulo_baseline():
     """The concurrency-discipline pass over ray_tpu/ itself: every
     blocking-under-lock / hot-path-sync hazard is either fixed or a
     baseline entry with a hand-written why (the deliberate ones: the
-    admission-path prefix fetch of ROADMAP item 3a, the controller
-    reconcile loop, drain idempotency). Any NEW CCR finding fails tier-1."""
+    controller reconcile loop, drain idempotency). Any NEW CCR finding
+    fails tier-1 — including any regression of the admission-path prefix
+    fetch, whose item-3a debt entries were RETIRED when the fetch moved
+    off the engine lock (the async fetch worker)."""
     from ray_tpu.lint.concur import all_concur_rules, concur_rule_ids
 
     findings = lint_paths([PKG], root=ROOT, rules=all_concur_rules())
@@ -166,20 +168,39 @@ def test_ccr_self_check_clean_modulo_baseline():
         + "\n".join(f.render() for f in d.new)
     )
     assert d.stale == [], d.stale
-    # the deliberate hazards stay TRACKED, not invisible: the ledger holds
-    # the admission-fetch (item 3a) entries among others
-    assert d.suppressed >= 9
+    # the deliberate hazards stay TRACKED, not invisible
+    assert d.suppressed >= 7
 
 
-def test_ccr_baseline_tracks_item_3a_admission_fetch():
-    # ISSUE policy: the admission-path object-plane fetch is accepted
-    # DEBT with a roadmap pointer, not a fix — the entry must exist, cite
-    # the roadmap item in its why, and sit on the engine's admission path
+def test_ccr_baseline_holds_no_stale_roadmap_debt():
+    """A baseline entry citing a ROADMAP item as accepted DEBT must stop
+    existing once the code stops tripping the rule — debt entries that
+    outlive their hazard would silently mask a regression reintroducing
+    it. Item 3a (the synchronous admission-path fetch) is the precedent:
+    its two CCR001 entries were deleted when the fetch moved to the
+    async worker, and the engine's admission path must now run CCR-clean
+    with NO engine-path fetch entry in the ledger at all."""
     entries = bl.load(bl.default_baseline_path())
-    hits = [e for e in entries.values()
-            if e["rule"] == "CCR001" and "3a" in e.get("why", "")]
-    assert hits, "item-3a admission-fetch baseline entry went missing"
-    assert all("engine" in e["path"] for e in hits)
+    debt = [e for e in entries.values()
+            if "accepted debt" in e.get("why", "") or "ROADMAP item" in e.get("why", "")]
+    assert debt == [], (
+        "baseline still carries roadmap-debt entries; retire them with the "
+        f"fix that clears the hazard: {debt}"
+    )
+    # and specifically: no baseline entry suppresses anything on the
+    # engine's admission/fetch path anymore
+    assert not any(e["path"].endswith("llm/engine.py") for e in entries.values())
+    # the stale-drop path proves the remaining ledger is live: a full
+    # concur pass uses every entry it keeps (bl.diff flags unused budget)
+    from ray_tpu.lint.concur import all_concur_rules, concur_rule_ids
+
+    findings = lint_paths([PKG], root=ROOT, rules=all_concur_rules())
+    ccr_ids = concur_rule_ids() | {"TPL004"}
+    ccr_entries = {fp: e for fp, e in entries.items() if e["rule"] in ccr_ids}
+    d = bl.diff(findings, ccr_entries)
+    assert d.stale == [], (
+        f"stale baseline entries (accepted hazards the code no longer trips): {d.stale}"
+    )
 
 
 def test_cli_select_ccr001_runs_only_that_rule(tmp_path, capsys):

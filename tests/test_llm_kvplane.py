@@ -422,7 +422,16 @@ def test_evicted_remote_block_bounded_retry_local_prefill(params, rt, oracle_fp)
     out_b = b.generate(prompt, SP)
     assert time.time() - t0 < 30, "lost-block fallback must be bounded, not a hang"
     assert out_b.token_ids == oracle_fp.generate(prompt, SP).token_ids, "fallback prefill diverged"
-    s = b.prefix_cache_stats()
+    # the fetch resolves on the engine's async worker: with the client's
+    # retry budget above the fetch deadline the request abandons to local
+    # prefill FIRST and the terminal lost-accounting lands when the
+    # worker finishes (zombie reap) — poll briefly for it
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        s = b.prefix_cache_stats()
+        if s["remote"]["lost"]:
+            break
+        time.sleep(0.05)
     assert s["remote"]["hits"] == 0 and s["remote"]["lost"] == 1
     assert s["plane"]["fetch_lost"] == 1
     # report_lost dropped the dead route; B's own publish (from its local
