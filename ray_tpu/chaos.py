@@ -69,7 +69,10 @@ import threading
 import time
 from dataclasses import dataclass
 
+from ray_tpu.exceptions import serving_error
 
+
+@serving_error
 class ChaosError(RuntimeError):
     """Default injected fault (rules may substitute any exception type)."""
 
@@ -89,6 +92,24 @@ SITES = frozenset({
     "serve.step",
     "serve.preempt",
 })
+
+# site -> typed errors (exceptions.SERVING_ERRORS names) a fault at that
+# site may surface as to a caller that exhausts its degradation path.
+# lint_gate's chaos-coverage cross-check enforces three-way agreement:
+# every SITES entry has a row here, every name is registered in
+# SERVING_ERRORS, and every name is exercised by tests/test_llm_chaos.py
+# — so a new injection site cannot land without a typed error and a test.
+FAULT_MODES: dict[str, tuple[str, ...]] = {
+    "direct.put_owned": ("ObjectLostError",),
+    "direct.get_owned_view": ("ObjectLostError",),
+    "handoff.put": ("HandoffLostError",),
+    "handoff.fetch": ("HandoffLostError",),
+    "kvplane.index": ("KVRouteError",),
+    "kvplane.prefetch": ("ChaosError",),
+    "llm.suspend": ("MigrationError",),
+    "serve.step": ("StepperDiedError",),
+    "serve.preempt": ("RequestMigratedError",),
+}
 
 _RPC_PREFIX = "rpc."
 

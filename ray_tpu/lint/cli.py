@@ -65,6 +65,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--update-baseline", action="store_true", help="accept current findings into the baseline and exit 0")
     p.add_argument("--select", default=None, help="comma-separated rule ids/names to run (default: all; alias ids like TPL004 resolve)")
     p.add_argument("--concur", action="store_true", help="run only the CCR concurrency-discipline rules")
+    p.add_argument("--fault", action="store_true", help="run only the ERR fault-discipline rules")
     p.add_argument("--jax", action="store_true", help="also trace registered entry points and run the JXC jaxpr rules")
     p.add_argument("--format", choices=("text", "json"), default="text",
                    help="json = one finding per line (JSON Lines)")
@@ -76,8 +77,8 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
-        # all three catalogs, uniformly: TPL+CCR (rule_catalog spans the
-        # merged AST registry) and JXC
+        # all four catalogs, uniformly: TPL+CCR+ERR (rule_catalog spans
+        # the merged AST registry) and JXC
         from ray_tpu.lint.jaxcheck import jax_rule_catalog
 
         for rid, name, summary in rule_catalog() + jax_rule_catalog():
@@ -89,6 +90,10 @@ def main(argv: list[str] | None = None) -> int:
         from ray_tpu.lint.concur import concur_rule_ids
 
         select = (select or set()) | concur_rule_ids() if select else concur_rule_ids()
+    if args.fault:
+        from ray_tpu.lint.fault import fault_rule_ids
+
+        select = (select or set()) | fault_rule_ids() if select else fault_rule_ids()
     rules = all_rules(select)
     root = os.path.abspath(args.root or os.getcwd())
 

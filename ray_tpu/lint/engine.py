@@ -31,8 +31,10 @@ _SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+|all)")
 
 # Retired rule ids that live on as aliases of their successor: old inline
 # disables, --select args, and baseline entries keep working verbatim.
-# TPL004 (lock-order cycles) moved into the concur catalog as CCR006.
-RULE_ALIASES = {"TPL004": "CCR006"}
+# TPL004 (lock-order cycles) moved into the concur catalog as CCR006;
+# TPL007 (swallowed connection errors) generalized into the fault
+# catalog as ERR001.
+RULE_ALIASES = {"TPL004": "CCR006", "TPL007": "ERR001"}
 
 
 def canonical_rule(rule_id: str) -> str:

@@ -1,0 +1,13 @@
+"""Fault-discipline (ERR) lint catalog.
+
+See ``ray_tpu/lint/fault/rules.py`` for the rules and
+``ray_tpu/exceptions.SERVING_ERRORS`` for the typed-error taxonomy the
+catalog audits against.
+"""
+
+from ray_tpu.lint.fault.rules import (  # noqa: F401
+    FAULT_RULES,
+    all_fault_rules,
+    fault_rule_catalog,
+    fault_rule_ids,
+)

@@ -1,0 +1,607 @@
+"""ERR rules: fault discipline over the serving/plane paths.
+
+ERR001  swallowed-exception        an ``except`` that neither re-raises,
+                                   converts to a taxonomy type, nor
+                                   counts/logs (absorbed TPL007; the old
+                                   id stays a live alias for baselines
+                                   and inline disables)
+ERR002  non-taxonomy-raise         bare ``RuntimeError``/``ValueError``/
+                                   ``Exception`` reachable (depth 2 via
+                                   the callgraph) from a serving ingress,
+                                   engine-step, or router root
+ERR003  raise-without-cause        ``raise X(...)`` inside an ``except``
+                                   block without ``from e`` (or explicit
+                                   cause threading) — a dropped chain
+                                   breaks the router probes
+ERR004  unbounded-retry            retry-shaped ``while True`` loop
+                                   (sleep + except) that neither draws a
+                                   RetryBudget nor tests a deadline
+ERR005  unbounded-transport-call   transport/index/object-plane call
+                                   (``index_call``, ``.request()``,
+                                   ``.fetch()``, ``get_owned_view``,
+                                   ``ray.get``) without a bounded
+                                   timeout, interprocedural through the
+                                   callgraph
+
+The discipline these rules enforce is the robustness plane's contract:
+every failure surfaces as a *typed* error (``exceptions.SERVING_ERRORS``)
+in a *bounded* time, and cause chains survive wrapping so the router
+probes (``http_error_of``, ``migration_of``, ``is_overloaded``) can
+classify them. Deliberate hazards go to the baseline with a ``why`` or an
+inline ``# tpulint: disable=ERR00x`` (locally explainable).
+
+Serving-path scoping: ERR002–005 and ERR001's broad arm only fire under
+``ray_tpu/serve/``, ``ray_tpu/llm/`` and ``core/direct.py`` (the transport
+the serving planes ride) — control-plane and test scaffolding raise and
+wait however they like. ERR001's connection-error arm keeps TPL007's
+any-path scope: a silently dropped peer death is a hazard everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.lint.callgraph import CallGraph, _walk_body, blocking_ray_call, dotted
+from ray_tpu.lint.concur.lockset import iter_functions
+from ray_tpu.lint.engine import FileContext, Finding, Rule, ScopedVisitor, call_keyword
+
+
+# ---------------------------------------------------------------------------
+# shared predicates
+# ---------------------------------------------------------------------------
+def _serving_path(path: str) -> bool:
+    """Paths carrying the serving/plane discipline (see module docstring).
+    Matched on posix-relative finding paths, so fixtures opt in by
+    passing e.g. ``path="ray_tpu/serve/fixture.py"`` to lint_source."""
+    parts = path.split("/")
+    return "serve" in parts or "llm" in parts or path.endswith("core/direct.py")
+
+
+# serving ingress / engine-step / router roots: a raise or unbounded wait
+# reachable from one of these is client-visible by construction
+_ROOT_NAMES = {
+    "generate", "generate_stream", "__call__", "step", "prefill", "decode",
+    "generate_from_handoff", "resume_from_migration", "resume_suspended",
+    "suspend_request", "preempt", "check_health", "route",
+}
+
+
+def _is_root(name: str) -> bool:
+    return name in _ROOT_NAMES or name.startswith("handle")
+
+
+_CONN_ERRORS = {
+    "ConnectionError", "ConnectionResetError", "ConnectionAbortedError",
+    "ConnectionRefusedError", "BrokenPipeError",
+}
+_BROAD_CATCHES = {"Exception", "BaseException"}
+
+
+def _names(type_expr: ast.AST | None) -> list[str]:
+    """Last segments of the caught exception type(s); [] for bare except."""
+    if type_expr is None:
+        return []
+    exprs = list(type_expr.elts) if isinstance(type_expr, ast.Tuple) else [type_expr]
+    out = []
+    for e in exprs:
+        name = dotted(e)
+        if name is not None:
+            out.append(name.split(".")[-1])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ERR001: swallowed exception (absorbed TPL007)
+# ---------------------------------------------------------------------------
+# teardown/eviction contexts where best-effort swallows are the
+# documented idiom (the operation is already ending; there is no caller
+# left to surface a typed error to) — same carve-out TPL007 made for
+# plain OSError cleanup swallows
+_TEARDOWN_TOKENS = (
+    "shutdown", "close", "cancel", "stop", "teardown", "cleanup", "clear",
+    "release", "drop", "free", "evict", "finalize", "abort",
+)
+
+
+def _teardown_scope(qualname: str) -> bool:
+    leaf = qualname.rsplit(".", 1)[-1].lower()
+    return leaf == "__del__" or any(tok in leaf for tok in _TEARDOWN_TOKENS)
+
+
+def _uses_name(body: list[ast.stmt], name: str | None) -> bool:
+    if name is None:
+        return False
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Name) and n.id == name:
+                return True
+    return False
+
+
+def _handles_somehow(handler: ast.ExceptHandler) -> bool:
+    """True when the handler observably HANDLES the exception: re-raises,
+    calls anything (log/count/cleanup helpers), bumps a counter
+    (AugAssign), writes shared state another path reads (assignment to an
+    attribute or subscript, e.g. ``rec["error"] = True``), or lets the
+    bound exception value escape (``last = e`` for a later terminal
+    raise). A handler doing none of these drops the event on the floor."""
+    for stmt in handler.body:
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.Raise, ast.Call, ast.AugAssign)):
+                return True
+            if isinstance(n, (ast.Assign, ast.AnnAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+                for t in targets:
+                    if any(isinstance(s, (ast.Attribute, ast.Subscript)) for s in ast.walk(t)):
+                        return True
+    return _uses_name(handler.body, handler.name)
+
+
+def _all_trivial(body: list[ast.stmt]) -> bool:
+    """Statement shapes that cannot observe the exception: pass/continue/
+    break, constant expressions (docstrings), call-free returns and
+    call-free assignments."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue
+        if isinstance(stmt, (ast.Return, ast.Assign, ast.AnnAssign)):
+            if any(isinstance(n, ast.Call) for n in ast.walk(stmt)):
+                return False
+            continue
+        return False
+    return True
+
+
+class _SwallowVisitor(ScopedVisitor):
+    def __init__(self, rule: Rule, ctx: FileContext):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        self.serving = _serving_path(ctx.path)
+        self.out: list[Finding] = []
+
+    def visit_Try(self, node: ast.Try):
+        for handler in node.handlers:
+            caught = set(_names(handler.type))
+            conn = sorted(caught & _CONN_ERRORS)
+            bare_body = all(
+                isinstance(s, (ast.Pass, ast.Continue, ast.Break)) for s in handler.body
+            )
+            if conn and bare_body:
+                # TPL007's arm, any path: a dropped peer-death transition
+                self.out.append(self.rule.finding(
+                    self.ctx, handler,
+                    f"swallowed {'/'.join(conn)} with a bare pass: the peer-death event is "
+                    "lost (pending work never fails over); complete/fail the in-flight "
+                    "state or record why another path observes it",
+                    context=self.qualname,
+                ))
+            elif (
+                self.serving
+                and self.qualname  # module level = import-guard idiom
+                and not _teardown_scope(self.qualname)
+                and not _handles_somehow(handler)
+            ):
+                # broad catches only: catching a SPECIFIC taxonomy type and
+                # degrading (break/continue on GetTimeoutError in a poll
+                # loop) is the bounded-degradation idiom, not a swallow
+                broad = handler.type is None or bool(caught & _BROAD_CATCHES)
+                if broad and _all_trivial(handler.body):
+                    what = "/".join(sorted(caught)) if caught else "bare except"
+                    self.out.append(self.rule.finding(
+                        self.ctx, handler,
+                        f"swallowed exception ({what}) on a serving path: the handler "
+                        "neither re-raises, converts to a SERVING_ERRORS type, nor "
+                        "counts/logs — the failure vanishes instead of surfacing typed",
+                        context=self.qualname,
+                    ))
+        self.generic_visit(node)
+
+
+class SwallowedException(Rule):
+    id = "ERR001"
+    name = "swallowed-exception"
+    summary = (
+        "except handler on a serving/plane path that neither re-raises, converts to a "
+        "taxonomy type, nor counts/logs (alias: TPL007)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        v = _SwallowVisitor(self, ctx)
+        v.visit(ctx.tree)
+        yield from v.out
+
+
+# ---------------------------------------------------------------------------
+# ERR002: non-taxonomy raise reachable from a serving root
+# ---------------------------------------------------------------------------
+_GENERIC_RAISES = {"RuntimeError", "ValueError", "Exception"}
+
+
+def _raised_name(node: ast.Raise) -> str | None:
+    exc = node.exc
+    if isinstance(exc, ast.Call):
+        name = dotted(exc.func)
+    else:
+        name = dotted(exc) if exc is not None else None
+    return name.split(".")[-1] if name else None
+
+
+def _reachable_raises(cg: CallGraph, fn, cls, depth: int):
+    """(raise node, resolved call chain) lexically in ``fn`` or in callees
+    resolvable to ``depth`` further levels (cycle-safe)."""
+    out: list[tuple[ast.Raise, tuple[str, ...]]] = []
+    seen = {id(fn)}
+
+    def rec(f, c, d, chain):
+        for n in _walk_body(f):
+            if isinstance(n, ast.Raise) and n.exc is not None:
+                out.append((n, chain))
+            elif isinstance(n, ast.Call) and d > 0:
+                callee = cg.resolve(n, c)
+                if callee is not None and id(callee) not in seen:
+                    seen.add(id(callee))
+                    rec(callee, cg.class_of(callee), d - 1, chain + (callee.name,))
+
+    rec(fn, cls, depth, ())
+    return out
+
+
+class NonTaxonomyRaise(Rule):
+    id = "ERR002"
+    name = "non-taxonomy-raise"
+    summary = (
+        "bare RuntimeError/ValueError/Exception raised on a path reachable from a "
+        "serving ingress, engine step, or router root"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _serving_path(ctx.path):
+            return
+        cg = CallGraph(ctx.tree)
+        fns = list(iter_functions(ctx.tree))
+        owner: dict[int, str] = {}
+        for fn, _cls, qual in fns:
+            for n in _walk_body(fn):
+                owner.setdefault(id(n), qual)
+        reported: set[int] = set()
+        for fn, cls, qual in fns:
+            if not _is_root(fn.name):
+                continue
+            for raise_node, chain in _reachable_raises(cg, fn, cls, depth=2):
+                name = _raised_name(raise_node)
+                if name not in _GENERIC_RAISES or id(raise_node) in reported:
+                    continue
+                reported.add(id(raise_node))
+                via = f" via {' -> '.join(chain)}" if chain else ""
+                yield self.finding(
+                    ctx, raise_node,
+                    f"raise {name} reachable from serving root {qual}(){via}: "
+                    "client-visible failures must be SERVING_ERRORS types "
+                    "(exceptions.py) so proxies/routers can classify them",
+                    context=owner.get(id(raise_node), qual),
+                )
+
+
+# ---------------------------------------------------------------------------
+# ERR003: raise inside except without cause threading
+# ---------------------------------------------------------------------------
+def _threads_cause(call: ast.Call, bound: list[str]) -> bool:
+    """Explicit cause threading: the bound exception passed as a bare
+    argument of the replacement error (``TaskError(cause=e)``,
+    ``from_exception(e)``)."""
+    args = list(call.args) + [kw.value for kw in call.keywords]
+    return any(isinstance(a, ast.Name) and a.id in bound for a in args)
+
+
+class _CauseVisitor(ScopedVisitor):
+    def __init__(self, rule: Rule, ctx: FileContext):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        self.out: list[Finding] = []
+        self._bound: list[str] = []  # handler-bound names, innermost last
+        self._in_handler = 0
+
+    def visit_Try(self, node: ast.Try):
+        for stmt in node.body + node.orelse + node.finalbody:
+            self.visit(stmt)
+        for handler in node.handlers:
+            self._in_handler += 1
+            if handler.name:
+                self._bound.append(handler.name)
+            for stmt in handler.body:
+                self.visit(stmt)
+            if handler.name:
+                self._bound.pop()
+            self._in_handler -= 1
+
+    def visit_Raise(self, node: ast.Raise):
+        if (
+            self._in_handler
+            and isinstance(node.exc, ast.Call)
+            and node.cause is None
+            and not _threads_cause(node.exc, self._bound)
+        ):
+            name = dotted(node.exc.func) or "<exception>"
+            self.out.append(self.rule.finding(
+                self.ctx, node,
+                f"raise {name.split('.')[-1]}(...) inside except without `from e` "
+                "(or passing the caught error in): the dropped cause chain blinds "
+                "the router probes (http_error_of / migration_of / is_overloaded)",
+                context=self.qualname,
+            ))
+        self.generic_visit(node)
+
+
+class RaiseWithoutCause(Rule):
+    id = "ERR003"
+    name = "raise-without-cause"
+    summary = (
+        "raise X(...) inside an except block without `from e` or explicit cause "
+        "threading — wire probes lose the classification chain"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _serving_path(ctx.path):
+            return
+        v = _CauseVisitor(self, ctx)
+        v.visit(ctx.tree)
+        yield from v.out
+
+
+# ---------------------------------------------------------------------------
+# ERR004: unbounded retry loop
+# ---------------------------------------------------------------------------
+_BOUND_TOKENS = ("deadline", "timeout", "budget", "retries", "retry", "attempt", "tries")
+
+
+def _loop_is_bounded(loop: ast.While) -> bool:
+    """Any identifier smelling of a bound (deadline/timeout/budget/
+    attempt counter) or a RetryBudget.try_spend call inside the loop."""
+    for n in ast.walk(loop):
+        ident = None
+        if isinstance(n, ast.Name):
+            ident = n.id
+        elif isinstance(n, ast.Attribute):
+            ident = n.attr
+        if ident is not None:
+            low = ident.lower()
+            if any(tok in low for tok in _BOUND_TOKENS):
+                return True
+        if isinstance(n, ast.Call):
+            fname = dotted(n.func)
+            if fname is not None and fname.split(".")[-1] == "try_spend":
+                return True
+    return False
+
+
+class UnboundedRetryLoop(Rule):
+    id = "ERR004"
+    name = "unbounded-retry"
+    summary = (
+        "while-True retry loop (sleep + except) that neither draws from a RetryBudget "
+        "nor tests a deadline — failure never surfaces in bounded time"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _serving_path(ctx.path):
+            return
+        for fn, _cls, qual in iter_functions(ctx.tree):
+            for node in _walk_body(fn):
+                if not isinstance(node, ast.While):
+                    continue
+                test = node.test
+                if not (isinstance(test, ast.Constant) and test.value in (True, 1)):
+                    continue
+                has_sleep = any(
+                    isinstance(n, ast.Call)
+                    and (dotted(n.func) or "").split(".")[-1] == "sleep"
+                    for n in ast.walk(node)
+                )
+                has_retry = any(
+                    isinstance(n, ast.Try) and n.handlers for n in ast.walk(node)
+                )
+                if has_sleep and has_retry and not _loop_is_bounded(node):
+                    yield self.finding(
+                        ctx, node,
+                        "retry-shaped `while True` (sleep + except) with no deadline, "
+                        "timeout, attempt bound, or RetryBudget draw: on a persistent "
+                        "fault this path retries forever instead of failing typed",
+                        context=qual,
+                    )
+
+
+# ---------------------------------------------------------------------------
+# ERR005: unbounded transport / plane call
+# ---------------------------------------------------------------------------
+_PLANE_FETCH_ATTRS = {"fetch", "lookup", "publish", "register", "heartbeat"}
+
+
+def _timeout_value(call: ast.Call, positional_idx: int | None = None):
+    """('absent', None) when no timeout is passed; ('node', expr) with the
+    passed expression otherwise. ``positional_idx`` names the positional
+    slot a timeout may ride in (None = keyword-only)."""
+    kw = call_keyword(call, "timeout", "timeout_s")
+    if kw is not None:
+        return "node", kw.value
+    if positional_idx is not None and len(call.args) > positional_idx:
+        return "node", call.args[positional_idx]
+    return "absent", None
+
+
+def _is_none(expr: ast.AST | None) -> bool:
+    return isinstance(expr, ast.Constant) and expr.value is None
+
+
+def _forwarded_none_params(fn) -> dict[str, str]:
+    """param name -> transport label, for params defaulting to None that a
+    function forwards into a transport call's timeout argument — callers
+    omitting the param inherit an unbounded wait."""
+    args = fn.args
+    defaults = dict(zip([a.arg for a in args.args[len(args.args) - len(args.defaults):]],
+                        args.defaults))
+    defaults.update({a.arg: d for a, d in zip(args.kwonlyargs, args.kw_defaults) if d is not None})
+    none_params = {name for name, d in defaults.items()
+                   if _is_none(d) and "timeout" in name.lower()}
+    if not none_params:
+        return {}
+    out: dict[str, str] = {}
+    for n in _walk_body(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        kw = call_keyword(n, "timeout", "timeout_s")
+        if kw is not None and isinstance(kw.value, ast.Name) and kw.value.id in none_params:
+            label = dotted(n.func) or "<call>"
+            out[kw.value.id] = f"{label}()"
+    return out
+
+
+def _passes_param(call: ast.Call, fn, param: str) -> bool:
+    if call_keyword(call, param) is not None:
+        return True
+    names = [a.arg for a in fn.args.args]
+    if isinstance(call.func, ast.Attribute) and names and names[0] == "self":
+        names = names[1:]
+    if param in names:
+        return len(call.args) > names.index(param)
+    return False
+
+
+class UnboundedTransportCall(Rule):
+    id = "ERR005"
+    name = "unbounded-transport-call"
+    summary = (
+        "transport/index/object-plane call (index_call, .request(), .fetch(), "
+        "get_owned_view, ray.get) without a bounded timeout (interprocedural)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _serving_path(ctx.path):
+            return
+        cg = CallGraph(ctx.tree)
+        fns = list(iter_functions(ctx.tree))
+
+        # serving-reachable set for the ray.get arm: roots + depth-2 callees
+        reach: set[int] = set()
+        for fn, cls, _qual in fns:
+            if not _is_root(fn.name):
+                continue
+            frontier = [(fn, cls, 2)]
+            while frontier:
+                f, c, d = frontier.pop()
+                if id(f) in reach:
+                    continue
+                reach.add(id(f))
+                if d == 0:
+                    continue
+                for n in _walk_body(f):
+                    if isinstance(n, ast.Call):
+                        callee = cg.resolve(n, c)
+                        if callee is not None:
+                            frontier.append((callee, cg.class_of(callee), d - 1))
+
+        # forwarding helpers: fn -> {param: transport label}
+        forwards: dict[int, tuple[object, dict[str, str]]] = {}
+        for fn, _cls, _qual in fns:
+            fwd = _forwarded_none_params(fn)
+            if fwd:
+                forwards[id(fn)] = (fn, fwd)
+
+        seen: set[int] = set()
+        for fn, cls, qual in fns:
+            for node in _walk_body(fn):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                msg = self._classify(node, cls, cg, forwards, id(fn) in reach)
+                if msg is not None:
+                    seen.add(id(node))
+                    yield self.finding(ctx, node, msg, context=qual)
+
+    @staticmethod
+    def _classify(node: ast.Call, cls, cg: CallGraph, forwards, on_serving_root) -> str | None:
+        fname = dotted(node.func) or ""
+        last = fname.split(".")[-1]
+        # explicit timeout=None on any transport shape is always a hazard
+        kw = call_keyword(node, "timeout", "timeout_s")
+        explicit_none = kw is not None and _is_none(kw.value)
+        if isinstance(node.func, ast.Name) and node.func.id == "index_call":
+            if explicit_none:
+                return "index_call(timeout_s=None): an index RPC must keep its bounded default"
+            return None
+        hit = blocking_ray_call(node)
+        if hit is not None:
+            name, bounded = hit
+            if explicit_none:
+                return f"{name}(timeout=None) on a serving path blocks forever on a lost object"
+            if not bounded and on_serving_root:
+                return (
+                    f"unbounded {name}() reachable from a serving root: pass timeout= "
+                    "so a lost object surfaces as GetTimeoutError, not a hang"
+                )
+            return None
+        # interprocedural: calling a local forwarding helper without its
+        # timeout param leaves the transport call inside unbounded
+        callee = cg.resolve(node, cls)
+        if callee is not None and id(callee) in forwards:
+            fn_def, fwd = forwards[id(callee)]
+            for param, label in sorted(fwd.items()):
+                if not _passes_param(node, fn_def, param):
+                    return (
+                        f"{callee.name}() called without {param}= — it forwards that "
+                        f"None default into {label}, which then never times out"
+                    )
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        attr = node.func.attr
+        recv = dotted(node.func.value) or ""
+        rlast = recv.split(".")[-1].lower() if recv else ""
+        if attr == "get_owned_view":
+            state, expr = _timeout_value(node, positional_idx=1)
+            if state == "absent" or _is_none(expr):
+                return (
+                    f"{recv or '<plane>'}.get_owned_view() without a bounded timeout: "
+                    "a lost owner parks the serving path forever"
+                )
+            return None
+        if attr == "request" and ("conn" in rlast or "peer" in rlast):
+            state, expr = _timeout_value(node, positional_idx=None)
+            if state == "absent" or _is_none(expr):
+                return (
+                    f"{recv}.request() without timeout=: a dead peer never answers — "
+                    "bound it so the caller fails over"
+                )
+            return None
+        if explicit_none and (attr in _PLANE_FETCH_ATTRS or last == "fetch"):
+            return f"{recv or fname}.{attr}(timeout=None) disables the transport's bounded default"
+        return None
+
+
+FAULT_RULES = (
+    SwallowedException,
+    NonTaxonomyRaise,
+    RaiseWithoutCause,
+    UnboundedRetryLoop,
+    UnboundedTransportCall,
+)
+
+
+def all_fault_rules(select: set[str] | None = None) -> list[Rule]:
+    from ray_tpu.lint.engine import canonical_rule
+
+    rules = [cls() for cls in FAULT_RULES]
+    if select:
+        canon = {canonical_rule(s) for s in select}
+        rules = [r for r in rules if r.id in canon or r.name in select]
+    return rules
+
+
+def fault_rule_catalog() -> list[tuple[str, str, str]]:
+    return [(cls.id, cls.name, cls.summary) for cls in FAULT_RULES]
+
+
+def fault_rule_ids() -> set[str]:
+    return {cls.id for cls in FAULT_RULES}

@@ -1,24 +1,24 @@
 """Rule registry: one module per rule, one instance per run.
 
-The default registry spans the TPL (distributed-runtime AST) and CCR
-(concurrency-discipline) catalogs — both are pure-AST passes over the
-same FileContext, so `all_rules()` runs them together and the tree
-self-check / lint gate cover CCR automatically. JXC (jaxpr) rules need
-tracing and stay behind ``--jax``.
+The default registry spans the TPL (distributed-runtime AST), CCR
+(concurrency-discipline) and ERR (fault-discipline) catalogs — all are
+pure-AST passes over the same FileContext, so `all_rules()` runs them
+together and the tree self-check / lint gate cover CCR and ERR
+automatically. JXC (jaxpr) rules need tracing and stay behind ``--jax``.
 
 ``--select`` accepts ids, names, and retired alias ids (TPL004 selects
-CCR006 — see engine.RULE_ALIASES).
+CCR006, TPL007 selects ERR001 — see engine.RULE_ALIASES).
 """
 
 from __future__ import annotations
 
 from ray_tpu.lint.concur.rules import CONCUR_RULES
 from ray_tpu.lint.engine import Rule, canonical_rule
+from ray_tpu.lint.fault.rules import FAULT_RULES
 from ray_tpu.lint.rules.blocking_get import BlockingGetInActor
 from ray_tpu.lint.rules.dropped_ref import DroppedObjectRef
 from ray_tpu.lint.rules.jax_purity import JaxImpureJit
 from ray_tpu.lint.rules.remote_capture import RemoteCapturesUnserializable
-from ray_tpu.lint.rules.swallowed_conn_error import SwallowedConnError
 from ray_tpu.lint.rules.unbounded_poll import UnboundedPollInDeadlineLoop
 
 _RULES = (
@@ -27,8 +27,7 @@ _RULES = (
     RemoteCapturesUnserializable,
     JaxImpureJit,
     UnboundedPollInDeadlineLoop,
-    SwallowedConnError,
-) + tuple(CONCUR_RULES)
+) + tuple(CONCUR_RULES) + tuple(FAULT_RULES)
 
 
 def all_rules(select: set[str] | None = None) -> list[Rule]:

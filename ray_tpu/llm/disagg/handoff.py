@@ -28,13 +28,17 @@ import time
 
 import numpy as np
 
+from ray_tpu.exceptions import serving_error
+
 HANDOFF_VERSION = 1
 
 
+@serving_error
 class HandoffError(ValueError):
     """Malformed or inconsistent handoff payload (codec-level)."""
 
 
+@serving_error
 class HandoffLostError(RuntimeError):
     """The handoff object vanished (owner died / evicted / freed) before
     the decode side could scatter it in. Bounded-retry callers raise this

@@ -39,8 +39,10 @@ from __future__ import annotations
 import threading
 
 from ray_tpu.llm.disagg.handoff import HandoffLostError
+from ray_tpu.exceptions import serving_error
 
 
+@serving_error
 class DisaggRequestError(RuntimeError):
     """Client-visible terminal failure after the router's retry budget."""
 

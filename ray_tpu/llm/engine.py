@@ -114,7 +114,7 @@ def _bucket(n: int, buckets) -> int:
     for b in buckets:
         if n <= b:
             return b
-    raise ValueError(f"prompt length {n} exceeds the largest prefill bucket {buckets[-1]}")
+    raise ValueError(f"prompt length {n} exceeds the largest prefill bucket {buckets[-1]}")  # tpulint: disable=ERR002 — suspend_request wraps it `raise MigrationError(...) from e`; ingress callers treat it as 400-class input validation
 
 
 # RequestState.cached_pref miss marker: prefix resolution ran and MISSED
@@ -892,7 +892,7 @@ class LLMEngine:
                 request_id = f"req-{self._auto_id}"
                 self._auto_id += 1
             if len(prompt_token_ids) + params.max_tokens > self.max_seq_len:
-                raise ValueError(
+                raise ValueError(  # tpulint: disable=ERR002 — request-shape validation at admission: 400-class caller error, not a fleet fault
                     f"prompt ({len(prompt_token_ids)}) + max_tokens ({params.max_tokens}) "
                     f"exceeds max_seq_len ({self.max_seq_len})"
                 )
@@ -900,7 +900,7 @@ class LLMEngine:
                 T = _bucket(len(prompt_token_ids), self.prefill_buckets)
                 need = min(T // self._pcfg.page_size + 1, self._pcfg.max_pages_per_seq)
                 if need > self._pcfg.num_pages - 1:
-                    raise ValueError(
+                    raise ValueError(  # tpulint: disable=ERR002 — pool-sizing validation at admission: config error the operator must fix, not a serving fault
                         f"prompt needs {need} pages but the pool has "
                         f"{self._pcfg.num_pages - 1}; raise num_pages"
                     )
@@ -1301,7 +1301,7 @@ class LLMEngine:
                 meta, ref = _mig.publish(state)
                 with self._lock:
                     rec["ref"], rec["meta"] = ref, meta
-            except Exception:  # noqa: BLE001 — DRAM tier stays valid
+            except Exception:  # tpulint: disable=ERR001 — noqa: BLE001 — plane publish is opportunism: the DRAM tier copy stays valid, resume still works
                 pass
         return {"request_id": request_id, "nbytes": nbytes, "published": rec["ref"] is not None}
 

@@ -141,7 +141,7 @@ class KVPlaneClient:
             "published_blocks": 0, "published_bytes": 0, "unpublished_blocks": 0,
             "published_skipped": 0,
             "fetches": 0, "fetched_bytes": 0, "fetch_lost": 0,
-            "index_errors": 0, "publish_errors": 0,
+            "index_errors": 0, "publish_errors": 0, "free_errors": 0,
             "prefetch_rounds": 0, "prefetch_blocks": 0, "prefetch_bytes": 0,
             "prefetch_skipped": 0, "prefetch_errors": 0,
         }
@@ -362,7 +362,9 @@ class KVPlaneClient:
             try:
                 _direct.free_owned([ref.id])
             except BaseException:  # noqa: BLE001
-                pass
+                # best-effort, but the failed free must stay visible:
+                # stranded owner bytes show up in stats() as free_errors
+                self.counts["free_errors"] += 1
             return 0
         with self._lock:
             for bn, key in bounds:

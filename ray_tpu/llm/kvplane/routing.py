@@ -32,7 +32,10 @@ from __future__ import annotations
 
 import threading
 
+from ray_tpu.exceptions import serving_error
 
+
+@serving_error
 class KVRouteError(RuntimeError):
     """Client-visible terminal failure after the router's retry budget."""
 

@@ -63,6 +63,8 @@ import time
 
 import numpy as np
 
+from ray_tpu.exceptions import serving_error
+
 from ray_tpu.llm.disagg import handoff as _handoff
 from ray_tpu.llm.sampling import SamplingParams
 
@@ -70,18 +72,21 @@ LIVE_STATE_VERSION = 1
 LIVE_KIND = _handoff.LIVE_KIND
 
 
+@serving_error
 class MigrationError(ValueError):
     """Malformed/inconsistent live_state payload, or a request whose
     state cannot be checkpointed (streaming consumer, prefill-only stub,
     sampled request with no live lane key)."""
 
 
+@serving_error
 class MigrationLostError(RuntimeError):
     """The published checkpoint vanished (owner process exited, object
     freed) before a peer could fetch it. Bounded-retry callers raise this
     after their budget; routers react by re-prefilling."""
 
 
+@serving_error
 class RequestMigratedError(RuntimeError):
     """Typed signal a migrating replica hands each in-flight waiter: the
     request did not fail — its live state was checkpointed and published,

@@ -24,10 +24,12 @@ import time
 from dataclasses import dataclass, field
 
 from ray_tpu import chaos
+from ray_tpu.exceptions import GetTimeoutError
 from ray_tpu.serve.overload import (
     AdmissionController,
     OverloadedError,  # noqa: F401 (re-export: the ingress's typed 429)
     ReplicaDrainingError,  # noqa: F401 (re-export)
+    StepperDiedError,
 )
 
 
@@ -177,7 +179,7 @@ class LLMServer:
     def check_health(self):
         """Serve health hook: a dead stepper means a dead engine."""
         if self._stepper_error is not None:
-            raise RuntimeError(f"llm stepper died:\n{self._stepper_error}")
+            raise StepperDiedError(f"llm stepper died:\n{self._stepper_error}")
         return True
 
     # -- engine pump: one thread advances every active sequence together --
@@ -279,7 +281,7 @@ class LLMServer:
                 )
             return
         if self._stepper_error is not None:
-            raise RuntimeError(f"llm stepper died:\n{self._stepper_error}")
+            raise StepperDiedError(f"llm stepper died:\n{self._stepper_error}")
 
     # -- request paths --
     def generate(self, prompt_token_ids, sampling_params: dict | None = None, timeout_s: float = 300.0) -> dict:
@@ -342,7 +344,7 @@ class LLMServer:
             # the preemption abort fallback) — not a server fault
             raise err
         if out is None:
-            raise RuntimeError(f"llm stepper died:\n{self._stepper_error or 'unknown'}")
+            raise StepperDiedError(f"llm stepper died:\n{self._stepper_error or 'unknown'}")
         return out
 
     def _fail_waiter(self, rid: str, exc: BaseException) -> None:
@@ -452,7 +454,7 @@ class LLMServer:
         shutdown hook; it is also directly callable for planned
         rebalancing. Returns what was drained/migrated."""
         if mode not in ("abort", "migrate"):
-            raise ValueError(f"drain mode must be 'abort' or 'migrate', got {mode!r}")
+            raise ValueError(f"drain mode must be 'abort' or 'migrate', got {mode!r}")  # tpulint: disable=ERR002 — operator-API argument validation, never client-visible
         with self._drain_lock:
             if self._drain_result is not None:
                 return dict(self._drain_result, repeated=True)
@@ -531,7 +533,7 @@ class LLMServer:
                     state = eng.checkpoint_request(rid)
                     meta, ref = _mig.publish(state)
                     err = _mig.RequestMigratedError(rid, meta, ref)
-                except Exception:  # noqa: BLE001 — abort is the fallback leg
+                except Exception:  # tpulint: disable=ERR001 — noqa: BLE001 — checkpoint/publish failure degrades to the abort leg below; the request still terminates typed
                     err = None
             if err is not None:
                 migrated.append({"request_id": rid, "meta": err.migration_meta,
@@ -652,7 +654,7 @@ class OpenAIServer(LLMServer):
         if isinstance(prompt, list):
             return [int(t) for t in prompt]
         if self.tokenizer is None:
-            raise ValueError("string prompts need LLMConfig.tokenizer (encode/decode); token-id lists work without one")
+            raise ValueError("string prompts need LLMConfig.tokenizer (encode/decode); token-id lists work without one")  # tpulint: disable=ERR002 — deployment-config validation (missing tokenizer): 400-class, fails every request identically
         return list(self.tokenizer.encode(prompt))
 
     def _decode(self, token_ids):
@@ -767,17 +769,19 @@ class OpenAIServer(LLMServer):
         deadline = _time.monotonic() + 300.0
         while True:
             if self._stepper_error is not None:
-                raise RuntimeError(f"llm stepper died:\n{self._stepper_error}")
+                raise StepperDiedError(f"llm stepper died:\n{self._stepper_error}")
             try:
                 tok = out_q.get(timeout=min(5.0, max(0.1, deadline - _time.monotonic())))
-            except _queue.Empty:
+            except _queue.Empty as e:
                 if _time.monotonic() > deadline:
                     self.engine.abort_request(rid)
-                    raise TimeoutError(f"stream {rid} produced no token for 300s")
+                    # typed (504, retryable) and chained: GetTimeoutError
+                    # IS-A TimeoutError, so pre-taxonomy callers still match
+                    raise GetTimeoutError(f"stream {rid} produced no token for 300s") from e
                 continue
             if tok is None:
                 if self._stepper_error is not None:
-                    raise RuntimeError(f"llm stepper died:\n{self._stepper_error}")
+                    raise StepperDiedError(f"llm stepper died:\n{self._stepper_error}")
                 break
             piece = self._decode([tok])
             content = {"role": "assistant", "content": piece} if chat else piece
@@ -819,6 +823,7 @@ class PrefillServer(LLMServer):
         """-> {"meta": {...}, "ref": ObjectRef}: the handoff publish half
         (llm/disagg/handoff.py)."""
         from ray_tpu.llm.disagg import publish_handoff
+        from ray_tpu.llm.disagg.handoff import HandoffError
 
         self._check_alive()
         # class-blind capacity guard (the prefill ingress doesn't know the
@@ -835,7 +840,7 @@ class PrefillServer(LLMServer):
             raise
         kv = self.engine.pop_handoff(rid)
         if out.finish_reason != "handoff" or kv is None:
-            raise RuntimeError(f"prefill-only request {rid} failed: {out.finish_reason}")
+            raise HandoffError(f"prefill-only request {rid} failed: {out.finish_reason}")
         meta, ref = publish_handoff(kv)
         return {"meta": meta, "ref": ref}
 

@@ -45,6 +45,8 @@ import threading
 import time
 from dataclasses import dataclass
 
+from ray_tpu.exceptions import SERVING_ERRORS, serving_error
+
 # Jitter for 429 retry hints: every shed client sleeping EXACTLY
 # retry_after_s re-arrives as one synchronized herd and re-saturates the
 # replica it just backed off from; ±25% spread de-phases them. A
@@ -56,13 +58,12 @@ RETRY_JITTER_FRAC = 0.25
 _retry_jitter = random.Random(0x52455452)  # "RETR"
 
 
+@serving_error
 class OverloadedError(RuntimeError):
     """Typed admission rejection: the replica (or the whole fleet, when a
     router exhausts its failover budget on overloaded replicas) cannot
     take this request NOW. Maps to HTTP 429; ``retry_after_s`` is the
     ingress's backoff hint (the estimated queue wait, clamped)."""
-
-    status_code = 429
 
     def __init__(self, msg: str, *, retry_after_s: float = 1.0, shed_class: int = 0):
         super().__init__(msg)
@@ -70,9 +71,19 @@ class OverloadedError(RuntimeError):
         self.shed_class = int(shed_class)
 
 
+@serving_error
 class ReplicaDrainingError(OverloadedError):
     """The replica is draining (finish-in-flight only): routers treat it
     exactly like overload — fail over to another replica, never wait."""
+
+
+@serving_error
+class StepperDiedError(RuntimeError):
+    """The replica's stepper thread died mid-flight: every waiter on this
+    replica fails with the stepper's traceback as context, and another
+    replica can serve the retry (503 + retryable in ``SERVING_ERRORS``).
+    Subclasses RuntimeError so pre-taxonomy callers that matched the old
+    bare ``RuntimeError("llm stepper died")`` keep working."""
 
 
 def _causes(e: BaseException | None):
@@ -131,7 +142,12 @@ def http_error_of(e: BaseException | None):
     proxy, or None for the generic 500 path. Walks the cause chain for a
     real status/retry-after carrier FIRST (the wrapper's traceback
     string must not shadow a surviving cause's hint), then falls back to
-    the remote traceback text for causes that didn't survive pickling."""
+    the remote traceback text for causes that didn't survive pickling.
+    Both passes are table-driven off ``exceptions.SERVING_ERRORS``: the
+    attr pass reads the ``status_code``/``retryable`` the
+    ``@serving_error`` decorator stamped, the traceback pass scans for
+    ANY registered class name — adding a typed error to the table is the
+    whole job, no proxy ladder to extend."""
     for err in _causes(e):
         code = getattr(err, "status_code", None)
         if code is not None:
@@ -142,8 +158,14 @@ def http_error_of(e: BaseException | None):
             return int(code), body
     for err in _causes(e):
         tb = getattr(err, "tb_str", "")
-        if "OverloadedError" in tb or "ReplicaDrainingError" in tb:
-            return 429, {"error": str(err), "retry_after_s": 1.0}
+        if not tb:
+            continue
+        for name, spec in SERVING_ERRORS.items():
+            if name in tb:
+                body = {"error": str(err)}
+                if spec.retryable:
+                    body["retry_after_s"] = 1.0
+                return spec.status_code, body
     return None
 
 
@@ -418,7 +440,7 @@ class RetryBudget:
         if self._tel is not None:
             try:
                 self._tel.on_budget_exhausted()
-            except Exception:  # noqa: BLE001 — accounting never fails a request path
+            except Exception:  # tpulint: disable=ERR001 — noqa: BLE001 — telemetry accounting is never load-bearing; failing it must not fail the request path
                 pass
 
 

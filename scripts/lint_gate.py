@@ -243,6 +243,79 @@ def check_chaos_safety() -> list[str]:
     return problems
 
 
+def check_chaos_coverage() -> list[str]:
+    """Chaos-coverage gate: the injection surface, the typed-error
+    taxonomy, and the chaos suite must agree three ways —
+
+    1. every ``chaos.SITES`` entry has a ``chaos.FAULT_MODES`` row naming
+       the typed error(s) a fault at that site may surface as;
+    2. every named error is registered in ``exceptions.SERVING_ERRORS``
+       (so proxies/routers can classify it by table lookup);
+    3. every named error is exercised somewhere in
+       ``tests/test_llm_chaos.py`` (textually — the suite must at least
+       name the type it asserts).
+
+    A new injection site therefore cannot land without a typed error and
+    a chaos test; a taxonomy row cannot silently lose its chaos coverage.
+    Import-time only (chaos.py and exceptions.py are both jax-free);
+    returns problems."""
+    import importlib.util
+
+    def _load(modname: str, *rel):
+        mod = sys.modules.get(modname)
+        if mod is not None:
+            return mod
+        path = os.path.join(ROOT, *rel)
+        alias = f"_rt_cov_{rel[-1].removesuffix('.py')}"
+        spec = importlib.util.spec_from_file_location(alias, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[alias] = mod
+        try:
+            spec.loader.exec_module(mod)
+        finally:
+            sys.modules.pop(alias, None)
+        return mod
+
+    try:
+        chaos = _load("ray_tpu.chaos", "ray_tpu", "chaos.py")
+        exceptions = _load("ray_tpu.exceptions", "ray_tpu", "exceptions.py")
+    except Exception as e:  # noqa: BLE001
+        return [f"chaos-coverage: module load failed: {type(e).__name__}: {e}"]
+
+    problems: list[str] = []
+    modes = getattr(chaos, "FAULT_MODES", {})
+    registered = set(exceptions.SERVING_ERRORS)
+    try:
+        suite = open(os.path.join(ROOT, "tests", "test_llm_chaos.py"), encoding="utf-8").read()
+    except OSError as e:
+        return [f"chaos-coverage: cannot read tests/test_llm_chaos.py: {e}"]
+
+    for site in sorted(chaos.SITES):
+        names = modes.get(site)
+        if not names:
+            problems.append(
+                f"chaos-coverage: site {site!r} has no FAULT_MODES row — name the typed "
+                "error(s) a fault there surfaces as"
+            )
+            continue
+        for name in names:
+            if name not in registered:
+                problems.append(
+                    f"chaos-coverage: site {site!r} fault mode {name!r} is not registered "
+                    "in exceptions.SERVING_ERRORS"
+                )
+            if name not in suite:
+                problems.append(
+                    f"chaos-coverage: site {site!r} fault mode {name!r} is never exercised "
+                    "in tests/test_llm_chaos.py"
+                )
+    for site in sorted(set(modes) - chaos.SITES):
+        problems.append(
+            f"chaos-coverage: FAULT_MODES row {site!r} names a site not in chaos.SITES"
+        )
+    return problems
+
+
 def check_baseline_policy() -> list[str]:
     """Baseline-policy gate: every accepted finding in the committed
     baseline must carry a non-empty hand-written ``why`` (the ledger of
@@ -291,12 +364,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("git_hook_args", nargs="*", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
-    # the telemetry, chaos-safety and baseline-policy gates are
-    # import-time cheap: run them unconditionally (a broken metric
-    # catalog, dashboard panel, reachable chaos injection, or an
-    # unjustified baseline entry fails the push regardless of which file
-    # introduced it)
-    telemetry_problems = check_telemetry() + check_chaos_safety() + check_baseline_policy()
+    # the telemetry, chaos-safety, chaos-coverage and baseline-policy
+    # gates are import-time cheap: run them unconditionally (a broken
+    # metric catalog, dashboard panel, reachable chaos injection,
+    # untyped/untested fault mode, or an unjustified baseline entry
+    # fails the push regardless of which file introduced it)
+    telemetry_problems = (
+        check_telemetry()
+        + check_chaos_safety()
+        + check_chaos_coverage()
+        + check_baseline_policy()
+    )
     for prob in telemetry_problems:
         print(f"lint_gate: {prob}", file=sys.stderr)
 

@@ -421,30 +421,30 @@ def test_tpl006_silent_outside_loops():
     """, "TPL006") == []
 
 
-# ------------------------------------------------------------------ TPL007
-def test_tpl007_flags_bare_pass_swallow():
+# ------------------------------------- ERR001 conn arm (absorbed TPL007)
+def test_err001_flags_bare_pass_conn_swallow():
     out = run("""
         def send(sock, data):
             try:
                 sock.sendall(data)
             except ConnectionError:
                 pass
-    """, "TPL007")
+    """, "ERR001")
     assert len(out) == 1
 
 
-def test_tpl007_flags_tuple_catch_with_conn_member():
+def test_err001_flags_tuple_catch_with_conn_member():
     out = run("""
         def send(sock, data):
             try:
                 sock.sendall(data)
             except (BrokenPipeError, ValueError):
                 pass
-    """, "TPL007")
+    """, "ERR001")
     assert len(out) == 1
 
 
-def test_tpl007_silent_on_handled_or_cleanup_oserror():
+def test_err001_silent_on_handled_or_cleanup_oserror():
     assert run("""
         def close(sock):
             try:
@@ -457,11 +457,13 @@ def test_tpl007_silent_on_handled_or_cleanup_oserror():
                 sock.sendall(data)
             except ConnectionError:
                 st.failover()
-    """, "TPL007") == []
+    """, "ERR001") == []
 
 
 # -------------------------------------------------------------- engine bits
-def test_inline_suppression_comment():
+def test_inline_suppression_comment_accepts_retired_alias_id():
+    # disable=TPL007 must keep suppressing after the TPL007 -> ERR001
+    # migration: both sides of the comparison canonicalize
     src = """
         def send(sock, data):
             try:
@@ -469,7 +471,7 @@ def test_inline_suppression_comment():
             except ConnectionError:  # tpulint: disable=TPL007
                 pass
     """
-    assert run(src, "TPL007") == []
+    assert run(src, "ERR001") == []
     src_all = src.replace("disable=TPL007", "disable=all")
     assert run(src_all) == []
 
@@ -1276,3 +1278,269 @@ def test_update_baseline_carries_why_across_rule_alias():
     prior[old.fingerprint()]["why"] = "two-phase shutdown, documented"
     fresh = bl.entries_from_findings([new], prior=prior)
     assert fresh[new.fingerprint()]["why"] == "two-phase shutdown, documented"
+
+
+# ------------------------------------------- ERR catalog (fault discipline)
+def run_serving(src: str, rule_id: str | None = None):
+    """ERR002-005 and ERR001's broad arm only fire on serving paths —
+    fixtures opt in via the path."""
+    out = lint_source(textwrap.dedent(src), path="ray_tpu/serve/fixture.py")
+    assert not any(f.rule == "TPLERR" for f in out), out
+    if rule_id is None:
+        return out
+    return [f for f in out if f.rule == rule_id]
+
+
+def test_err001_broad_arm_flags_serving_swallow():
+    out = run_serving("""
+        def push(state, item):
+            try:
+                state.deliver(item)
+            except Exception:
+                pass
+    """, "ERR001")
+    assert len(out) == 1
+    assert out[0].context == "push"
+
+
+def test_err001_broad_arm_needs_serving_path():
+    # same code outside serve/llm/direct stays TPL007-scoped: broad
+    # swallows fire only where the typed-error contract applies
+    assert run("""
+        def push(state, item):
+            try:
+                state.deliver(item)
+            except Exception:
+                pass
+    """, "ERR001") == []
+
+
+def test_err001_silent_when_handler_observes():
+    assert run_serving("""
+        def push(self, state, item):
+            try:
+                state.deliver(item)
+            except Exception:
+                self.counts["deliver_errors"] += 1
+
+        def flag(rec, state, item):
+            try:
+                state.deliver(item)
+            except Exception:
+                rec["error"] = True
+
+        def rewrap(state, item):
+            try:
+                state.deliver(item)
+            except Exception as e:
+                raise RuntimeError("x") from e
+    """, "ERR001") == []
+
+
+def test_err001_silent_in_teardown_scope_and_module_guard():
+    assert run_serving("""
+        try:
+            import fastpath
+        except Exception:
+            fastpath = None
+
+        class Pool:
+            def shutdown(self):
+                try:
+                    self.conn.close()
+                except Exception:
+                    pass
+
+            def __del__(self):
+                try:
+                    self.conn.close()
+                except Exception:
+                    pass
+    """, "ERR001") == []
+
+
+def test_err001_silent_on_specific_typed_catch_degradation():
+    # catching a SPECIFIC taxonomy type and degrading is the
+    # bounded-degradation idiom (poll loop break), not a swallow
+    assert run_serving("""
+        def drain(q):
+            while q:
+                try:
+                    q.pop_ready()
+                except GetTimeoutError:
+                    break
+    """, "ERR001") == []
+
+
+def test_err002_flags_generic_raise_from_serving_root():
+    out = run_serving("""
+        def step(engine):
+            raise RuntimeError("stepper wedged")
+    """, "ERR002")
+    assert len(out) == 1
+    assert "step()" in out[0].message
+
+
+def test_err002_follows_callgraph_two_levels():
+    out = run_serving("""
+        class Server:
+            def generate(self, prompt):
+                return self._admit(prompt)
+
+            def _admit(self, prompt):
+                if not prompt:
+                    raise ValueError("empty prompt")
+    """, "ERR002")
+    assert len(out) == 1
+    assert "via _admit" in out[0].message
+    assert out[0].context == "Server._admit"
+
+
+def test_err002_silent_on_typed_raise_and_non_root():
+    assert run_serving("""
+        def step(engine):
+            raise MigrationError("typed is fine")
+
+        def helper_not_a_root(engine):
+            raise RuntimeError("unreachable from any root at depth 0")
+    """, "ERR002") == []
+
+
+def test_err003_flags_raise_in_except_without_cause():
+    out = run_serving("""
+        def fetch(plane, key):
+            try:
+                return plane.get(key)
+            except KeyError:
+                raise LookupFailed(f"no {key}")
+    """, "ERR003")
+    assert len(out) == 1
+    assert "from e" in out[0].message
+
+
+def test_err003_silent_when_cause_threaded():
+    assert run_serving("""
+        def a(plane, key):
+            try:
+                return plane.get(key)
+            except KeyError as e:
+                raise LookupFailed(f"no {key}") from e
+
+        def b(plane, key):
+            try:
+                return plane.get(key)
+            except KeyError as e:
+                raise TaskError(cause=e)
+
+        def c(plane, key):
+            try:
+                return plane.get(key)
+            except KeyError:
+                raise  # bare re-raise keeps the original
+    """, "ERR003") == []
+
+
+def test_err004_flags_unbounded_retry_loop():
+    out = run_serving("""
+        def pump(plane, item):
+            while True:
+                try:
+                    return plane.publish(item)
+                except Exception:
+                    time.sleep(0.1)
+    """, "ERR004")
+    assert len(out) == 1
+
+
+def test_err004_silent_when_loop_is_bounded():
+    assert run_serving("""
+        def pump_deadline(plane, item, deadline):
+            while True:
+                if time.monotonic() > deadline:
+                    raise PublishFailed("out of time")
+                try:
+                    return plane.publish(item)
+                except Exception:
+                    time.sleep(0.1)
+
+        def pump_budget(plane, item, budget):
+            while True:
+                try:
+                    return plane.publish(item)
+                except Exception:
+                    if not budget.try_spend():
+                        raise
+                    time.sleep(0.1)
+    """, "ERR004") == []
+
+
+def test_err005_flags_unbounded_gets_on_serving_root():
+    out = run_serving("""
+        import ray_tpu
+
+        def step(engine, ref, plane, conn):
+            a = ray_tpu.get(ref)
+            b = plane.get_owned_view(ref.id)
+            c = conn.request("get", key="k")
+            return a, b, c
+    """, "ERR005")
+    assert len(out) == 3
+
+
+def test_err005_silent_when_bounded():
+    assert run_serving("""
+        import ray_tpu
+
+        def step(engine, ref, plane, conn):
+            a = ray_tpu.get(ref, timeout=5.0)
+            b = plane.get_owned_view(ref.id, timeout=10.0)
+            c = conn.request("get", key="k", timeout=10.0)
+            return a, b, c
+    """, "ERR005") == []
+
+
+def test_err005_interprocedural_forwarded_none_timeout():
+    # helper defaults timeout_s=None and forwards it into the transport:
+    # a caller omitting the param inherits the unbounded wait
+    out = run_serving("""
+        def fetch_block(plane, key, timeout_s=None):
+            return plane.fetch(key, timeout_s=timeout_s)
+
+        def caller(plane, key):
+            return fetch_block(plane, key)
+
+        def bounded_caller(plane, key):
+            return fetch_block(plane, key, timeout_s=30.0)
+    """, "ERR005")
+    assert len(out) == 1
+    assert "fetch_block" in out[0].message and out[0].context == "caller"
+
+
+# ------------------------------------- TPL007 -> ERR001 alias contract
+def test_tpl007_alias_baseline_entry_suppresses_err001_finding():
+    # an entry accepted under the OLD id (old-id fingerprint and all)
+    # still suppresses the finding now reported as ERR001
+    f = run("""
+        def send(sock, data):
+            try:
+                sock.sendall(data)
+            except ConnectionError:
+                pass
+    """, "ERR001")[0]
+    old = Finding("TPL007", f.path, f.line, f.col, f.message, f.context)
+    entries = bl.entries_from_findings([old])
+    assert set(entries) == {old.fingerprint()} != {f.fingerprint()}
+    d = bl.diff([f], entries)
+    assert d.new == [] and d.suppressed == 1 and d.stale == []
+
+
+def test_update_baseline_carries_why_across_tpl007_migration():
+    # a hand-annotated TPL007 entry regenerated after the absorption
+    # keeps its why VERBATIM under the new ERR001 fingerprint
+    new = Finding("ERR001", "ray_tpu/x.py", 3, 4, "swallowed ConnectionError", "send")
+    old = Finding("TPL007", new.path, new.line, new.col, new.message, new.context)
+    prior = bl.entries_from_findings([old])
+    why = "deliberate: peer death observed by the heartbeat plane one layer up"
+    prior[old.fingerprint()]["why"] = why
+    fresh = bl.entries_from_findings([new], prior=prior)
+    assert fresh[new.fingerprint()]["why"] == why

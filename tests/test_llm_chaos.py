@@ -1011,3 +1011,108 @@ def test_chaos_index_delay_bounded_by_engine_paths(params, rt, oracle):
     assert list(out.token_ids) == oracle["shared"]
     assert time.perf_counter() - t0 < 60.0
     assert not plane.index_down()  # slow is not dead: breaker stays closed
+
+
+# ------------------------------------------------- fault taxonomy (ERR catalog)
+
+
+def test_fault_taxonomy_registry_agreement():
+    """The three-way contract the lint gate's chaos-coverage check locks:
+    every chaos site declares its fault modes (FAULT_MODES), every declared
+    mode is registered in SERVING_ERRORS with a sane wire classification,
+    and @serving_error stamped the class so instance probes resolve."""
+    from ray_tpu import exceptions as exc
+
+    assert set(chaos.FAULT_MODES) == set(chaos.SITES)
+    for site, names in chaos.FAULT_MODES.items():
+        assert names, f"site {site} declares no fault modes"
+        for name in names:
+            spec = exc.SERVING_ERRORS[name]
+            assert 400 <= spec.status_code < 600, f"{name}: {spec.status_code}"
+    spec = exc.serving_error_spec(ChaosError("x"))
+    assert spec is exc.SERVING_ERRORS["ChaosError"]
+    assert ChaosError.status_code == spec.status_code
+    assert ChaosError.retryable == spec.retryable
+
+
+@pytest.mark.chaos
+def test_chaos_suspend_fault_is_migration_error_with_cause(params):
+    """An injected fault at llm.suspend surfaces as the typed
+    MigrationError with the injected ChaosError intact on __cause__ (the
+    ERR catalog's cause-chain discipline, end to end), and the refusal
+    leaves the conversation RUNNING — a later suspend still works."""
+    from ray_tpu.exceptions import serving_error_spec
+    from ray_tpu.llm.migrate import MigrationError
+
+    eng = LLMEngine(CFG, params, max_num_seqs=2, max_seq_len=128)
+    rid = eng.add_request(list(PROMPT), SP)
+    for _ in range(3):
+        eng.step()
+    chaos.inject("llm.suspend", raises=ChaosError)
+    with pytest.raises(MigrationError) as ei:
+        eng.suspend_request(rid, publish=False)
+    assert isinstance(ei.value.__cause__, ChaosError)
+    spec = serving_error_spec(ei.value)
+    assert spec is not None and spec.status_code == 500 and not spec.retryable
+    chaos.clear()
+    assert not eng._requests[rid].finished  # refusal mutated nothing
+    assert eng.suspend_request(rid, publish=False)["nbytes"] > 0
+
+
+@pytest.mark.chaos
+def test_chaos_stepper_death_is_typed_stepper_died(params):
+    """A raises rule on serve.step kills the stepper: the waiter and the
+    health probe both see the typed StepperDiedError (503, retryable) —
+    still a RuntimeError subclass, so pre-taxonomy callers keep matching."""
+    from ray_tpu.exceptions import serving_error_spec
+    from ray_tpu.serve.overload import StepperDiedError
+
+    srv = LLMServer(_cfg(params))
+    try:
+        chaos.inject("serve.step", raises=ChaosError, max_hits=1)
+        with pytest.raises(StepperDiedError) as ei:
+            srv.generate(list(PROMPT), {"max_tokens": SP.max_tokens}, timeout_s=30.0)
+        assert isinstance(ei.value, RuntimeError)
+        assert "ChaosError" in str(ei.value)
+        spec = serving_error_spec(ei.value)
+        assert spec is not None and spec.status_code == 503 and spec.retryable
+        with pytest.raises(StepperDiedError):
+            srv.check_health()
+    finally:
+        srv.shutdown()
+
+
+def test_stream_stall_and_handoff_failures_map_typed():
+    """Regression for the ERR002 fixes in serve/llm.py: the stream-stall
+    abort raises GetTimeoutError (504, retryable — still a TimeoutError
+    for pre-taxonomy callers) chained on the queue.Empty that tripped it,
+    and a failed prefill-only request raises HandoffError (500, not
+    retryable — still a ValueError). http_error_of maps both off the
+    SERVING_ERRORS table, walking the cause chain, with retry_after_s
+    only on the retryable row."""
+    import queue as _queue
+
+    from ray_tpu.exceptions import GetTimeoutError, serving_error_spec
+    from ray_tpu.llm.disagg.handoff import HandoffError
+    from ray_tpu.serve.overload import http_error_of
+
+    assert issubclass(GetTimeoutError, TimeoutError)
+    assert issubclass(HandoffError, ValueError)
+
+    try:
+        try:
+            raise _queue.Empty()
+        except _queue.Empty as e:
+            raise GetTimeoutError("stream r1 produced no token for 300s") from e
+    except GetTimeoutError as stall:
+        assert isinstance(stall.__cause__, _queue.Empty)
+        spec = serving_error_spec(stall)
+        assert spec is not None and spec.status_code == 504 and spec.retryable
+        status, body = http_error_of(stall)
+        assert status == 504 and "stream r1" in body["error"]
+
+    handoff = HandoffError("prefill-only request r2 failed: error")
+    spec = serving_error_spec(handoff)
+    assert spec is not None and spec.status_code == 500 and not spec.retryable
+    status, body = http_error_of(handoff)
+    assert status == 500 and "retry_after_s" not in body

@@ -372,6 +372,29 @@ def test_publish_runs_with_engine_lock_released(params, rt):
     assert idx.stats()["keys"] == 1  # registered by the time generate() returned
 
 
+def test_publish_free_failure_is_counted_not_raised(params, rt, monkeypatch):
+    """Regression for the ERR001 fix in KVPlaneClient.publish: when the
+    index register RPC fails (the compensating path frees the freshly
+    put owned block) AND that free ALSO fails, publish still degrades to
+    0 — it never raises into the prefill stage — but the stranded
+    owner-side bytes stay visible as a free_errors count instead of
+    vanishing in a silent swallow."""
+    from ray_tpu.core import direct
+
+    client = _client(PrefixIndex(), "A")
+    monkeypatch.setattr(client, "_safe_call", lambda *a, **kw: None)
+
+    def boom(refs):
+        raise RuntimeError("owner store unreachable")
+
+    monkeypatch.setattr(direct, "free_owned", boom)
+    ids = list(range(1, 65))  # one full 64-token block boundary
+    blk = np.zeros((2, 64, 1, 4), np.float32)
+    assert client.publish(ids, blk, blk) == 0
+    assert client.counts["free_errors"] == 1
+    assert client.counts["published_blocks"] == 0
+
+
 def test_blocked_follower_still_hits_leaders_same_wave_store(params):
     """A leader and a shared-prefix follower arriving together, pool too
     small for both: the follower's first resolution MISSES (the leader's
